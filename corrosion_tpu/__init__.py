@@ -1,0 +1,17 @@
+"""corrosion-tpu: a TPU-native, gossip-based, multi-writer distributed store.
+
+A brand-new framework with the capabilities of Corrosion (studied via the
+klukai fork): SWIM membership, infection-style change broadcast, pull-based
+anti-entropy sync, column-level LWW CRDT merge with causal-length deletes,
+live-query subscriptions, and an HTTP/CLI surface.
+
+The core is re-architected for JAX/XLA: the per-node SWIM state machine and
+broadcast fanout are batched message-passing kernels over node-state arrays
+(`corrosion_tpu.ops.swim`), member shards are laid out over a
+`jax.sharding.Mesh` (`corrosion_tpu.parallel`), and the CRDT merge is a
+vectorized compare-and-select kernel (`corrosion_tpu.ops.merge`). The host
+runtime (agents, transports, sync protocol, HTTP API) lives alongside and
+speaks wire formats modeled on the reference's (see `corrosion_tpu.types`).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,38 @@
+"""Wire types, identifiers, and codecs (counterpart of klukai-types)."""
+
+from corrosion_tpu.types.base import DbVersion, Seq, Timestamp, HLClock
+from corrosion_tpu.types.actor import ActorId, ClusterId, Actor
+from corrosion_tpu.types.values import (
+    SqliteValue,
+    TYPE_NULL,
+    TYPE_INTEGER,
+    TYPE_REAL,
+    TYPE_TEXT,
+    TYPE_BLOB,
+)
+from corrosion_tpu.types.pack import pack_columns, unpack_columns
+from corrosion_tpu.types.rangeset import RangeSet
+from corrosion_tpu.types.change import Change, Changeset, ChangeV1, chunk_changes
+
+__all__ = [
+    "DbVersion",
+    "Seq",
+    "Timestamp",
+    "HLClock",
+    "ActorId",
+    "ClusterId",
+    "Actor",
+    "SqliteValue",
+    "TYPE_NULL",
+    "TYPE_INTEGER",
+    "TYPE_REAL",
+    "TYPE_TEXT",
+    "TYPE_BLOB",
+    "pack_columns",
+    "unpack_columns",
+    "RangeSet",
+    "Change",
+    "Changeset",
+    "ChangeV1",
+    "chunk_changes",
+]

@@ -1,0 +1,138 @@
+"""The CRDT change unit and changesets.
+
+Counterpart of `klukai-types/src/change.rs` (Change, ChunkedChanges,
+MAX_CHANGES_BYTE_SIZE) and the Changeset/ChangeV1 wire enums from
+`klukai-types/src/broadcast.rs:98-283`.
+
+A `Change` is one column-level CRDT delta: a (table, pk, column) cell with
+its value and clock metadata. `cl` is the causal length of the row: odd =
+alive, even = deleted; the delete sentinel column is `DELETE_SENTINEL`.
+A version's changes are sequenced 0..=last_seq; changesets may carry a
+sub-range (partial version) — receivers buffer partials until the seq range
+closes (reference `agent/util.rs:1070-1203`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+from corrosion_tpu.types.actor import ActorId
+from corrosion_tpu.types.base import Timestamp
+from corrosion_tpu.types.values import SqliteValue
+
+# cr-sqlite sentinels (observable in crsql_changes rows)
+DELETE_SENTINEL = "__crsql_del"
+PKONLY_SENTINEL = "__crsql_pko"
+
+MAX_CHANGES_BYTE_SIZE = 8 * 1024  # change.rs:179
+
+
+@dataclass(frozen=True)
+class Change:
+    table: str
+    pk: bytes  # pack_columns-encoded primary key
+    cid: str  # column name or sentinel
+    val: SqliteValue
+    col_version: int
+    db_version: int
+    seq: int
+    site_id: bytes  # 16 bytes == ActorId
+    cl: int  # causal length (odd=alive, even=deleted)
+    ts: Timestamp = field(default=Timestamp(0), compare=False)
+
+    def estimated_byte_size(self) -> int:
+        # change.rs:34-52: rough wire-size estimate
+        val_sz = (
+            len(self.val)
+            if isinstance(self.val, (str, bytes))
+            else 8
+            if self.val is not None
+            else 0
+        )
+        return len(self.table) + len(self.pk) + len(self.cid) + val_sz + 8 * 5 + 16
+
+    def is_delete(self) -> bool:
+        return self.cid == DELETE_SENTINEL
+
+
+@dataclass(frozen=True)
+class ChangesetEmpty:
+    """Versions known to carry no changes (cleared/compacted)."""
+
+    versions: Tuple[int, int]  # inclusive range
+    ts: Optional[Timestamp] = None
+
+
+@dataclass(frozen=True)
+class ChangesetEmptySet:
+    versions: Tuple[Tuple[int, int], ...]
+    ts: Timestamp = Timestamp(0)
+
+
+@dataclass(frozen=True)
+class ChangesetFull:
+    version: int
+    changes: Tuple[Change, ...]
+    seqs: Tuple[int, int]  # inclusive seq range carried here
+    last_seq: int  # final seq of the full version
+    ts: Timestamp = Timestamp(0)
+
+    def is_complete(self) -> bool:
+        return self.seqs == (0, self.last_seq)
+
+    def is_empty(self) -> bool:
+        return not self.changes
+
+
+Changeset = object  # union: ChangesetEmpty | ChangesetEmptySet | ChangesetFull
+
+
+@dataclass(frozen=True)
+class ChangeV1:
+    actor_id: ActorId
+    changeset: object  # Changeset union
+
+    @property
+    def versions(self) -> Tuple[int, int]:
+        cs = self.changeset
+        if isinstance(cs, ChangesetFull):
+            return (cs.version, cs.version)
+        if isinstance(cs, ChangesetEmpty):
+            return cs.versions
+        raise TypeError("EmptySet has multiple ranges")
+
+
+def chunk_changes(
+    changes: Iterable[Change],
+    last_seq: int,
+    max_bytes: int = MAX_CHANGES_BYTE_SIZE,
+) -> Iterator[Tuple[List[Change], Tuple[int, int]]]:
+    """Group ordered same-version changes into chunks of ≤ max_bytes,
+    preserving contiguous seq coverage across gaps (change.rs:65-177):
+    each emitted seq range starts where the previous ended + 1, and the
+    final range extends to `last_seq`.
+
+    Yields (chunk, (seq_start, seq_end)).
+    """
+    buf: List[Change] = []
+    size = 0
+    range_start = 0
+    last_emitted_end: Optional[int] = None
+    it = iter(changes)
+    for ch in it:
+        buf.append(ch)
+        size += ch.estimated_byte_size()
+        if size >= max_bytes:
+            end = buf[-1].seq
+            yield buf, (range_start, end)
+            last_emitted_end = end
+            range_start = end + 1
+            buf, size = [], 0
+    if buf:
+        yield buf, (range_start, last_seq)
+    elif last_emitted_end is not None and last_emitted_end < last_seq:
+        yield [], (range_start, last_seq)
+    elif last_emitted_end is None:
+        # no changes at all: single empty full range
+        yield [], (0, last_seq)

@@ -1,0 +1,519 @@
+"""Binary wire codecs modeled byte-for-byte on the reference's speedy layouts.
+
+The reference serializes peer wire types with speedy 0.8 (little-endian):
+  - derived enums: u32 LE variant tag (speedy's default)
+  - hand-written enum codecs (Changeset, SyncNeedV1, SyncStateV1,
+    SqliteValue): u8 tags / u64 ("usize") lengths exactly as in
+    `klukai-types/src/broadcast.rs:285-375`, `sync.rs:258-346,371-437`,
+    `api.rs:657-707`
+  - Vec/String/HashMap: u32 LE length prefix; Option: u8 presence byte
+  - uuid/[u8;16]: 16 raw bytes; u64/i64/f64: LE fixed width
+
+Frames on uni/bi streams are length-delimited with a u32 BE length prefix
+(tokio LengthDelimitedCodec default), max frame 100 MiB
+(`klukai-agent/src/agent/uni.rs:50` / `api/peer/mod.rs`).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from corrosion_tpu.types.actor import ActorId, ClusterId
+from corrosion_tpu.types.base import Timestamp
+from corrosion_tpu.types.change import (
+    Change,
+    ChangeV1,
+    ChangesetEmpty,
+    ChangesetEmptySet,
+    ChangesetFull,
+)
+from corrosion_tpu.types.values import (
+    SqliteValue,
+    value_type,
+    TYPE_BLOB,
+    TYPE_INTEGER,
+    TYPE_NULL,
+    TYPE_REAL,
+    TYPE_TEXT,
+)
+
+MAX_FRAME = 100 * 1024 * 1024
+
+
+class Writer:
+    __slots__ = ("buf",)
+
+    def __init__(self):
+        self.buf = bytearray()
+
+    def u8(self, v: int):
+        self.buf.append(v & 0xFF)
+
+    def u16(self, v: int):
+        self.buf += struct.pack("<H", v)
+
+    def u32(self, v: int):
+        self.buf += struct.pack("<I", v)
+
+    def u64(self, v: int):
+        self.buf += struct.pack("<Q", v)
+
+    def i64(self, v: int):
+        self.buf += struct.pack("<q", v)
+
+    def f64(self, v: float):
+        self.buf += struct.pack("<d", v)
+
+    def raw(self, b: bytes):
+        self.buf += b
+
+    def string(self, s: str):
+        raw = s.encode("utf-8")
+        self.u32(len(raw))
+        self.buf += raw
+
+    def vec_u8(self, b: bytes):
+        self.u32(len(b))
+        self.buf += b
+
+    def opt(self, v, write_fn):
+        if v is None:
+            self.u8(0)
+        else:
+            self.u8(1)
+            write_fn(v)
+
+    def bytes(self) -> bytes:
+        return bytes(self.buf)
+
+
+class Reader:
+    __slots__ = ("data", "pos")
+
+    def __init__(self, data: bytes):
+        self.data = memoryview(data)
+        self.pos = 0
+
+    def _take(self, n: int) -> memoryview:
+        if self.pos + n > len(self.data):
+            raise ValueError("truncated buffer")
+        mv = self.data[self.pos : self.pos + n]
+        self.pos += n
+        return mv
+
+    def u8(self) -> int:
+        return self._take(1)[0]
+
+    def u16(self) -> int:
+        return struct.unpack("<H", self._take(2))[0]
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self._take(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack("<Q", self._take(8))[0]
+
+    def i64(self) -> int:
+        return struct.unpack("<q", self._take(8))[0]
+
+    def f64(self) -> float:
+        return struct.unpack("<d", self._take(8))[0]
+
+    def raw(self, n: int) -> bytes:
+        return bytes(self._take(n))
+
+    def string(self) -> str:
+        return self.raw(self.u32()).decode("utf-8")
+
+    def vec_u8(self) -> bytes:
+        return self.raw(self.u32())
+
+    def opt(self, read_fn):
+        return read_fn() if self.u8() else None
+
+    def eof(self) -> bool:
+        return self.pos >= len(self.data)
+
+
+# -- SqliteValue (api.rs:657-707, u8 tags) --------------------------------
+
+
+def write_value(w: Writer, v: SqliteValue) -> None:
+    t = value_type(v)
+    if t == TYPE_NULL:
+        w.u8(0)
+    elif t == TYPE_INTEGER:
+        w.u8(1)
+        w.i64(int(v))
+    elif t == TYPE_REAL:
+        w.u8(2)
+        w.f64(v)
+    elif t == TYPE_TEXT:
+        w.u8(3)
+        w.string(v)
+    else:
+        w.u8(4)
+        w.vec_u8(bytes(v))
+
+
+def read_value(r: Reader) -> SqliteValue:
+    tag = r.u8()
+    if tag == 0:
+        return None
+    if tag == 1:
+        return r.i64()
+    if tag == 2:
+        return r.f64()
+    if tag == 3:
+        return r.string()
+    if tag == 4:
+        return r.vec_u8()
+    raise ValueError(f"unknown SqliteValue tag {tag}")
+
+
+# -- Change (derive order: change.rs:19-29) --------------------------------
+
+
+def write_change(w: Writer, c: Change) -> None:
+    w.string(c.table)
+    w.vec_u8(c.pk)
+    w.string(c.cid)
+    write_value(w, c.val)
+    w.i64(c.col_version)
+    w.u64(c.db_version)
+    w.u64(c.seq)
+    w.raw(c.site_id)
+    w.i64(c.cl)
+
+
+def read_change(r: Reader) -> Change:
+    return Change(
+        table=r.string(),
+        pk=r.vec_u8(),
+        cid=r.string(),
+        val=read_value(r),
+        col_version=r.i64(),
+        db_version=r.u64(),
+        seq=r.u64(),
+        site_id=r.raw(16),
+        cl=r.i64(),
+    )
+
+
+# -- Changeset (hand-written u8 tags, broadcast.rs:285-375) ----------------
+
+
+def write_changeset(w: Writer, cs) -> None:
+    if isinstance(cs, ChangesetEmpty):
+        w.u8(0)
+        w.u64(cs.versions[0])
+        w.u64(cs.versions[1])
+        w.opt(cs.ts, lambda ts: w.u64(ts.ntp64))
+    elif isinstance(cs, ChangesetFull):
+        w.u8(1)
+        w.u64(cs.version)
+        w.u32(len(cs.changes))
+        for c in cs.changes:
+            write_change(w, c)
+        w.u64(cs.seqs[0])
+        w.u64(cs.seqs[1])
+        w.u64(cs.last_seq)
+        w.u64(cs.ts.ntp64)
+    elif isinstance(cs, ChangesetEmptySet):
+        w.u8(2)
+        w.u64(len(cs.versions))  # usize
+        for s, e in cs.versions:
+            w.u64(s)
+            w.u64(e)
+        w.u64(cs.ts.ntp64)
+    else:
+        raise TypeError(f"not a changeset: {cs!r}")
+
+
+def read_changeset(r: Reader):
+    tag = r.u8()
+    if tag == 0:
+        start, end = r.u64(), r.u64()
+        ts = r.opt(lambda: Timestamp(r.u64()))
+        return ChangesetEmpty(versions=(start, end), ts=ts)
+    if tag == 1:
+        version = r.u64()
+        changes = tuple(read_change(r) for _ in range(r.u32()))
+        seqs = (r.u64(), r.u64())
+        last_seq = r.u64()
+        ts = Timestamp(r.u64())
+        return ChangesetFull(version, changes, seqs, last_seq, ts)
+    if tag == 2:
+        n = r.u64()
+        versions = tuple((r.u64(), r.u64()) for _ in range(n))
+        ts = Timestamp(r.u64())
+        return ChangesetEmptySet(versions, ts)
+    raise ValueError(f"unknown Changeset tag {tag}")
+
+
+def write_change_v1(w: Writer, cv: ChangeV1) -> None:
+    w.raw(cv.actor_id.bytes16)
+    write_changeset(w, cv.changeset)
+
+
+def read_change_v1(r: Reader) -> ChangeV1:
+    return ChangeV1(actor_id=ActorId(r.raw(16)), changeset=read_changeset(r))
+
+
+# -- UniPayload / BiPayload (derived, u32 tags) ----------------------------
+
+
+def encode_uni_payload(cv: ChangeV1, cluster_id: ClusterId = ClusterId(0)) -> bytes:
+    w = Writer()
+    w.u32(0)  # UniPayload::V1
+    w.u32(0)  # UniPayloadV1::Broadcast
+    w.u32(0)  # BroadcastV1::Change
+    write_change_v1(w, cv)
+    w.u16(cluster_id.value)
+    return w.bytes()
+
+
+def decode_uni_payload(data: bytes) -> Tuple[ChangeV1, ClusterId]:
+    r = Reader(data)
+    if r.u32() != 0 or r.u32() != 0 or r.u32() != 0:
+        raise ValueError("unknown UniPayload variant")
+    cv = read_change_v1(r)
+    cluster_id = ClusterId(r.u16()) if not r.eof() else ClusterId(0)  # default_on_eof
+    return cv, cluster_id
+
+
+@dataclass(frozen=True)
+class SyncTraceContext:
+    traceparent: Optional[str] = None
+    tracestate: Optional[str] = None
+
+
+def encode_bi_payload_sync_start(
+    actor_id: ActorId,
+    trace: SyncTraceContext = SyncTraceContext(),
+    cluster_id: ClusterId = ClusterId(0),
+) -> bytes:
+    w = Writer()
+    w.u32(0)  # BiPayload::V1
+    w.u32(0)  # BiPayloadV1::SyncStart
+    w.raw(actor_id.bytes16)
+    w.opt(trace.traceparent, w.string)
+    w.opt(trace.tracestate, w.string)
+    w.u16(cluster_id.value)
+    return w.bytes()
+
+
+def decode_bi_payload(data: bytes) -> Tuple[ActorId, SyncTraceContext, ClusterId]:
+    r = Reader(data)
+    if r.u32() != 0 or r.u32() != 0:
+        raise ValueError("unknown BiPayload variant")
+    actor_id = ActorId(r.raw(16))
+    trace = SyncTraceContext(
+        traceparent=r.opt(r.string) if not r.eof() else None,
+        tracestate=r.opt(r.string) if not r.eof() else None,
+    )
+    cluster_id = ClusterId(r.u16()) if not r.eof() else ClusterId(0)
+    return actor_id, trace, cluster_id
+
+
+# -- Sync messages (sync.rs) ----------------------------------------------
+
+
+@dataclass
+class SyncState:
+    """SyncStateV1: what this node has and what it's missing, per origin."""
+
+    actor_id: ActorId
+    heads: Dict[ActorId, int]
+    need: Dict[ActorId, List[Tuple[int, int]]]
+    partial_need: Dict[ActorId, Dict[int, List[Tuple[int, int]]]]
+    last_cleared_ts: Optional[Timestamp] = None
+
+
+@dataclass(frozen=True)
+class NeedFull:
+    versions: Tuple[int, int]
+
+    def count(self) -> int:
+        return self.versions[1] - self.versions[0] + 1
+
+
+@dataclass(frozen=True)
+class NeedPartial:
+    version: int
+    seqs: Tuple[Tuple[int, int], ...]
+
+    def count(self) -> int:
+        return 1
+
+
+@dataclass(frozen=True)
+class NeedEmpty:
+    ts: Optional[Timestamp] = None
+
+    def count(self) -> int:
+        return 1
+
+
+@dataclass(frozen=True)
+class SyncRejection:
+    reason: int  # 0 = MaxConcurrencyReached, 1 = DifferentCluster
+
+    MAX_CONCURRENCY = 0
+    DIFFERENT_CLUSTER = 1
+
+
+# SyncMessage variants (SyncMessageV1 derived tags)
+_SYNC_STATE, _SYNC_CHANGESET, _SYNC_CLOCK, _SYNC_REJECTION, _SYNC_REQUEST = range(5)
+
+
+def _write_sync_state(w: Writer, st: SyncState) -> None:
+    w.raw(st.actor_id.bytes16)
+    w.u32(len(st.heads))
+    for aid, head in st.heads.items():
+        w.raw(aid.bytes16)
+        w.u64(head)
+    w.u64(len(st.need))  # usize in the manual impl
+    for aid, ranges in st.need.items():
+        w.raw(aid.bytes16)
+        w.u64(len(ranges))
+        for s, e in ranges:
+            w.u64(s)
+            w.u64(e)
+    w.u64(len(st.partial_need))
+    for aid, versions in st.partial_need.items():
+        w.raw(aid.bytes16)
+        w.u64(len(versions))
+        for version, seq_ranges in versions.items():
+            w.u64(version)
+            w.u64(len(seq_ranges))
+            for s, e in seq_ranges:
+                w.u64(s)
+                w.u64(e)
+    w.opt(st.last_cleared_ts, lambda ts: w.u64(ts.ntp64))
+
+
+def _read_sync_state(r: Reader) -> SyncState:
+    actor_id = ActorId(r.raw(16))
+    heads = {ActorId(r.raw(16)): r.u64() for _ in range(r.u32())}
+    need = {}
+    for _ in range(r.u64()):
+        aid = ActorId(r.raw(16))
+        need[aid] = [(r.u64(), r.u64()) for _ in range(r.u64())]
+    partial_need = {}
+    for _ in range(r.u64()):
+        aid = ActorId(r.raw(16))
+        versions = {}
+        for _ in range(r.u64()):
+            v = r.u64()
+            versions[v] = [(r.u64(), r.u64()) for _ in range(r.u64())]
+        partial_need[aid] = versions
+    last_cleared_ts = r.opt(lambda: Timestamp(r.u64()))
+    return SyncState(actor_id, heads, need, partial_need, last_cleared_ts)
+
+
+def _write_need(w: Writer, n) -> None:
+    if isinstance(n, NeedFull):
+        w.u8(0)
+        w.u64(n.versions[0])
+        w.u64(n.versions[1])
+    elif isinstance(n, NeedPartial):
+        w.u8(1)
+        w.u64(n.version)
+        w.u64(len(n.seqs))
+        for s, e in n.seqs:
+            w.u64(s)
+            w.u64(e)
+    elif isinstance(n, NeedEmpty):
+        w.u8(2)
+        w.opt(n.ts, lambda ts: w.u64(ts.ntp64))
+    else:
+        raise TypeError(f"not a need: {n!r}")
+
+
+def _read_need(r: Reader):
+    tag = r.u8()
+    if tag == 0:
+        return NeedFull((r.u64(), r.u64()))
+    if tag == 1:
+        version = r.u64()
+        seqs = tuple((r.u64(), r.u64()) for _ in range(r.u64()))
+        return NeedPartial(version, seqs)
+    if tag == 2:
+        return NeedEmpty(r.opt(lambda: Timestamp(r.u64())))
+    raise ValueError(f"unknown SyncNeedV1 tag {tag}")
+
+
+def encode_sync_msg(msg) -> bytes:
+    """msg: SyncState | ChangeV1 | Timestamp | SyncRejection | request list."""
+    w = Writer()
+    w.u32(0)  # SyncMessage::V1
+    if isinstance(msg, SyncState):
+        w.u32(_SYNC_STATE)
+        _write_sync_state(w, msg)
+    elif isinstance(msg, ChangeV1):
+        w.u32(_SYNC_CHANGESET)
+        write_change_v1(w, msg)
+    elif isinstance(msg, Timestamp):
+        w.u32(_SYNC_CLOCK)
+        w.u64(msg.ntp64)
+    elif isinstance(msg, SyncRejection):
+        w.u32(_SYNC_REJECTION)
+        w.u32(msg.reason)
+    elif isinstance(msg, list):  # SyncRequestV1
+        w.u32(_SYNC_REQUEST)
+        w.u32(len(msg))
+        for aid, needs in msg:
+            w.raw(aid.bytes16)
+            w.u32(len(needs))
+            for n in needs:
+                _write_need(w, n)
+    else:
+        raise TypeError(f"not a sync message: {msg!r}")
+    return w.bytes()
+
+
+def decode_sync_msg(data: bytes):
+    r = Reader(data)
+    if r.u32() != 0:
+        raise ValueError("unknown SyncMessage version")
+    tag = r.u32()
+    if tag == _SYNC_STATE:
+        return _read_sync_state(r)
+    if tag == _SYNC_CHANGESET:
+        return read_change_v1(r)
+    if tag == _SYNC_CLOCK:
+        return Timestamp(r.u64())
+    if tag == _SYNC_REJECTION:
+        return SyncRejection(r.u32())
+    if tag == _SYNC_REQUEST:
+        out = []
+        for _ in range(r.u32()):
+            aid = ActorId(r.raw(16))
+            needs = [_read_need(r) for _ in range(r.u32())]
+            out.append((aid, needs))
+        return out
+    raise ValueError(f"unknown SyncMessageV1 tag {tag}")
+
+
+# -- length-delimited framing (u32 BE, tokio LengthDelimitedCodec default) --
+
+
+def frame(payload: bytes) -> bytes:
+    if len(payload) > MAX_FRAME:
+        raise ValueError("frame too large")
+    return struct.pack(">I", len(payload)) + payload
+
+
+def deframe(buf: bytes, pos: int = 0) -> Tuple[Optional[bytes], int]:
+    """Try to read one frame at `pos`; returns (payload|None, new_pos)."""
+    if len(buf) - pos < 4:
+        return None, pos
+    (n,) = struct.unpack_from(">I", buf, pos)
+    if n > MAX_FRAME:
+        raise ValueError("frame too large")
+    if len(buf) - pos - 4 < n:
+        return None, pos
+    return bytes(buf[pos + 4 : pos + 4 + n]), pos + 4 + n

@@ -1,0 +1,120 @@
+"""Compact binary primary-key encoding, byte-compatible with cr-sqlite.
+
+Format (reference `klukai-types/src/pubsub.rs:2257-2410`):
+    [num_columns:u8, ...per value: (intlen<<3 | type):u8,
+                     big-endian signed int of `intlen` bytes (int value or
+                     text/blob length), then raw bytes for text/blob]
+Floats are always 8 big-endian IEEE bytes with intlen 0. NULL has no payload.
+Type tags are the ColumnType values in `values.py` (Integer=1, Float=2,
+Text=3, Blob=4, Null=5).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Sequence
+
+from corrosion_tpu.types.values import (
+    SqliteValue,
+    TYPE_BLOB,
+    TYPE_INTEGER,
+    TYPE_NULL,
+    TYPE_REAL,
+    TYPE_TEXT,
+    value_type,
+)
+
+
+def _num_bytes_needed(val: int) -> int:
+    """Bytes needed for a big-endian signed int, matching the reference's
+    byte-mask probing (pubsub.rs:2315-2340). Note the reference checks raw
+    byte occupancy of the two's-complement u64 pattern, so negatives always
+    take 8 bytes and 0 takes 0 bytes."""
+    u = val & 0xFFFFFFFFFFFFFFFF
+    for n in range(8, 0, -1):
+        if u >> ((n - 1) * 8) & 0xFF:
+            return n
+    return 0
+
+
+def _put_int(buf: bytearray, val: int, nbytes: int) -> None:
+    u = val & 0xFFFFFFFFFFFFFFFF
+    buf += u.to_bytes(8, "big")[8 - nbytes :] if nbytes else b""
+
+
+def _get_int(data: memoryview, pos: int, nbytes: int) -> int:
+    if nbytes == 0:
+        return 0
+    raw = bytes(data[pos : pos + nbytes])
+    val = int.from_bytes(raw, "big", signed=True)
+    return val
+
+
+def pack_columns(values: Sequence[SqliteValue]) -> bytes:
+    if len(values) > 0xFF:
+        raise ValueError("too many columns to pack")
+    buf = bytearray([len(values)])
+    for v in values:
+        t = value_type(v)
+        if t == TYPE_NULL:
+            buf.append(TYPE_NULL)
+        elif t == TYPE_INTEGER:
+            v = int(v)
+            n = _num_bytes_needed(v)
+            buf.append((n << 3) | TYPE_INTEGER)
+            _put_int(buf, v, n)
+        elif t == TYPE_REAL:
+            buf.append(TYPE_REAL)
+            buf += struct.pack(">d", v)
+        elif t == TYPE_TEXT:
+            raw = v.encode("utf-8")
+            n = _num_bytes_needed(len(raw)) if raw else 0
+            buf.append((n << 3) | TYPE_TEXT)
+            _put_int(buf, len(raw), n)
+            buf += raw
+        else:  # blob
+            raw = bytes(v)
+            n = _num_bytes_needed(len(raw)) if raw else 0
+            buf.append((n << 3) | TYPE_BLOB)
+            _put_int(buf, len(raw), n)
+            buf += raw
+    return bytes(buf)
+
+
+def unpack_columns(data: bytes) -> List[SqliteValue]:
+    mv = memoryview(data)
+    if not mv:
+        raise ValueError("empty pk buffer")
+    n = mv[0]
+    pos = 1
+    out: List[SqliteValue] = []
+    for _ in range(n):
+        if pos >= len(mv):
+            raise ValueError("truncated pk buffer")
+        tb = mv[pos]
+        pos += 1
+        t = tb & 0x07
+        intlen = tb >> 3
+        if t == TYPE_NULL:
+            out.append(None)
+        elif t == TYPE_INTEGER:
+            out.append(_get_int(mv, pos, intlen))
+            pos += intlen
+        elif t == TYPE_REAL:
+            out.append(struct.unpack(">d", mv[pos : pos + 8])[0])
+            pos += 8
+        elif t == TYPE_TEXT:
+            ln = _get_int(mv, pos, intlen)
+            pos += intlen
+            out.append(bytes(mv[pos : pos + ln]).decode("utf-8"))
+            pos += ln
+        elif t == TYPE_BLOB:
+            ln = _get_int(mv, pos, intlen)
+            pos += intlen
+            out.append(bytes(mv[pos : pos + ln]))
+            pos += ln
+        else:
+            raise ValueError(f"bad column type tag {t}")
+    if pos != len(mv):
+        raise ValueError("trailing bytes in pk buffer")
+    return out

@@ -1,0 +1,21 @@
+"""Test config: force an 8-device virtual CPU mesh before jax initializes.
+
+Multi-chip TPU hardware isn't available in CI; sharding tests run on a
+virtual CPU mesh exactly like the driver's dryrun (see __graft_entry__.py).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng_seed():
+    return 42

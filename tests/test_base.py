@@ -1,0 +1,55 @@
+"""HLC, Timestamp, Actor identity."""
+
+import time
+
+from corrosion_tpu.types.actor import Actor, ActorId, ClusterId
+from corrosion_tpu.types.base import HLClock, Timestamp
+
+
+def test_timestamp_roundtrip():
+    ts = Timestamp.from_unix(1700000000.5)
+    assert ts.secs == 1700000000
+    assert abs(ts.to_unix() - 1700000000.5) < 1e-6
+    assert not ts.is_zero()
+    assert Timestamp.zero().is_zero()
+
+
+def test_timestamp_ordering():
+    a = Timestamp.from_unix(100.0)
+    b = Timestamp.from_unix(100.5)
+    assert a < b
+
+
+def test_hlc_monotonic():
+    clk = HLClock()
+    prev = clk.new_timestamp()
+    for _ in range(100):
+        cur = clk.new_timestamp()
+        assert cur.ntp64 > prev.ntp64
+        prev = cur
+
+
+def test_hlc_update_with_peer():
+    clk = HLClock(max_delta_ms=300)
+    peer = Timestamp.from_unix(time.time() + 0.1)
+    assert clk.update_with_timestamp(peer)
+    assert clk.new_timestamp().ntp64 > peer.ntp64
+    # too far in the future → rejected
+    far = Timestamp.from_unix(time.time() + 10.0)
+    assert not clk.update_with_timestamp(far)
+
+
+def test_actor_renew_and_conflict():
+    a = Actor(id=ActorId.new_random(), addr="127.0.0.1:1234", ts=Timestamp.now())
+    time.sleep(0.01)
+    renewed = a.renew()
+    assert renewed.bump == a.bump + 1
+    assert renewed.wins_addr_conflict(a)
+    assert renewed.id == a.id
+
+
+def test_actor_id():
+    aid = ActorId.new_random()
+    assert ActorId.from_uuid_str(str(aid)) == aid
+    assert len(aid.short()) == 8
+    assert ClusterId(65535).value == 65535

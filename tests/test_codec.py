@@ -1,0 +1,198 @@
+"""Wire codec roundtrips for all peer payloads.
+
+Layouts mirror the reference's speedy encodings (see codec.py docstring);
+roundtrip + structural fixtures here, cross-impl byte fixtures would need a
+Rust toolchain (absent) so we lock the layout with golden bytes instead.
+"""
+
+import pytest
+
+from corrosion_tpu.types.actor import ActorId, ClusterId
+from corrosion_tpu.types.base import Timestamp
+from corrosion_tpu.types.change import (
+    Change,
+    ChangeV1,
+    ChangesetEmpty,
+    ChangesetEmptySet,
+    ChangesetFull,
+)
+from corrosion_tpu.types.codec import (
+    NeedEmpty,
+    NeedFull,
+    NeedPartial,
+    SyncRejection,
+    SyncState,
+    decode_bi_payload,
+    decode_sync_msg,
+    decode_uni_payload,
+    deframe,
+    encode_bi_payload_sync_start,
+    encode_sync_msg,
+    encode_uni_payload,
+    frame,
+    SyncTraceContext,
+)
+
+
+def mk_change(**kw):
+    base = dict(
+        table="tests",
+        pk=b"\x01\x09\x01",
+        cid="text",
+        val="hello",
+        col_version=1,
+        db_version=7,
+        seq=0,
+        site_id=b"\x11" * 16,
+        cl=1,
+    )
+    base.update(kw)
+    return Change(**base)
+
+
+def test_uni_payload_roundtrip():
+    cv = ChangeV1(
+        actor_id=ActorId(b"\x22" * 16),
+        changeset=ChangesetFull(
+            version=7,
+            changes=(mk_change(), mk_change(cid="num", val=42, seq=1)),
+            seqs=(0, 1),
+            last_seq=1,
+            ts=Timestamp(123456789),
+        ),
+    )
+    data = encode_uni_payload(cv, ClusterId(3))
+    out, cluster = decode_uni_payload(data)
+    assert cluster == ClusterId(3)
+    assert out == cv
+
+
+def test_uni_payload_default_on_eof_cluster_id():
+    cv = ChangeV1(
+        actor_id=ActorId(b"\x22" * 16),
+        changeset=ChangesetEmpty(versions=(1, 5), ts=None),
+    )
+    data = encode_uni_payload(cv, ClusterId(0))
+    # strip trailing u16 cluster id; decoder must default it (speedy
+    # #[speedy(default_on_eof)])
+    out, cluster = decode_uni_payload(data[:-2])
+    assert cluster == ClusterId(0)
+    assert out == cv
+
+
+def test_changeset_variants_roundtrip():
+    for cs in [
+        ChangesetEmpty(versions=(2, 9), ts=Timestamp(5)),
+        ChangesetEmpty(versions=(2, 9), ts=None),
+        ChangesetEmptySet(versions=((1, 2), (5, 5)), ts=Timestamp(9)),
+        ChangesetFull(
+            version=1,
+            changes=(mk_change(val=None), mk_change(val=2.5), mk_change(val=b"\x00")),
+            seqs=(0, 2),
+            last_seq=10,
+            ts=Timestamp(1),
+        ),
+    ]:
+        cv = ChangeV1(actor_id=ActorId(b"\x01" * 16), changeset=cs)
+        out, _ = decode_uni_payload(encode_uni_payload(cv))
+        assert out == cv
+
+
+def test_bi_payload_roundtrip():
+    aid = ActorId.new_random()
+    data = encode_bi_payload_sync_start(
+        aid, SyncTraceContext(traceparent="00-abc-def-01"), ClusterId(1)
+    )
+    out_aid, trace, cluster = decode_bi_payload(data)
+    assert out_aid == aid
+    assert trace.traceparent == "00-abc-def-01"
+    assert trace.tracestate is None
+    assert cluster == ClusterId(1)
+
+
+def test_sync_state_roundtrip():
+    a1, a2 = ActorId(b"\x01" * 16), ActorId(b"\x02" * 16)
+    st = SyncState(
+        actor_id=a1,
+        heads={a1: 10, a2: 20},
+        need={a2: [(1, 3), (7, 7)]},
+        partial_need={a2: {9: [(0, 4), (6, 6)]}},
+        last_cleared_ts=Timestamp(77),
+    )
+    out = decode_sync_msg(encode_sync_msg(st))
+    assert out.actor_id == a1
+    assert out.heads == st.heads
+    assert out.need == st.need
+    assert out.partial_need == st.partial_need
+    assert out.last_cleared_ts == st.last_cleared_ts
+
+
+def test_sync_msg_variants():
+    cv = ChangeV1(
+        actor_id=ActorId(b"\x03" * 16),
+        changeset=ChangesetEmpty(versions=(1, 1), ts=None),
+    )
+    assert decode_sync_msg(encode_sync_msg(cv)) == cv
+    assert decode_sync_msg(encode_sync_msg(Timestamp(42))) == Timestamp(42)
+    rej = SyncRejection(SyncRejection.DIFFERENT_CLUSTER)
+    assert decode_sync_msg(encode_sync_msg(rej)) == rej
+    req = [
+        (
+            ActorId(b"\x04" * 16),
+            [
+                NeedFull((1, 5)),
+                NeedPartial(version=7, seqs=((0, 2), (5, 9))),
+                NeedEmpty(ts=Timestamp(3)),
+                NeedEmpty(ts=None),
+            ],
+        )
+    ]
+    assert decode_sync_msg(encode_sync_msg(req)) == req
+
+
+def test_golden_bytes_empty_changeset():
+    # Locks the layout: UniPayload tags (3×u32 LE zeros), actor uuid,
+    # Changeset::Empty tag u8=0, start/end u64 LE, Option ts u8=0, cluster u16.
+    cv = ChangeV1(
+        actor_id=ActorId(b"\xaa" * 16),
+        changeset=ChangesetEmpty(versions=(1, 2), ts=None),
+    )
+    data = encode_uni_payload(cv, ClusterId(0))
+    expect = (
+        b"\x00\x00\x00\x00" * 3
+        + b"\xaa" * 16
+        + b"\x00"
+        + (1).to_bytes(8, "little")
+        + (2).to_bytes(8, "little")
+        + b"\x00"
+        + b"\x00\x00"
+    )
+    assert data == expect
+
+
+def test_framing():
+    p = b"hello world"
+    buf = frame(p) + frame(b"")
+    got1, pos = deframe(buf)
+    assert got1 == p
+    got2, pos = deframe(buf, pos)
+    assert got2 == b""
+    got3, pos2 = deframe(buf, pos)
+    assert got3 is None and pos2 == pos
+
+
+def test_framing_partial():
+    p = frame(b"abcdef")
+    got, pos = deframe(p[:5])
+    assert got is None
+
+
+def test_change_estimated_size():
+    c = mk_change()
+    assert c.estimated_byte_size() > 0
+
+
+@pytest.mark.parametrize("bad", [b"", b"\x01\x00\x00\x00"])
+def test_decode_garbage_raises(bad):
+    with pytest.raises(Exception):
+        decode_uni_payload(bad)

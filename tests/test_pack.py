@@ -1,0 +1,93 @@
+"""pack_columns/unpack_columns roundtrips + byte fixtures.
+
+Fixture bytes are derived from the format spec in the reference
+(`klukai-types/src/pubsub.rs:2257-2340`): [n:u8, (intlen<<3|type):u8, ...].
+"""
+
+import math
+
+import pytest
+
+from corrosion_tpu.types.pack import pack_columns, unpack_columns
+
+
+@pytest.mark.parametrize(
+    "values",
+    [
+        [],
+        [None],
+        [0],
+        [1],
+        [-1],
+        [127],
+        [256],
+        [2**40 + 7],
+        [-(2**62)],
+        [1.5],
+        [-0.0],
+        [""],
+        ["hello"],
+        ["héllo wörld"],
+        [b""],
+        [b"\x00\x01\x02"],
+        [None, 42, 2.5, "text", b"blob"],
+        ["a" * 300],  # 2-byte length
+        [b"x" * 70000],  # 3-byte length
+    ],
+)
+def test_roundtrip(values):
+    packed = pack_columns(values)
+    out = unpack_columns(packed)
+    assert len(out) == len(values)
+    for a, b in zip(values, out):
+        if isinstance(a, float):
+            assert math.isclose(a, b) or (a == 0 and b == 0)
+        else:
+            assert a == b
+
+
+def test_fixture_bytes():
+    # single integer 1: [1, (1<<3)|1=0x09, 0x01]
+    assert pack_columns([1]) == bytes([1, 0x09, 0x01])
+    # single NULL: [1, 5]
+    assert pack_columns([None]) == bytes([1, 5])
+    # integer 0 packs with zero bytes: [1, 0x01]
+    assert pack_columns([0]) == bytes([1, 0x01])
+    # negative ints always take 8 bytes (two's-complement occupancy)
+    assert pack_columns([-1]) == bytes([1, (8 << 3) | 1]) + b"\xff" * 8
+    # text "ab": [1, (1<<3)|3, 2, 'a', 'b']
+    assert pack_columns(["ab"]) == bytes([1, 0x0B, 2]) + b"ab"
+    # real 1.0: big-endian IEEE754
+    import struct
+
+    assert pack_columns([1.0]) == bytes([1, 2]) + struct.pack(">d", 1.0)
+
+
+def test_reference_sign_extension_quirk():
+    # The reference writer (pubsub.rs:2315-2340) packs 128..=255 into one
+    # byte but its reader (bytes::Buf::get_int) sign-extends, so 255
+    # canonically decodes to -1. We reproduce this exactly for wire parity;
+    # stores must treat packed pk bytes as the opaque row identity.
+    assert unpack_columns(pack_columns([255])) == [-1]
+    assert unpack_columns(pack_columns([0x80])) == [-128]
+    # the 9th bit makes it unambiguous again
+    assert unpack_columns(pack_columns([256])) == [256]
+
+
+def test_ordering_is_stable():
+    # pk encodings must be comparable as raw bytes for dedupe maps
+    a = pack_columns([1, "x"])
+    b = pack_columns([1, "x"])
+    assert a == b
+
+
+def test_empty_text_zero_intlen():
+    assert pack_columns([""]) == bytes([1, 3])
+    assert unpack_columns(bytes([1, 3])) == [""]
+
+
+def test_truncated_raises():
+    with pytest.raises(ValueError):
+        unpack_columns(bytes([2, 0x09, 0x01]))  # claims 2 cols, has 1
+    with pytest.raises(ValueError):
+        unpack_columns(b"")

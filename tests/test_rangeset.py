@@ -1,0 +1,90 @@
+"""RangeSet: coalescing, removal splitting, gaps, overlapping.
+
+Mirrors the reference's reliance on rangemap::RangeInclusiveSet semantics
+(adjacent integer ranges coalesce) in `sync.rs:126-248` and
+`agent.rs:1181-1246`.
+"""
+
+import random
+
+from corrosion_tpu.types.rangeset import RangeSet
+
+
+def test_insert_coalesces_adjacent():
+    rs = RangeSet()
+    rs.insert(1, 2)
+    rs.insert(3, 4)
+    assert list(rs) == [(1, 4)]
+    rs.insert(10, 12)
+    assert list(rs) == [(1, 4), (10, 12)]
+    rs.insert(5, 9)
+    assert list(rs) == [(1, 12)]
+
+
+def test_insert_overlap_merge():
+    rs = RangeSet([(1, 5), (8, 10)])
+    rs.insert(4, 9)
+    assert list(rs) == [(1, 10)]
+
+
+def test_remove_splits():
+    rs = RangeSet([(1, 10)])
+    rs.remove(4, 6)
+    assert list(rs) == [(1, 3), (7, 10)]
+    rs.remove(1, 3)
+    assert list(rs) == [(7, 10)]
+    rs.remove(9, 20)
+    assert list(rs) == [(7, 8)]
+
+
+def test_contains():
+    rs = RangeSet([(5, 7), (10, 10)])
+    assert rs.contains(5) and rs.contains(7) and rs.contains(10)
+    assert not rs.contains(4) and not rs.contains(8) and not rs.contains(11)
+    assert rs.contains_range(5, 7)
+    assert not rs.contains_range(5, 10)
+
+
+def test_gaps():
+    rs = RangeSet([(3, 4), (8, 9)])
+    assert list(rs.gaps(1, 12)) == [(1, 2), (5, 7), (10, 12)]
+    assert list(rs.gaps(3, 9)) == [(5, 7)]
+    assert list(RangeSet().gaps(1, 3)) == [(1, 3)]
+
+
+def test_overlapping():
+    rs = RangeSet([(1, 3), (5, 8), (12, 14)])
+    assert list(rs.overlapping(2, 6)) == [(1, 3), (5, 8)]
+    assert list(rs.overlapping(9, 11)) == []
+
+
+def test_difference_union():
+    a = RangeSet([(1, 10)])
+    b = RangeSet([(3, 4), (8, 12)])
+    assert list(a.difference(b)) == [(1, 2), (5, 7)]
+    assert list(a.union(b)) == [(1, 12)]
+
+
+def test_randomized_against_set_model():
+    rnd = random.Random(1234)
+    rs = RangeSet()
+    model = set()
+    for _ in range(500):
+        s = rnd.randint(0, 100)
+        e = s + rnd.randint(0, 10)
+        if rnd.random() < 0.6:
+            rs.insert(s, e)
+            model |= set(range(s, e + 1))
+        else:
+            rs.remove(s, e)
+            model -= set(range(s, e + 1))
+        # full equivalence on values
+        vals = {v for st, en in rs for v in range(st, en + 1)}
+        assert vals == model
+        # disjoint + sorted + coalesced invariants
+        prev_end = None
+        for st, en in rs:
+            assert st <= en
+            if prev_end is not None:
+                assert st > prev_end + 1
+            prev_end = en
