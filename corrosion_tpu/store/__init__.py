@@ -1,0 +1,17 @@
+"""CRDT storage: schema engine, sqlite-backed store, version bookkeeping."""
+
+from corrosion_tpu.store.bookkeeping import (
+    PartialVersion,
+    BookedVersions,
+    VersionsSnapshot,
+    Booked,
+    Bookie,
+)
+
+__all__ = [
+    "PartialVersion",
+    "BookedVersions",
+    "VersionsSnapshot",
+    "Booked",
+    "Bookie",
+]

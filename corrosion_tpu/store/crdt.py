@@ -1,0 +1,892 @@
+"""CrdtStore: the cr-sqlite replacement — SQLite storage + CRDT clocks.
+
+The reference embeds the cr-sqlite C extension (loaded in
+`klukai-types/src/sqlite.rs:125-143`) to make tables conflict-free. This
+module reimplements its observable behavior natively on stdlib sqlite3:
+
+  - per-table clock tables  `<t>__crdt_clock(pk, cid, col_version,
+    db_version, seq, site_id, ts)` — one row per (row, column) cell holding
+    the latest write's clock; the sentinel cid "-1" row tracks row
+    create/delete
+  - per-table row tables    `<t>__crdt_rows(pk, cl)` — causal length per
+    row (odd = alive, even = deleted)
+  - change capture for local writes via generated AFTER INSERT/UPDATE/DELETE
+    triggers (gated on `__crdt_ctx.enabled` so remote applies don't re-log),
+    with pks packed by a registered Python function in the cr-sqlite pk
+    format
+  - merge semantics for remote changes (column-level LWW): higher causal
+    length wins the row; at equal (odd) cl, higher col_version wins the
+    cell; at equal col_version the larger value wins, equal values merge
+    silently (the reference sets `crsql_config_set('merge-equal-values',1)`,
+    agent.rs:361)
+  - db_version/seq assignment: every local commit takes the next db_version;
+    its changed cells are sequenced 0..=last_seq (change.rs:188-258)
+
+Observable-parity features: `changes_for_version` reconstructs
+`crsql_changes` rows (current values only — overwritten versions become
+"cleared", the basis of Changeset::Empty/EmptySet in sync), and
+`rows_impacted`-style filtering marks which applied changes were impactful
+(`agent/util.rs:1206-1310`).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+from corrosion_tpu.store.bookkeeping import (
+    BookedVersions,
+    GapStore,
+    PartialVersion,
+)
+from corrosion_tpu.store.schema import Schema, SchemaError, diff_schemas, parse_sql
+from corrosion_tpu.types.actor import ActorId
+from corrosion_tpu.types.base import Timestamp
+from corrosion_tpu.types.change import Change, SENTINEL
+from corrosion_tpu.types.pack import pack_columns, unpack_columns
+from corrosion_tpu.types.rangeset import RangeSet
+from corrosion_tpu.types.values import SqliteValue, cmp_values
+
+
+class ChangeApplyError(Exception):
+    pass
+
+
+# -- internal tables -------------------------------------------------------
+
+_BOOTSTRAP = """
+CREATE TABLE IF NOT EXISTS __crdt_ctx (id INTEGER PRIMARY KEY CHECK (id = 1),
+    capture INTEGER NOT NULL DEFAULT 1);
+INSERT OR IGNORE INTO __crdt_ctx (id, capture) VALUES (1, 1);
+
+CREATE TABLE IF NOT EXISTS __crdt_pending (
+    rowseq INTEGER PRIMARY KEY AUTOINCREMENT,
+    tbl TEXT NOT NULL, pk BLOB NOT NULL, cid TEXT NOT NULL, val ANY
+);
+
+CREATE TABLE IF NOT EXISTS __crdt_site (id INTEGER PRIMARY KEY CHECK (id = 1),
+    site_id BLOB NOT NULL);
+
+CREATE TABLE IF NOT EXISTS __crdt_db_versions (
+    site_id BLOB PRIMARY KEY, db_version INTEGER NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS __corro_bookkeeping_gaps (
+    actor_id BLOB NOT NULL, start INTEGER NOT NULL, end INTEGER NOT NULL,
+    PRIMARY KEY (actor_id, start)
+);
+
+CREATE TABLE IF NOT EXISTS __corro_seq_bookkeeping (
+    site_id BLOB NOT NULL, db_version INTEGER NOT NULL,
+    start_seq INTEGER NOT NULL, end_seq INTEGER NOT NULL,
+    last_seq INTEGER NOT NULL, ts INTEGER NOT NULL,
+    PRIMARY KEY (site_id, db_version, start_seq)
+);
+
+CREATE TABLE IF NOT EXISTS __corro_buffered_changes (
+    site_id BLOB NOT NULL, db_version INTEGER NOT NULL, seq INTEGER NOT NULL,
+    tbl TEXT NOT NULL, pk BLOB NOT NULL, cid TEXT NOT NULL, val ANY,
+    col_version INTEGER NOT NULL, cl INTEGER NOT NULL,
+    last_seq INTEGER NOT NULL, ts INTEGER NOT NULL,
+    PRIMARY KEY (site_id, db_version, seq)
+);
+
+CREATE TABLE IF NOT EXISTS __corro_schema (
+    tbl_name TEXT NOT NULL, type TEXT NOT NULL, name TEXT NOT NULL,
+    sql TEXT NOT NULL, source TEXT NOT NULL,
+    PRIMARY KEY (tbl_name, type, name)
+);
+
+CREATE TABLE IF NOT EXISTS __corro_members (
+    actor_id BLOB PRIMARY KEY, address TEXT NOT NULL,
+    foca_state TEXT, rtt_min REAL, updated_at INTEGER NOT NULL DEFAULT 0
+);
+
+CREATE TABLE IF NOT EXISTS __corro_state (key TEXT PRIMARY KEY, value ANY);
+"""
+
+
+def _clock_table(t: str) -> str:
+    return f"{t}__crdt_clock"
+
+
+def _rows_table(t: str) -> str:
+    return f"{t}__crdt_rows"
+
+
+@dataclass
+class AppliedChanges:
+    """Result of applying a remote changeset portion."""
+
+    impactful: List[Change]
+    changed_tables: Dict[str, int]
+
+
+class CrdtStore:
+    """One node's database: user tables + CRDT clocks + bookkeeping.
+
+    Thread model: a single writer at a time (enforced with an RLock, the
+    SplitPool equivalent provides queuing above this); readers may use
+    `read_conn()` snapshots on other threads (WAL mode).
+    """
+
+    _mem_counter = 0
+
+    def __init__(self, path: str, site_id: Optional[ActorId] = None):
+        if path == ":memory:":
+            # shared-cache URI so read_conn() can open real extra
+            # connections to the same in-memory database
+            CrdtStore._mem_counter += 1
+            path = f"file:crdtmem{id(self)}_{CrdtStore._mem_counter}?mode=memory&cache=shared"
+        self.path = path
+        self._conn = sqlite3.connect(
+            path, check_same_thread=False, isolation_level=None, uri=True
+        )
+        self._conn.row_factory = sqlite3.Row
+        self._lock = threading.RLock()
+        self._setup_conn(self._conn)
+        with self._lock:
+            self._conn.executescript(_BOOTSTRAP)
+            row = self._conn.execute("SELECT site_id FROM __crdt_site").fetchone()
+            if row is None:
+                sid = site_id or ActorId.new_random()
+                self._conn.execute(
+                    "INSERT INTO __crdt_site (id, site_id) VALUES (1, ?)",
+                    (sid.bytes16,),
+                )
+            else:
+                sid = ActorId(bytes(row["site_id"]))
+                if site_id is not None and site_id != sid:
+                    raise ChangeApplyError(
+                        f"db already has site id {sid}, asked for {site_id}"
+                    )
+        self.site_id: ActorId = sid
+        self.schema: Schema = Schema()
+        self._load_schema()
+
+    # -- connection setup --------------------------------------------------
+
+    @property
+    def _is_memory(self) -> bool:
+        return "mode=memory" in self.path
+
+    def _setup_conn(self, conn: sqlite3.Connection) -> None:
+        if not self._is_memory:
+            conn.execute("PRAGMA journal_mode = WAL")
+        conn.execute("PRAGMA synchronous = NORMAL")
+        conn.execute("PRAGMA foreign_keys = OFF")
+        conn.execute("PRAGMA recursive_triggers = OFF")
+        conn.create_function("crdt_pack", -1, _sql_pack, deterministic=True)
+
+    def read_conn(self) -> sqlite3.Connection:
+        """A new read connection (WAL snapshot isolation for file stores,
+        shared cache for in-memory). Caller closes."""
+        conn = sqlite3.connect(self.path, check_same_thread=False, uri=True)
+        conn.row_factory = sqlite3.Row
+        conn.execute("PRAGMA query_only = ON")
+        return conn
+
+    def close(self) -> None:
+        self._conn.close()
+
+    # -- schema ------------------------------------------------------------
+
+    def _load_schema(self) -> None:
+        rows = self._conn.execute(
+            "SELECT sql FROM __corro_schema WHERE type IN ('table','index')"
+            " ORDER BY rowid"
+        ).fetchall()
+        if rows:
+            self.schema = parse_sql("\n".join(r["sql"] + ";" for r in rows))
+
+    def apply_schema_sql(self, sql: str) -> Schema:
+        """Parse + diff + apply new schema DDL (the `/v1/migrations` path,
+        reference `api/public/mod.rs:560-667` → `schema.rs:285`)."""
+        new_schema = parse_sql(sql)
+        diff = diff_schemas(self.schema, new_schema)
+        with self._lock:
+            self._conn.execute("BEGIN IMMEDIATE")
+            try:
+                for t in diff.new_tables:
+                    self._conn.execute(t.raw_sql)
+                    self._create_crr_machinery(t)
+                    for idx in t.indexes.values():
+                        self._conn.execute(idx.raw_sql)
+                for tname, col, decl in diff.new_columns:
+                    self._conn.execute(f'ALTER TABLE "{tname}" ADD COLUMN {decl}')
+                    # regenerate triggers to include the new column
+                    t = new_schema.tables[tname]
+                    self._drop_triggers(tname)
+                    self._create_triggers(t)
+                for iname in diff.dropped_indexes:
+                    self._conn.execute(f'DROP INDEX IF EXISTS "{iname}"')
+                for idx in diff.changed_indexes:
+                    self._conn.execute(f'DROP INDEX IF EXISTS "{idx.name}"')
+                    self._conn.execute(idx.raw_sql)
+                for idx in diff.new_indexes:
+                    self._conn.execute(idx.raw_sql)
+                # persist schema source
+                self._conn.execute("DELETE FROM __corro_schema")
+                for t in new_schema.tables.values():
+                    self._conn.execute(
+                        "INSERT INTO __corro_schema VALUES (?,?,?,?,?)",
+                        (t.name, "table", t.name, t.raw_sql, "api"),
+                    )
+                    for idx in t.indexes.values():
+                        self._conn.execute(
+                            "INSERT INTO __corro_schema VALUES (?,?,?,?,?)",
+                            (t.name, "index", idx.name, idx.raw_sql, "api"),
+                        )
+                self._conn.execute("COMMIT")
+            except BaseException:
+                self._conn.execute("ROLLBACK")
+                raise
+        self.schema = new_schema
+        return new_schema
+
+    def _create_crr_machinery(self, t) -> None:
+        ct, rt = _clock_table(t.name), _rows_table(t.name)
+        self._conn.execute(
+            f'CREATE TABLE IF NOT EXISTS "{ct}" ('
+            " pk BLOB NOT NULL, cid TEXT NOT NULL,"
+            " col_version INTEGER NOT NULL, db_version INTEGER NOT NULL,"
+            " seq INTEGER NOT NULL, site_id BLOB NOT NULL, ts INTEGER NOT NULL,"
+            " PRIMARY KEY (pk, cid))"
+        )
+        self._conn.execute(
+            f'CREATE INDEX IF NOT EXISTS "{ct}__site_version"'
+            f' ON "{ct}" (site_id, db_version)'
+        )
+        self._conn.execute(
+            f'CREATE TABLE IF NOT EXISTS "{rt}" ('
+            " pk BLOB PRIMARY KEY, cl INTEGER NOT NULL)"
+        )
+        self._create_triggers(t)
+
+    def _pk_pack_expr(self, t, prefix: str) -> str:
+        cols = ", ".join(f'{prefix}."{c}"' for c in t.pk_cols)
+        return f"crdt_pack({cols})"
+
+    def _create_triggers(self, t) -> None:
+        name = t.name
+        new_pk = self._pk_pack_expr(t, "NEW")
+        old_pk = self._pk_pack_expr(t, "OLD")
+        gate = "(SELECT capture FROM __crdt_ctx WHERE id = 1) = 1"
+        ins_cols = "".join(
+            f"INSERT INTO __crdt_pending (tbl, pk, cid, val)"
+            f" VALUES ('{name}', {new_pk}, '{c}', NEW.\"{c}\");\n"
+            for c in t.non_pk_cols
+        )
+        self._conn.execute(
+            f'CREATE TRIGGER "{name}__crdt_ins" AFTER INSERT ON "{name}"'
+            f" WHEN {gate} BEGIN\n"
+            f" INSERT INTO __crdt_pending (tbl, pk, cid, val)"
+            f" VALUES ('{name}', {new_pk}, '{SENTINEL}', NULL);\n"
+            f"{ins_cols} END"
+        )
+        # A pk change is modeled as delete(old row) + create(new row), the
+        # way cr-sqlite treats it — otherwise replicas diverge silently.
+        pk_changed = f"{new_pk} IS NOT {old_pk}"
+        upd_cols = "".join(
+            f"INSERT INTO __crdt_pending (tbl, pk, cid, val)"
+            f" SELECT '{name}', {new_pk}, '{c}', NEW.\"{c}\""
+            f' WHERE NEW."{c}" IS NOT OLD."{c}" OR {pk_changed};\n'
+            for c in t.non_pk_cols
+        )
+        self._conn.execute(
+            f'CREATE TRIGGER "{name}__crdt_upd" AFTER UPDATE ON "{name}"'
+            f" WHEN {gate} BEGIN\n"
+            f" INSERT INTO __crdt_pending (tbl, pk, cid, val)"
+            f" SELECT '{name}', {old_pk}, '{SENTINEL}X', NULL WHERE {pk_changed};\n"
+            f" INSERT INTO __crdt_pending (tbl, pk, cid, val)"
+            f" SELECT '{name}', {new_pk}, '{SENTINEL}', NULL WHERE {pk_changed};\n"
+            f"{upd_cols} END"
+        )
+        self._conn.execute(
+            f'CREATE TRIGGER "{name}__crdt_del" AFTER DELETE ON "{name}"'
+            f" WHEN {gate} BEGIN\n"
+            f" INSERT INTO __crdt_pending (tbl, pk, cid, val)"
+            f" VALUES ('{name}', {old_pk}, '{SENTINEL}X', NULL);\n END"
+        )
+
+    def _drop_triggers(self, name: str) -> None:
+        for suffix in ("ins", "upd", "del"):
+            self._conn.execute(f'DROP TRIGGER IF EXISTS "{name}__crdt_{suffix}"')
+
+    # -- db_version accounting --------------------------------------------
+
+    def db_version_for(self, site: ActorId) -> int:
+        row = self._conn.execute(
+            "SELECT db_version FROM __crdt_db_versions WHERE site_id = ?",
+            (site.bytes16,),
+        ).fetchone()
+        return row["db_version"] if row else 0
+
+    def _bump_db_version(self, site: ActorId, version: int) -> None:
+        self._conn.execute(
+            "INSERT INTO __crdt_db_versions (site_id, db_version) VALUES (?, ?)"
+            " ON CONFLICT (site_id) DO UPDATE SET db_version ="
+            " MAX(db_version, excluded.db_version)",
+            (site.bytes16, version),
+        )
+
+    # -- local writes ------------------------------------------------------
+
+    def write_tx(self, ts: Timestamp) -> "WriteTx":
+        """Begin a local write transaction capturing CRDT changes."""
+        return WriteTx(self, ts)
+
+    # -- serving changes (crsql_changes reads) ----------------------------
+
+    def changes_for_versions(
+        self,
+        site: ActorId,
+        start_version: int,
+        end_version: int,
+        conn: Optional[sqlite3.Connection] = None,
+    ) -> Iterator[Tuple[int, List[Change]]]:
+        """Yield (db_version, ordered changes) for every version in the
+        range that still has live clock rows from `site`, newest first
+        (the sync server scans db_version DESC, peer/mod.rs:620-700).
+        Overwritten versions yield nothing — callers emit EmptySet."""
+        c = conn or self._conn
+        per_version: Dict[int, List[Change]] = {}
+        for tname, t in self.schema.tables.items():
+            ct, rt = _clock_table(tname), _rows_table(tname)
+            rows = c.execute(
+                f'SELECT k.pk AS pk, k.cid AS cid, k.col_version AS col_version,'
+                f" k.db_version AS db_version, k.seq AS seq, k.ts AS ts,"
+                f' r.cl AS cl FROM "{ct}" k JOIN "{rt}" r ON r.pk = k.pk'
+                f" WHERE k.site_id = ? AND k.db_version BETWEEN ? AND ?",
+                (site.bytes16, start_version, end_version),
+            ).fetchall()
+            for row in rows:
+                val = None
+                cid = row["cid"]
+                if cid != SENTINEL:
+                    val = self._current_value(c, t, bytes(row["pk"]), cid)
+                ch = Change(
+                    table=tname,
+                    pk=bytes(row["pk"]),
+                    cid=cid,
+                    val=val,
+                    col_version=row["col_version"],
+                    db_version=row["db_version"],
+                    seq=row["seq"],
+                    site_id=site.bytes16,
+                    cl=row["cl"],
+                    ts=Timestamp(row["ts"]),
+                )
+                per_version.setdefault(row["db_version"], []).append(ch)
+        for v in sorted(per_version, reverse=True):
+            changes = per_version[v]
+            changes.sort(key=lambda ch: ch.seq)
+            yield v, changes
+
+    def last_seq_for_version(self, site: ActorId, version: int) -> Optional[int]:
+        """Max seq ever assigned in `version` (needed because later writes
+        can erase clock rows; tracked in __corro_state for local versions)."""
+        row = self._conn.execute(
+            "SELECT value FROM __corro_state WHERE key = ?",
+            (f"last_seq:{site}:{version}",),
+        ).fetchone()
+        return row["value"] if row else None
+
+    def record_last_seq(self, site: ActorId, version: int, last_seq: int) -> None:
+        self._conn.execute(
+            "INSERT OR REPLACE INTO __corro_state VALUES (?, ?)",
+            (f"last_seq:{site}:{version}", last_seq),
+        )
+
+    def _current_value(
+        self, conn: sqlite3.Connection, t, pk: bytes, cid: str
+    ) -> SqliteValue:
+        where = " AND ".join(f'"{c}" IS ?' for c in t.pk_cols)
+        row = conn.execute(
+            f'SELECT "{cid}" AS v FROM "{t.name}" WHERE {where}',
+            unpack_columns(pk),
+        ).fetchone()
+        return row["v"] if row is not None else None
+
+    # -- remote change application ----------------------------------------
+
+    def apply_changes(self, changes: Sequence[Change]) -> AppliedChanges:
+        """Apply remote CRDT changes inside one transaction; returns the
+        impactful subset (counterpart of `process_complete_version`,
+        util.rs:1206-1310, with crsql's merge rules)."""
+        impactful: List[Change] = []
+        changed_tables: Dict[str, int] = {}
+        with self._lock:
+            self._conn.execute("BEGIN IMMEDIATE")
+            self._conn.execute("UPDATE __crdt_ctx SET capture = 0 WHERE id = 1")
+            try:
+                for ch in changes:
+                    if self._apply_one(ch):
+                        impactful.append(ch)
+                        changed_tables[ch.table] = changed_tables.get(ch.table, 0) + 1
+                    self._bump_db_version(ActorId(ch.site_id), ch.db_version)
+                self._conn.execute("UPDATE __crdt_ctx SET capture = 1 WHERE id = 1")
+                self._conn.execute("COMMIT")
+            except BaseException:
+                self._conn.execute("ROLLBACK")
+                self._conn.execute("UPDATE __crdt_ctx SET capture = 1 WHERE id = 1")
+                raise
+        return AppliedChanges(impactful, changed_tables)
+
+    def _apply_one(self, ch: Change) -> bool:
+        t = self.schema.tables.get(ch.table)
+        if t is None:
+            # unknown table: drop silently like cr-sqlite (schema lag)
+            return False
+        if ch.cid != SENTINEL and ch.cid not in t.columns:
+            return False
+        conn = self._conn
+        rt, ct = _rows_table(ch.table), _clock_table(ch.table)
+        row = conn.execute(
+            f'SELECT cl FROM "{rt}" WHERE pk = ?', (ch.pk,)
+        ).fetchone()
+        local_cl = row["cl"] if row else 0
+
+        if ch.cl < local_cl:
+            return False  # stale causal length: row-level dominance
+        if ch.cl > local_cl:
+            if ch.cl % 2 == 0:
+                # delete wins: drop data row, clear column clocks
+                self._delete_row(t, ch.pk)
+                conn.execute(
+                    f'INSERT INTO "{rt}" (pk, cl) VALUES (?, ?)'
+                    " ON CONFLICT (pk) DO UPDATE SET cl = excluded.cl",
+                    (ch.pk, ch.cl),
+                )
+                conn.execute(f'DELETE FROM "{ct}" WHERE pk = ? AND cid != ?',
+                             (ch.pk, SENTINEL))
+                self._upsert_clock(ct, ch, cid=SENTINEL)
+                return True
+            # (re)create: fresh causal epoch — reset column clocks
+            conn.execute(
+                f'INSERT INTO "{rt}" (pk, cl) VALUES (?, ?)'
+                " ON CONFLICT (pk) DO UPDATE SET cl = excluded.cl",
+                (ch.pk, ch.cl),
+            )
+            conn.execute(
+                f'DELETE FROM "{ct}" WHERE pk = ? AND cid != ?', (ch.pk, SENTINEL)
+            )
+            self._ensure_data_row(t, ch.pk)
+            self._upsert_clock(ct, ch, cid=SENTINEL)
+            if ch.cid != SENTINEL:
+                self._set_cell(t, ch.pk, ch.cid, ch.val)
+                self._upsert_clock(ct, ch)
+            return True
+
+        # equal causal length
+        if local_cl % 2 == 0:
+            # both deleted; nothing to merge beyond clock freshness
+            return False
+        if ch.cid == SENTINEL:
+            return False  # sentinel at same cl: no-op
+        clock = conn.execute(
+            f'SELECT col_version FROM "{ct}" WHERE pk = ? AND cid = ?',
+            (ch.pk, ch.cid),
+        ).fetchone()
+        local_cv = clock["col_version"] if clock else 0
+        if ch.col_version < local_cv:
+            return False
+        if ch.col_version == local_cv:
+            if clock is None:
+                pass  # no local write at all → incoming wins
+            else:
+                cur = self._current_value(conn, t, ch.pk, ch.cid)
+                c = cmp_values(ch.val, cur)
+                if c <= 0:
+                    return False  # equal values merge; smaller loses
+        self._ensure_data_row(t, ch.pk)
+        self._set_cell(t, ch.pk, ch.cid, ch.val)
+        self._upsert_clock(ct, ch)
+        return True
+
+    def _upsert_clock(self, ct: str, ch: Change, cid: Optional[str] = None) -> None:
+        self._conn.execute(
+            f'INSERT INTO "{ct}" (pk, cid, col_version, db_version, seq,'
+            " site_id, ts) VALUES (?,?,?,?,?,?,?)"
+            " ON CONFLICT (pk, cid) DO UPDATE SET"
+            " col_version = excluded.col_version,"
+            " db_version = excluded.db_version, seq = excluded.seq,"
+            " site_id = excluded.site_id, ts = excluded.ts",
+            (
+                ch.pk,
+                cid if cid is not None else ch.cid,
+                ch.cl if cid is not None else ch.col_version,
+                ch.db_version,
+                ch.seq,
+                ch.site_id,
+                ch.ts.ntp64,
+            ),
+        )
+
+    def _ensure_data_row(self, t, pk: bytes) -> None:
+        pk_vals = unpack_columns(pk)
+        cols = ", ".join(f'"{c}"' for c in t.pk_cols)
+        marks = ", ".join("?" for _ in t.pk_cols)
+        self._conn.execute(
+            f'INSERT OR IGNORE INTO "{t.name}" ({cols}) VALUES ({marks})', pk_vals
+        )
+
+    def _set_cell(self, t, pk: bytes, cid: str, val: SqliteValue) -> None:
+        where = " AND ".join(f'"{c}" IS ?' for c in t.pk_cols)
+        self._conn.execute(
+            f'UPDATE "{t.name}" SET "{cid}" = ? WHERE {where}',
+            [val, *unpack_columns(pk)],
+        )
+
+    def _delete_row(self, t, pk: bytes) -> None:
+        where = " AND ".join(f'"{c}" IS ?' for c in t.pk_cols)
+        self._conn.execute(
+            f'DELETE FROM "{t.name}" WHERE {where}', unpack_columns(pk)
+        )
+
+    # -- buffered partial versions ----------------------------------------
+
+    def buffer_partial_changes(
+        self,
+        site: ActorId,
+        version: int,
+        changes: Sequence[Change],
+        seqs: Tuple[int, int],
+        last_seq: int,
+        ts: Timestamp,
+    ) -> None:
+        """Stash an incomplete version's chunk (process_incomplete_version,
+        util.rs:1070-1203)."""
+        with self._lock:
+            self._conn.execute("BEGIN IMMEDIATE")
+            try:
+                for ch in changes:
+                    self._conn.execute(
+                        "INSERT OR REPLACE INTO __corro_buffered_changes"
+                        " VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+                        (
+                            site.bytes16,
+                            version,
+                            ch.seq,
+                            ch.table,
+                            ch.pk,
+                            ch.cid,
+                            ch.val,
+                            ch.col_version,
+                            ch.cl,
+                            last_seq,
+                            ch.ts.ntp64,
+                        ),
+                    )
+                # merge the seq range bookkeeping
+                existing = self._conn.execute(
+                    "SELECT start_seq, end_seq FROM __corro_seq_bookkeeping"
+                    " WHERE site_id = ? AND db_version = ?",
+                    (site.bytes16, version),
+                ).fetchall()
+                rs = RangeSet([(r["start_seq"], r["end_seq"]) for r in existing])
+                rs.insert(seqs[0], seqs[1])
+                self._conn.execute(
+                    "DELETE FROM __corro_seq_bookkeeping"
+                    " WHERE site_id = ? AND db_version = ?",
+                    (site.bytes16, version),
+                )
+                for s, e in rs:
+                    self._conn.execute(
+                        "INSERT INTO __corro_seq_bookkeeping VALUES (?,?,?,?,?,?)",
+                        (site.bytes16, version, s, e, last_seq, ts.ntp64),
+                    )
+                self._conn.execute("COMMIT")
+            except BaseException:
+                self._conn.execute("ROLLBACK")
+                raise
+
+    def take_buffered_version(
+        self, site: ActorId, version: int
+    ) -> List[Change]:
+        """Drain a fully-buffered version into Change objects for apply
+        (process_fully_buffered_changes, util.rs:552-700)."""
+        rows = self._conn.execute(
+            "SELECT * FROM __corro_buffered_changes"
+            " WHERE site_id = ? AND db_version = ? ORDER BY seq",
+            (site.bytes16, version),
+        ).fetchall()
+        return [
+            Change(
+                table=r["tbl"],
+                pk=bytes(r["pk"]),
+                cid=r["cid"],
+                val=r["val"],
+                col_version=r["col_version"],
+                db_version=version,
+                seq=r["seq"],
+                site_id=site.bytes16,
+                cl=r["cl"],
+                ts=Timestamp(r["ts"]),
+            )
+            for r in rows
+        ]
+
+    def clear_buffered_version(self, site: ActorId, version: int) -> None:
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM __corro_buffered_changes"
+                " WHERE site_id = ? AND db_version = ?",
+                (site.bytes16, version),
+            )
+            self._conn.execute(
+                "DELETE FROM __corro_seq_bookkeeping"
+                " WHERE site_id = ? AND db_version = ?",
+                (site.bytes16, version),
+            )
+
+    # -- bookkeeping persistence (GapStore) -------------------------------
+
+    def gap_store(self) -> GapStore:
+        return _SqliteGapStore(self._conn)
+
+    def load_booked_versions(self, actor_id: ActorId) -> BookedVersions:
+        """Rebuild bookkeeping for an actor from durable state
+        (BookedVersions::from_conn, agent.rs:1293-1362)."""
+        bv = BookedVersions(actor_id)
+        bv.max = self.db_version_for(actor_id) or None
+        for r in self._conn.execute(
+            "SELECT db_version, start_seq, end_seq, last_seq, ts"
+            " FROM __corro_seq_bookkeeping WHERE site_id = ?",
+            (actor_id.bytes16,),
+        ):
+            bv.insert_partial(
+                r["db_version"],
+                PartialVersion(
+                    seqs=RangeSet([(r["start_seq"], r["end_seq"])]),
+                    last_seq=r["last_seq"],
+                    ts=Timestamp(r["ts"]),
+                ),
+            )
+        for r in self._conn.execute(
+            "SELECT start, end FROM __corro_bookkeeping_gaps WHERE actor_id = ?",
+            (actor_id.bytes16,),
+        ):
+            bv.needed.insert(r["start"], r["end"])
+        return bv
+
+    def booked_actor_ids(self) -> List[ActorId]:
+        """All sites we have any state for (bookie warm-up,
+        run_root.rs:136-197)."""
+        ids = {
+            bytes(r["site_id"])
+            for r in self._conn.execute("SELECT site_id FROM __crdt_db_versions")
+        }
+        ids |= {
+            bytes(r["site_id"])
+            for r in self._conn.execute(
+                "SELECT DISTINCT site_id FROM __corro_seq_bookkeeping"
+            )
+        }
+        ids |= {
+            bytes(r["actor_id"])
+            for r in self._conn.execute(
+                "SELECT DISTINCT actor_id FROM __corro_bookkeeping_gaps"
+            )
+        }
+        return [ActorId(b) for b in sorted(ids)]
+
+
+class WriteTx:
+    """A local write transaction; captures triggers' pending log and turns
+    it into broadcastable changes on commit (the `/v1/transactions` path:
+    make_broadcastable_changes + insert_local_changes,
+    `api/public/mod.rs:57-258`, change.rs:188)."""
+
+    def __init__(self, store: CrdtStore, ts: Timestamp):
+        self.store = store
+        self.ts = ts
+        self._done = False
+
+    def __enter__(self) -> "WriteTx":
+        self.store._lock.acquire()
+        self.conn = self.store._conn
+        self.conn.execute("BEGIN IMMEDIATE")
+        self.conn.execute("DELETE FROM __crdt_pending")
+        return self
+
+    def execute(self, sql: str, params: Sequence[SqliteValue] = ()) -> int:
+        cur = self.conn.execute(sql, tuple(params))
+        return cur.rowcount if cur.rowcount > 0 else 0
+
+    def commit(self) -> Tuple[List[Change], int, int]:
+        """Finalize: assign db_version/seqs, write clocks, return
+        (changes, db_version, last_seq). db_version == 0 → no changes."""
+        store = self.store
+        conn = self.conn
+        try:
+            pending = conn.execute(
+                "SELECT rowseq, tbl, pk, cid, val FROM __crdt_pending"
+                " ORDER BY rowseq"
+            ).fetchall()
+            changes = self._finalize_pending(pending)
+            conn.execute("DELETE FROM __crdt_pending")
+            conn.execute("COMMIT")
+            self._done = True
+            if changes:
+                db_version = changes[0].db_version
+                return changes, db_version, changes[-1].seq
+            return [], 0, 0
+        except BaseException:
+            conn.execute("ROLLBACK")
+            self._done = True
+            raise
+
+    def rollback(self) -> None:
+        if not self._done:
+            self.conn.execute("ROLLBACK")
+            self._done = True
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        try:
+            if not self._done:
+                if exc_type is None:
+                    self.commit()
+                else:
+                    self.rollback()
+        finally:
+            self.store._lock.release()
+        return False
+
+    def _finalize_pending(self, pending) -> List[Change]:
+        store = self.store
+        conn = self.conn
+        if not pending:
+            return []
+        site = store.site_id
+        db_version = store.db_version_for(site) + 1
+
+        # Dedupe cells: last write in the tx wins; deletes ('-1X') override
+        # the row's other pending entries for sentinel handling.
+        cells: Dict[Tuple[str, bytes, str], SqliteValue] = {}
+        order: List[Tuple[str, bytes, str]] = []
+        deleted_rows: Dict[Tuple[str, bytes], bool] = {}
+        created_rows: Dict[Tuple[str, bytes], bool] = {}
+        for r in pending:
+            tbl, pk, cid, val = r["tbl"], bytes(r["pk"]), r["cid"], r["val"]
+            if cid == SENTINEL + "X":  # delete marker from the del trigger
+                deleted_rows[(tbl, pk)] = True
+                created_rows.pop((tbl, pk), None)
+                # drop any pending column writes for the row
+                for key in [k for k in cells if k[0] == tbl and k[1] == pk]:
+                    del cells[key]
+                    order.remove(key)
+                continue
+            if cid == SENTINEL:
+                created_rows[(tbl, pk)] = True
+                deleted_rows.pop((tbl, pk), None)
+            key = (tbl, pk, cid)
+            if key not in cells:
+                order.append(key)
+            cells[key] = val
+
+        changes: List[Change] = []
+        seq = 0
+
+        def emit(tbl: str, pk: bytes, cid: str, val, col_version: int, cl: int):
+            nonlocal seq
+            changes.append(
+                Change(
+                    table=tbl,
+                    pk=pk,
+                    cid=cid,
+                    val=val,
+                    col_version=col_version,
+                    db_version=db_version,
+                    seq=seq,
+                    site_id=site.bytes16,
+                    cl=cl,
+                    ts=self.ts,
+                )
+            )
+            seq += 1
+
+        # deletes first: sentinel change with bumped-even cl
+        for (tbl, pk) in deleted_rows:
+            rt, ct = _rows_table(tbl), _clock_table(tbl)
+            row = conn.execute(
+                f'SELECT cl FROM "{rt}" WHERE pk = ?', (pk,)
+            ).fetchone()
+            cl = (row["cl"] if row else 1) + 1
+            if cl % 2 == 1:
+                cl += 1  # already deleted? keep even
+            conn.execute(
+                f'INSERT INTO "{rt}" (pk, cl) VALUES (?, ?)'
+                " ON CONFLICT (pk) DO UPDATE SET cl = excluded.cl",
+                (pk, cl),
+            )
+            conn.execute(
+                f'DELETE FROM "{ct}" WHERE pk = ? AND cid != ?', (pk, SENTINEL)
+            )
+            emit(tbl, pk, SENTINEL, None, cl, cl)
+            store._upsert_clock(ct, changes[-1])
+
+        # creations/updates
+        for key in order:
+            tbl, pk, cid = key
+            rt, ct = _rows_table(tbl), _clock_table(tbl)
+            rrow = conn.execute(
+                f'SELECT cl FROM "{rt}" WHERE pk = ?', (pk,)
+            ).fetchone()
+            if cid == SENTINEL:
+                # row creation (or resurrection)
+                prev_cl = rrow["cl"] if rrow else 0
+                cl = prev_cl + 1 if prev_cl % 2 == 0 else prev_cl
+                if rrow is None or prev_cl % 2 == 0:
+                    conn.execute(
+                        f'INSERT INTO "{rt}" (pk, cl) VALUES (?, ?)'
+                        " ON CONFLICT (pk) DO UPDATE SET cl = excluded.cl",
+                        (pk, cl),
+                    )
+                    if prev_cl % 2 == 0 and prev_cl > 0:
+                        # resurrection: reset column clocks
+                        conn.execute(
+                            f'DELETE FROM "{ct}" WHERE pk = ? AND cid != ?',
+                            (pk, SENTINEL),
+                        )
+                    emit(tbl, pk, SENTINEL, None, cl, cl)
+                    store._upsert_clock(ct, changes[-1])
+                continue
+            # column write on a (now) live row
+            cl = rrow["cl"] if rrow else 1
+            crow = conn.execute(
+                f'SELECT col_version FROM "{ct}" WHERE pk = ? AND cid = ?',
+                (pk, cid),
+            ).fetchone()
+            col_version = (crow["col_version"] if crow else 0) + 1
+            emit(tbl, pk, cid, cells[key], col_version, cl)
+            store._upsert_clock(ct, changes[-1])
+
+        if changes:
+            store._bump_db_version(site, db_version)
+            store.record_last_seq(site, db_version, changes[-1].seq)
+        return changes
+
+
+class _SqliteGapStore:
+    def __init__(self, conn: sqlite3.Connection):
+        self._conn = conn
+
+    def delete_gap(self, actor_id: ActorId, start: int, end: int) -> None:
+        self._conn.execute(
+            "DELETE FROM __corro_bookkeeping_gaps"
+            " WHERE actor_id = ? AND start = ? AND end = ?",
+            (actor_id.bytes16, start, end),
+        )
+
+    def insert_gap(self, actor_id: ActorId, start: int, end: int) -> None:
+        self._conn.execute(
+            "INSERT OR REPLACE INTO __corro_bookkeeping_gaps VALUES (?, ?, ?)",
+            (actor_id.bytes16, start, end),
+        )
+
+
+def _sql_pack(*args) -> bytes:
+    return pack_columns(list(args))

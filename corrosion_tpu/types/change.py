@@ -6,7 +6,8 @@ MAX_CHANGES_BYTE_SIZE) and the Changeset/ChangeV1 wire enums from
 
 A `Change` is one column-level CRDT delta: a (table, pk, column) cell with
 its value and clock metadata. `cl` is the causal length of the row: odd =
-alive, even = deleted; the delete sentinel column is `DELETE_SENTINEL`.
+alive, even = deleted. Row create/delete travels as a change whose cid is
+the `SENTINEL` column id ("-1"); a sentinel change with even cl is a delete.
 A version's changes are sequenced 0..=last_seq; changesets may carry a
 sub-range (partial version) — receivers buffer partials until the seq range
 closes (reference `agent/util.rs:1070-1203`).
@@ -21,9 +22,11 @@ from corrosion_tpu.types.actor import ActorId
 from corrosion_tpu.types.base import Timestamp
 from corrosion_tpu.types.values import SqliteValue
 
-# cr-sqlite sentinels (observable in crsql_changes rows)
-DELETE_SENTINEL = "__crsql_del"
-PKONLY_SENTINEL = "__crsql_pko"
+# cr-sqlite sentinel column id (observable in crsql_changes rows; the
+# reference checks `ColumnName::is_crsql_sentinel` == "-1", api.rs:790).
+# A sentinel change tracks row create/delete: its row's causal length `cl`
+# is odd while alive, even when deleted.
+SENTINEL = "-1"
 
 MAX_CHANGES_BYTE_SIZE = 8 * 1024  # change.rs:179
 
@@ -52,8 +55,11 @@ class Change:
         )
         return len(self.table) + len(self.pk) + len(self.cid) + val_sz + 8 * 5 + 16
 
+    def is_sentinel(self) -> bool:
+        return self.cid == SENTINEL
+
     def is_delete(self) -> bool:
-        return self.cid == DELETE_SENTINEL
+        return self.cid == SENTINEL and self.cl % 2 == 0
 
 
 @dataclass(frozen=True)

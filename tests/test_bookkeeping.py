@@ -1,0 +1,149 @@
+"""BookedVersions gap algebra, ported from the reference's unit tests
+(`klukai-types/src/agent.rs:1611-1933` exercises insert_db gap bookkeeping
+against an in-memory db; fixtures below mirror its scenarios).
+"""
+
+import random
+
+from corrosion_tpu.store.bookkeeping import (
+    BookedVersions,
+    NULL_GAP_STORE,
+    PartialVersion,
+    Bookie,
+)
+from corrosion_tpu.types.actor import ActorId
+from corrosion_tpu.types.base import Timestamp
+from corrosion_tpu.types.rangeset import RangeSet
+
+AID = ActorId(b"\x07" * 16)
+
+
+class RecordingStore:
+    """Checks the persisted gap rows always mirror the in-memory set."""
+
+    def __init__(self):
+        self.rows = set()
+
+    def delete_gap(self, actor_id, start, end):
+        assert (actor_id, start, end) in self.rows, f"missing row {(start, end)}"
+        self.rows.discard((actor_id, start, end))
+
+    def insert_gap(self, actor_id, start, end):
+        assert (actor_id, start, end) not in self.rows
+        self.rows.add((actor_id, start, end))
+
+
+def observe(bv, store, *ranges):
+    snap = bv.snapshot()
+    snap.insert_db(store, RangeSet(list(ranges)))
+    bv.commit_snapshot(snap)
+
+
+def test_sequential_no_gaps():
+    bv = BookedVersions(AID)
+    store = RecordingStore()
+    observe(bv, store, (1, 1))
+    observe(bv, store, (2, 5))
+    assert bv.max == 5
+    assert bv.needed.is_empty()
+    assert store.rows == set()
+    assert bv.contains_version(3)
+    assert not bv.contains_version(6)
+
+
+def test_gap_created_and_filled():
+    bv = BookedVersions(AID)
+    store = RecordingStore()
+    observe(bv, store, (1, 2))
+    observe(bv, store, (5, 6))  # creates gap 3..4
+    assert list(bv.needed) == [(3, 4)]
+    assert store.rows == {(AID, 3, 4)}
+    assert not bv.contains_version(3)
+    assert bv.contains_version(5)
+    observe(bv, store, (3, 4))  # fills it
+    assert bv.needed.is_empty()
+    assert store.rows == set()
+    assert bv.contains_all((1, 6))
+
+
+def test_gap_partially_filled_splits():
+    bv = BookedVersions(AID)
+    store = RecordingStore()
+    observe(bv, store, (10, 10))  # gap 1..9
+    assert list(bv.needed) == [(1, 9)]
+    observe(bv, store, (4, 5))
+    assert list(bv.needed) == [(1, 3), (6, 9)]
+    assert store.rows == {(AID, 1, 3), (AID, 6, 9)}
+    observe(bv, store, (1, 3))
+    observe(bv, store, (6, 9))
+    assert bv.needed.is_empty() and store.rows == set()
+
+
+def test_out_of_order_first_observation():
+    bv = BookedVersions(AID)
+    store = RecordingStore()
+    observe(bv, store, (100, 120))
+    assert list(bv.needed) == [(1, 99)]
+    assert bv.max == 120
+    # an already-known version range is a no-op
+    observe(bv, store, (100, 120))
+    assert list(bv.needed) == [(1, 99)]
+
+
+def test_multi_range_single_observation():
+    bv = BookedVersions(AID)
+    store = RecordingStore()
+    observe(bv, store, (5, 6), (10, 12))
+    assert list(bv.needed) == [(1, 4), (7, 9)]
+    assert bv.max == 12
+
+
+def test_partials_lifecycle():
+    bv = BookedVersions(AID)
+    pv = bv.insert_partial(
+        3, PartialVersion(seqs=RangeSet([(0, 4)]), last_seq=10, ts=Timestamp(1))
+    )
+    assert not pv.is_complete()
+    assert bv.max == 3  # partial bumps max
+    pv = bv.insert_partial(
+        3, PartialVersion(seqs=RangeSet([(5, 10)]), last_seq=10, ts=Timestamp(2))
+    )
+    assert pv.is_complete()
+    assert list(pv.gaps()) == []
+
+
+def test_contains_with_seqs():
+    bv = BookedVersions(AID)
+    store = RecordingStore()
+    observe(bv, store, (1, 5))
+    bv.insert_partial(
+        5, PartialVersion(seqs=RangeSet([(0, 3)]), last_seq=9, ts=Timestamp(1))
+    )
+    assert bv.contains(5, (0, 2))
+    assert not bv.contains(5, (0, 5))
+    assert bv.contains(4, (0, 100))  # no partial → fully applied
+
+
+def test_randomized_store_mirror():
+    rnd = random.Random(7)
+    bv = BookedVersions(AID)
+    store = RecordingStore()
+    for _ in range(300):
+        s = rnd.randint(1, 200)
+        e = s + rnd.randint(0, 20)
+        observe(bv, store, (s, e))
+        assert {(st, en) for (_, st, en) in store.rows} == set(bv.needed)
+        # invariant: needed never exceeds max, never contains observed
+        assert (bv.needed.max() or 0) <= (bv.max or 0)
+
+
+def test_bookie():
+    bookie = Bookie()
+    b = bookie.ensure(AID)
+    with b.write() as bv:
+        bv.insert_partial(
+            1, PartialVersion(seqs=RangeSet([(0, 0)]), last_seq=0, ts=Timestamp(1))
+        )
+    assert bookie.get(AID) is b
+    with bookie.ensure(AID).read() as bv:
+        assert bv.get_partial(1) is not None

@@ -1,0 +1,389 @@
+"""CrdtStore: schema, local write capture, merge semantics, convergence.
+
+Semantics under test mirror cr-sqlite's observable behavior as consumed by
+the reference (column LWW with value tie-break + merge-equal-values,
+causal-length deletes, sentinel rows, db_version/seq assignment); the
+convergence tests replay the same operations in different orders on
+independent stores and require identical final states — the core CRDT
+property the whole system rests on.
+"""
+
+import itertools
+
+import pytest
+
+from corrosion_tpu.store.crdt import CrdtStore
+from corrosion_tpu.store.schema import SchemaError, parse_sql
+from corrosion_tpu.types.actor import ActorId
+from corrosion_tpu.types.base import Timestamp
+from corrosion_tpu.types.change import SENTINEL
+
+SCHEMA = """
+CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, text TEXT NOT NULL DEFAULT '');
+CREATE TABLE tests2 (id INTEGER NOT NULL PRIMARY KEY, text TEXT NOT NULL DEFAULT '');
+CREATE TABLE testsblob (id BLOB NOT NULL PRIMARY KEY, text TEXT NOT NULL DEFAULT '');
+"""
+# ^ same shape as the reference's TEST_SCHEMA (klukai-tests/src/lib.rs:13)
+
+
+def mk_store(site_byte=1):
+    s = CrdtStore(":memory:", site_id=ActorId(bytes([site_byte]) * 16))
+    s.apply_schema_sql(SCHEMA)
+    return s
+
+
+def write(store, sql, params=(), ts=None):
+    with store.write_tx(ts or Timestamp.now()) as tx:
+        tx.execute(sql, params)
+        return tx.commit()
+
+
+def rows(store, table="tests"):
+    return [tuple(r) for r in store._conn.execute(f"SELECT * FROM {table} ORDER BY 1")]
+
+
+# -- schema engine ---------------------------------------------------------
+
+
+def test_schema_constraints():
+    with pytest.raises(SchemaError, match="primary key"):
+        parse_sql("CREATE TABLE nopk (a INTEGER);")
+    with pytest.raises(SchemaError, match="UNIQUE"):
+        parse_sql("CREATE TABLE u (id INTEGER PRIMARY KEY, x TEXT UNIQUE);")
+    with pytest.raises(SchemaError, match="foreign keys"):
+        parse_sql(
+            "CREATE TABLE a (id INTEGER PRIMARY KEY);"
+            "CREATE TABLE b (id INTEGER PRIMARY KEY,"
+            " a_id INTEGER REFERENCES a(id));"
+        )
+    with pytest.raises(SchemaError, match="DEFAULT"):
+        parse_sql("CREATE TABLE n (id INTEGER PRIMARY KEY, x TEXT NOT NULL);")
+    ok = parse_sql(SCHEMA)
+    assert set(ok.tables) == {"tests", "tests2", "testsblob"}
+    assert ok.tables["tests"].pk_cols == ["id"]
+    assert ok.tables["tests"].non_pk_cols == ["text"]
+
+
+def test_schema_add_column_and_index():
+    store = mk_store()
+    store.apply_schema_sql(
+        SCHEMA + "\nCREATE INDEX tests_text ON tests (text);"
+    )
+    assert "tests_text" in store.schema.tables["tests"].indexes
+    # add a column
+    new = SCHEMA.replace(
+        "CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, text TEXT NOT NULL DEFAULT '');",
+        "CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, text TEXT NOT NULL DEFAULT '', num INTEGER);",
+    )
+    store.apply_schema_sql(new)
+    write(store, "INSERT INTO tests (id, text, num) VALUES (1, 'a', 5)")
+    assert rows(store) == [(1, "a", 5)]
+
+
+def test_schema_destructive_refused():
+    store = mk_store()
+    with pytest.raises(SchemaError, match="destructive"):
+        store.apply_schema_sql(
+            "CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, text TEXT NOT NULL DEFAULT '');"
+        )  # drops tests2/testsblob
+
+
+# -- local write capture ---------------------------------------------------
+
+
+def test_insert_produces_changes():
+    store = mk_store()
+    changes, db_version, last_seq = write(
+        store, "INSERT INTO tests (id, text) VALUES (1, 'hello')"
+    )
+    assert db_version == 1
+    cids = [c.cid for c in changes]
+    assert cids == [SENTINEL, "text"]
+    assert [c.seq for c in changes] == [0, 1]
+    assert last_seq == 1
+    assert changes[0].cl == 1 and changes[1].cl == 1
+    assert changes[1].val == "hello"
+    assert changes[1].col_version == 1
+    assert all(c.site_id == store.site_id.bytes16 for c in changes)
+
+
+def test_update_bumps_col_version():
+    store = mk_store()
+    write(store, "INSERT INTO tests (id, text) VALUES (1, 'a')")
+    changes, db_version, _ = write(store, "UPDATE tests SET text = 'b' WHERE id = 1")
+    assert db_version == 2
+    assert len(changes) == 1
+    assert changes[0].cid == "text"
+    assert changes[0].col_version == 2
+    assert changes[0].cl == 1
+
+
+def test_noop_update_produces_nothing():
+    store = mk_store()
+    write(store, "INSERT INTO tests (id, text) VALUES (1, 'a')")
+    changes, db_version, _ = write(store, "UPDATE tests SET text = 'a' WHERE id = 1")
+    assert changes == [] and db_version == 0
+
+
+def test_delete_produces_even_cl_sentinel():
+    store = mk_store()
+    write(store, "INSERT INTO tests (id, text) VALUES (1, 'a')")
+    changes, _, _ = write(store, "DELETE FROM tests WHERE id = 1")
+    assert len(changes) == 1
+    assert changes[0].cid == SENTINEL
+    assert changes[0].cl == 2
+    assert changes[0].is_delete()
+    assert rows(store) == []
+
+
+def test_reinsert_after_delete_bumps_cl():
+    store = mk_store()
+    write(store, "INSERT INTO tests (id, text) VALUES (1, 'a')")
+    write(store, "DELETE FROM tests WHERE id = 1")
+    changes, _, _ = write(store, "INSERT INTO tests (id, text) VALUES (1, 'b')")
+    sentinel = [c for c in changes if c.cid == SENTINEL][0]
+    assert sentinel.cl == 3  # resurrection: odd again
+    col = [c for c in changes if c.cid == "text"][0]
+    assert col.cl == 3 and col.col_version == 1  # fresh causal epoch
+
+
+def test_pk_change_is_delete_plus_create():
+    # UPDATE that changes the pk must replicate as delete(old)+create(new)
+    a, b = mk_store(1), mk_store(2)
+    ch1, _, _ = write(a, "INSERT INTO tests (id, text) VALUES (1, 'x')")
+    replicate(ch1, b)
+    ch2, _, _ = write(a, "UPDATE tests SET id = 2 WHERE id = 1")
+    assert ch2, "pk change must produce changes"
+    replicate(ch2, b)
+    assert rows(a) == rows(b) == [(2, "x")]
+
+
+def test_pk_change_with_value_update():
+    a, b = mk_store(1), mk_store(2)
+    ch1, _, _ = write(a, "INSERT INTO tests (id, text) VALUES (1, 'x')")
+    replicate(ch1, b)
+    ch2, _, _ = write(a, "UPDATE tests SET id = 3, text = 'y' WHERE id = 1")
+    replicate(ch2, b)
+    assert rows(a) == rows(b) == [(3, "y")]
+
+
+def test_read_conn_close_is_safe():
+    store = mk_store()
+    write(store, "INSERT INTO tests (id, text) VALUES (1, 'a')")
+    rc = store.read_conn()
+    assert rc.execute("SELECT count(*) FROM tests").fetchone()[0] == 1
+    with pytest.raises(Exception):
+        rc.execute("INSERT INTO tests (id) VALUES (9)")  # query_only
+    rc.close()
+    # the store's own connection is unaffected
+    assert rows(store) == [(1, "a")]
+
+
+def test_exotic_column_name_rejected():
+    with pytest.raises(SchemaError, match="invalid column name"):
+        parse_sql('CREATE TABLE t (id INTEGER PRIMARY KEY, "a\'b" TEXT);')
+
+
+def test_multi_statement_tx_single_version():
+    store = mk_store()
+    ts = Timestamp.now()
+    with store.write_tx(ts) as tx:
+        tx.execute("INSERT INTO tests (id, text) VALUES (1, 'a')")
+        tx.execute("INSERT INTO tests2 (id, text) VALUES (9, 'z')")
+        changes, db_version, last_seq = tx.commit()
+    assert db_version == 1
+    assert {c.table for c in changes} == {"tests", "tests2"}
+    assert [c.seq for c in changes] == list(range(len(changes)))
+    assert last_seq == len(changes) - 1
+
+
+def test_rollback_on_error():
+    store = mk_store()
+    with pytest.raises(Exception):
+        with store.write_tx(Timestamp.now()) as tx:
+            tx.execute("INSERT INTO tests (id, text) VALUES (1, 'a')")
+            tx.execute("INSERT INTO nonexistent VALUES (1)")
+    assert rows(store) == []
+    assert store.db_version_for(store.site_id) == 0
+
+
+# -- remote application + merge rules --------------------------------------
+
+
+def replicate(src_changes, dst):
+    return dst.apply_changes(src_changes)
+
+
+def test_basic_replication():
+    a, b = mk_store(1), mk_store(2)
+    changes, _, _ = write(a, "INSERT INTO tests (id, text) VALUES (1, 'hello')")
+    res = replicate(changes, b)
+    assert rows(b) == [(1, "hello")]
+    assert len(res.impactful) == len(changes)
+    assert res.changed_tables == {"tests": 2}
+
+
+def test_idempotent_apply():
+    a, b = mk_store(1), mk_store(2)
+    changes, _, _ = write(a, "INSERT INTO tests (id, text) VALUES (1, 'hello')")
+    replicate(changes, b)
+    res = replicate(changes, b)
+    assert res.impactful == []  # crsql_rows_impacted-equivalent: no-op
+
+
+def test_lww_higher_col_version_wins():
+    a, b = mk_store(1), mk_store(2)
+    ch1, _, _ = write(a, "INSERT INTO tests (id, text) VALUES (1, 'a')")
+    replicate(ch1, b)
+    ch_b, _, _ = write(b, "UPDATE tests SET text = 'b-wins' WHERE id = 1")
+    assert ch_b[0].col_version == 2
+    res = replicate(ch_b, a)
+    assert rows(a) == [(1, "b-wins")]
+    assert len(res.impactful) == 1
+    # stale lower col_version loses
+    res2 = replicate(ch1, a)
+    assert rows(a) == [(1, "b-wins")]
+    assert not any(c.cid == "text" for c in res2.impactful)
+
+
+def test_lww_equal_version_value_tiebreak():
+    # concurrent writes with equal col_version: larger value wins everywhere
+    a, b = mk_store(1), mk_store(2)
+    cha, _, _ = write(a, "INSERT INTO tests (id, text) VALUES (1, 'aaa')")
+    chb, _, _ = write(b, "INSERT INTO tests (id, text) VALUES (1, 'zzz')")
+    replicate(chb, a)
+    replicate(cha, b)
+    assert rows(a) == rows(b) == [(1, "zzz")]
+
+
+def test_merge_equal_values_no_impact():
+    a, b = mk_store(1), mk_store(2)
+    cha, _, _ = write(a, "INSERT INTO tests (id, text) VALUES (1, 'same')")
+    chb, _, _ = write(b, "INSERT INTO tests (id, text) VALUES (1, 'same')")
+    res = replicate(chb, a)
+    # sentinel same cl: no-op; text equal value: merged silently
+    assert res.impactful == []
+
+
+def test_delete_beats_concurrent_update():
+    a, b = mk_store(1), mk_store(2)
+    ch1, _, _ = write(a, "INSERT INTO tests (id, text) VALUES (1, 'x')")
+    replicate(ch1, b)
+    del_b, _, _ = write(b, "DELETE FROM tests WHERE id = 1")  # cl=2
+    upd_a, _, _ = write(a, "UPDATE tests SET text = 'y' WHERE id = 1")  # cl=1
+    replicate(del_b, a)
+    replicate(upd_a, b)
+    assert rows(a) == rows(b) == []
+
+
+def test_resurrection_beats_delete():
+    a, b = mk_store(1), mk_store(2)
+    ch1, _, _ = write(a, "INSERT INTO tests (id, text) VALUES (1, 'x')")
+    replicate(ch1, b)
+    write(a, "DELETE FROM tests WHERE id = 1")
+    res_a, _, _ = write(a, "INSERT INTO tests (id, text) VALUES (1, 'back')")  # cl=3
+    del_b, _, _ = write(b, "DELETE FROM tests WHERE id = 1")  # cl=2
+    replicate(del_b, a)
+    replicate(res_a, b)
+    assert rows(a) == [(1, "back")]
+    assert rows(b) == [(1, "back")]
+
+
+def test_convergence_all_orders():
+    """Apply three sites' concurrent changesets in every order; all replicas
+    converge to the same state."""
+    base = mk_store(9)
+    ch0, _, _ = write(base, "INSERT INTO tests (id, text) VALUES (1, 'base')")
+
+    sets = []
+    for sb, op in [
+        (1, ("UPDATE tests SET text = 'alpha' WHERE id = 1", ())),
+        (2, ("DELETE FROM tests WHERE id = 1", ())),
+        (3, ("INSERT INTO tests (id, text) VALUES (2, 'two')", ())),
+    ]:
+        s = mk_store(sb)
+        replicate(ch0, s)
+        chs, _, _ = write(s, *op)
+        sets.append(chs)
+
+    results = []
+    for perm in itertools.permutations(range(3)):
+        r = mk_store(50)
+        replicate(ch0, r)
+        for i in perm:
+            replicate(sets[i], r)
+        results.append(rows(r))
+    assert all(r == results[0] for r in results), results
+
+
+def test_pk_only_table_and_blob_pks():
+    store = mk_store()
+    changes, _, _ = write(
+        store, "INSERT INTO testsblob (id, text) VALUES (?, 'v')", (b"\x01\x02",)
+    )
+    b2 = mk_store(2)
+    replicate(changes, b2)
+    got = b2._conn.execute("SELECT id, text FROM testsblob").fetchone()
+    assert bytes(got[0]) == b"\x01\x02" and got[1] == "v"
+
+
+# -- serving changes back (crsql_changes reads) ----------------------------
+
+
+def test_changes_for_versions_roundtrip():
+    a, b = mk_store(1), mk_store(2)
+    write(a, "INSERT INTO tests (id, text) VALUES (1, 'one')")
+    write(a, "INSERT INTO tests (id, text) VALUES (2, 'two')")
+    served = list(a.changes_for_versions(a.site_id, 1, 2))
+    assert [v for v, _ in served] == [2, 1]  # newest first
+    for _, chs in served:
+        replicate(chs, b)
+    assert rows(b) == [(1, "one"), (2, "two")]
+
+
+def test_overwritten_version_serves_nothing():
+    a = mk_store(1)
+    write(a, "INSERT INTO tests (id, text) VALUES (1, 'old')")
+    write(a, "UPDATE tests SET text = 'new' WHERE id = 1")
+    served = dict(a.changes_for_versions(a.site_id, 1, 2))
+    # version 1's text cell was overwritten; only its sentinel remains
+    assert [c.cid for c in served.get(1, [])] == [SENTINEL]
+    assert [c.cid for c in served[2]] == ["text"]
+    assert served[2][0].val == "new"
+
+
+# -- buffered partials -----------------------------------------------------
+
+
+def test_buffer_and_drain_partials():
+    a, b = mk_store(1), mk_store(2)
+    with a.write_tx(Timestamp.now()) as tx:
+        for i in range(10):
+            tx.execute(f"INSERT INTO tests (id, text) VALUES ({i}, 'v{i}')")
+        changes, version, last_seq = tx.commit()
+    # deliver out of order, in two buffered halves
+    half = len(changes) // 2
+    b.buffer_partial_changes(
+        a.site_id, version, changes[half:], (changes[half].seq, last_seq),
+        last_seq, Timestamp.now(),
+    )
+    assert b.take_buffered_version(a.site_id, version)[0].seq == changes[half].seq
+    b.buffer_partial_changes(
+        a.site_id, version, changes[:half], (0, changes[half - 1].seq),
+        last_seq, Timestamp.now(),
+    )
+    buffered = b.take_buffered_version(a.site_id, version)
+    assert [c.seq for c in buffered] == list(range(last_seq + 1))
+    res = b.apply_changes(buffered)
+    assert len(res.impactful) == len(changes)
+    b.clear_buffered_version(a.site_id, version)
+    assert b.take_buffered_version(a.site_id, version) == []
+    assert rows(b) == rows(a)
+
+
+def test_load_booked_versions_roundtrip():
+    a = mk_store(1)
+    write(a, "INSERT INTO tests (id, text) VALUES (1, 'x')")
+    bv = a.load_booked_versions(a.site_id)
+    assert bv.max == 1
+    assert a.booked_actor_ids() == [a.site_id]
