@@ -1,0 +1,67 @@
+"""Benchmark: time-to-stable-membership for a simulated SWIM devcluster.
+
+North star (BASELINE.md): converge a 100k-member devcluster to stable
+membership in <60 s on a v5e-8. This single-chip bench measures wall-clock
+to 99.9% live-member coverage for BENCH_N members (default 10_000 — the
+"10k on one core" rung of the BASELINE.json scale ladder) with zero false
+positives, and reports vs_baseline as (60 s budget / measured), >1 = faster
+than the north-star budget.
+
+Prints exactly one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+
+    from corrosion_tpu.models.cluster import ClusterSim
+
+    n = int(os.environ.get("BENCH_N", "10000"))
+    target = float(os.environ.get("BENCH_COVERAGE", "0.999"))
+    # feed rate sized so convergence lands in O(100) ticks at any n
+    feeds = max(4, n // (25 * 50))
+
+    sim = ClusterSim(n, seed=0, feeds_per_tick=feeds)
+    # warm-up/compile outside the measured window
+    sim.step()
+    jax.block_until_ready(sim.state.view)
+
+    t0 = time.monotonic()
+    stable_tick = sim.run_until_stable(
+        coverage_target=target, max_ticks=5000, record_every=5
+    )
+    elapsed = time.monotonic() - t0
+    stats = sim.stats()
+
+    budget = 60.0
+    print(
+        json.dumps(
+            {
+                "metric": f"time_to_stable_membership_n{n}",
+                "value": round(elapsed, 3),
+                "unit": "s",
+                "vs_baseline": round(budget / elapsed, 3) if elapsed > 0 else 0.0,
+                "detail": {
+                    "n_members": n,
+                    "coverage": round(stats["coverage"], 5),
+                    "false_positive": round(stats["false_positive"], 6),
+                    "stable_tick": stable_tick,
+                    "feeds_per_tick": feeds,
+                    "platform": jax.devices()[0].platform,
+                },
+            }
+        )
+    )
+    if stable_tick is None:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+"""Simulation models: batched SWIM clusters (the devcluster scale engine)."""
+
+from corrosion_tpu.models.cluster import ClusterSim
+
+__all__ = ["ClusterSim"]

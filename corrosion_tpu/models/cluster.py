@@ -1,0 +1,102 @@
+"""ClusterSim: drive the batched SWIM kernel as a simulated devcluster.
+
+This is the TPU replacement for `klukai-devcluster` spawning one OS process
+per node (`crates/klukai-devcluster/src/main.rs:107-232`): instead, 10^4+
+members advance as array rows through `ops.swim.tick`. The measurement
+surface mirrors §6 of SURVEY.md: time-to-stable-membership and
+false-positive detection rates under churn.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from corrosion_tpu.ops import swim
+
+
+@dataclass
+class TickMetrics:
+    tick: int
+    coverage: float
+    detected: float
+    false_positive: float
+    wall_s: float
+
+
+class ClusterSim:
+    """A simulated SWIM cluster of `n` members on one device (see
+    `corrosion_tpu.parallel` for the sharded multi-device variant)."""
+
+    def __init__(
+        self,
+        n: int,
+        seed: int = 0,
+        seeds_per_member: int = 3,
+        seed_mode: str = "ring",
+        **param_overrides,
+    ):
+        self.params = swim.SwimParams(n=n, **param_overrides)
+        self._rng = jax.random.PRNGKey(seed)
+        self._rng, init_key = jax.random.split(self._rng)
+        self.state = swim.init_state(
+            self.params, init_key, seeds_per_member, seed_mode
+        )
+        self.history: List[TickMetrics] = []
+
+    def step(self, ticks: int = 1) -> None:
+        for _ in range(ticks):
+            self._rng, key = jax.random.split(self._rng)
+            self.state = swim.tick(self.state, key, self.params)
+
+    def crash(self, member: int) -> None:
+        self.state = swim.set_alive(self.state, member, False)
+
+    def restart(self, member: int) -> None:
+        self.state = swim.set_alive(self.state, member, True)
+
+    def stats(self) -> Dict[str, float]:
+        return swim.membership_stats(self.state)
+
+    def run_until_stable(
+        self,
+        coverage_target: float = 0.999,
+        max_ticks: int = 10_000,
+        record_every: int = 1,
+    ) -> Optional[int]:
+        """Advance until live-member coverage reaches the target; returns
+        the tick count at stability or None. Records metric history."""
+        start = time.monotonic()
+        while int(self.state.t) < max_ticks:
+            self.step()
+            t = int(self.state.t)
+            if t % record_every == 0:
+                s = self.stats()
+                self.history.append(
+                    TickMetrics(
+                        tick=t,
+                        coverage=s["coverage"],
+                        detected=s["detected"],
+                        false_positive=s["false_positive"],
+                        wall_s=time.monotonic() - start,
+                    )
+                )
+                if s["coverage"] >= coverage_target:
+                    return t
+        return None
+
+    def run_until_detected(
+        self, detect_target: float = 1.0, max_extra_ticks: int = 200
+    ) -> Optional[int]:
+        """After a crash, advance until every live member marked the dead
+        ones down; returns ticks taken or None."""
+        t0 = int(self.state.t)
+        while int(self.state.t) - t0 < max_extra_ticks:
+            self.step()
+            if self.stats()["detected"] >= detect_target:
+                return int(self.state.t) - t0
+        return None

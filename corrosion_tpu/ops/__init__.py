@@ -1,0 +1,1 @@
+"""JAX kernels: batched SWIM membership, gossip dissemination, CRDT merge."""

@@ -1,0 +1,507 @@
+"""Batched SWIM failure detection + infection-style dissemination, as one
+jitted tick over node-state arrays.
+
+The reference runs one foca SWIM state machine per process, event-driven
+(`klukai-agent/src/broadcast/mod.rs:121-386`, with foca's WAN config at
+`:951-960`). This kernel re-architects that for TPU: ALL members advance one
+protocol period per `tick`, and every message-level merge is expressed as a
+scatter-max thanks to the key encoding below. This is what lets a devcluster
+simulate 10^4–10^6 members on TPU cores instead of one async task per node.
+
+## Key encoding
+
+A member's knowledge about a subject is one int32:
+
+    key = 0                     unknown (never heard of the subject)
+    key = (inc + 1) * 4 + prec  known, at incarnation `inc`, with
+                                prec: 0 = alive, 1 = suspect, 2 = down
+
+SWIM's update-precedence rules (higher incarnation wins; for the same
+incarnation `down > suspect > alive`) make `max(key_a, key_b)` exactly the
+protocol merge, so delivering any number of gossip messages is
+`view.at[dst, subj].max(key)` — a single batched scatter-max, and views are
+monotone (a member's knowledge never goes backwards, matching foca).
+
+## Protocol per tick (one SWIM protocol period)
+
+1. probe FSM: idle members pick a random known-alive target and ping it;
+   unacked direct pings escalate to `indirect_probes` helpers; unacked
+   indirect pings raise a suspicion (suspect update + per-prober timer, the
+   SWIM/Lifeguard rule that only the *prober* runs the suspicion timer)
+2. suspicion timers that expire un-refuted declare the subject down
+3. gossip: every member sends its `piggyback` least-transmitted buffered
+   updates to `fanout` random known-alive targets (infection-style with
+   per-update send counts and `max_transmissions` decay, mirroring the
+   broadcast loop's re-send policy in `broadcast/mod.rs:653-812`)
+4. delivery: scatter-max; updates that *improved* a receiver's view enter
+   the receiver's own gossip buffer (epidemic relay); a member that hears
+   itself suspected/downed at its current incarnation refutes by bumping
+   its incarnation and gossiping a fresh alive update
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+INT32_MAX = jnp.iinfo(jnp.int32).max
+
+PREC_ALIVE = 0
+PREC_SUSPECT = 1
+PREC_DOWN = 2
+
+
+class SwimParams(NamedTuple):
+    """Static protocol parameters (hashable → usable as jit static arg)."""
+
+    n: int  # member count
+    fanout: int = 2  # gossip targets per tick
+    piggyback: int = 8  # updates per gossip message
+    buffer_slots: int = 16  # per-member update buffer (B)
+    incoming_slots: int = 8  # max buffer inserts per member per tick (R)
+    susp_slots: int = 4  # concurrent suspicion timers per member (S)
+    max_transmissions: int = 10  # foca-style re-send decay
+    direct_timeout: int = 1  # ticks to wait for a direct ack
+    indirect_timeout: int = 1  # ticks to wait for indirect acks
+    indirect_probes: int = 3  # helpers for an indirect probe (foca WAN: 3)
+    suspicion_ticks: int = 6  # suspect → down without refutation
+    probe_candidates: int = 4  # random candidates tried to find a target
+    antientropy: int = 2  # random view entries pushed per gossip message
+    feed_entries: int = 25  # entries per announce/feed exchange (≈ one
+    # 1178-byte SWIM packet's worth of member records, the foca feed that
+    # bulk-transfers member lists on join/announce)
+    feeds_per_tick: int = 4  # feed packets exchanged per protocol period;
+    # a protocol period is ~1 s, so k feeds/tick ≈ k packets/s of
+    # member-list transfer per member — bump for large clusters
+    loss: float = 0.0  # iid per-leg message loss probability
+
+
+def make_key(inc, prec):
+    return (inc + 1) * 4 + prec
+
+
+def key_inc(key):
+    return key // 4 - 1
+
+
+def key_prec(key):
+    return key % 4
+
+
+def key_known(key):
+    return key > 0
+
+
+class SwimState(NamedTuple):
+    t: jax.Array  # () int32 — current tick
+    alive: jax.Array  # [N] bool — ground truth process liveness
+    inc: jax.Array  # [N] int32 — own incarnation
+    view: jax.Array  # [N, N] int32 — key matrix, view[obs, subj]
+    buf_subj: jax.Array  # [N, B] int32 — gossip buffer subject (N = empty)
+    buf_key: jax.Array  # [N, B] int32
+    buf_sent: jax.Array  # [N, B] int32 — send count (INT32_MAX = empty)
+    probe_phase: jax.Array  # [N] int32 — 0 idle / 1 direct / 2 indirect
+    probe_subj: jax.Array  # [N] int32
+    probe_deadline: jax.Array  # [N] int32
+    probe_ok: jax.Array  # [N] bool — will the pending ack arrive?
+    susp_subj: jax.Array  # [N, S] int32 (N = empty)
+    susp_inc: jax.Array  # [N, S] int32
+    susp_deadline: jax.Array  # [N, S] int32
+
+
+def init_state(
+    params: SwimParams,
+    rng: jax.Array,
+    seeds_per_member: int = 3,
+    seed_mode: str = "ring",
+) -> SwimState:
+    """Freshly booted cluster: every member knows itself plus a few
+    bootstrap seeds (`seed_mode="ring"`: the next k members, like a
+    devcluster ring topology; `"hub"`: everyone knows members 0..k-1)."""
+    n, b, s = params.n, params.buffer_slots, params.susp_slots
+    view = jnp.zeros((n, n), dtype=jnp.int32)
+    idx = jnp.arange(n)
+    view = view.at[idx, idx].set(make_key(0, PREC_ALIVE))
+    alive_key = make_key(0, PREC_ALIVE)
+    if seed_mode == "ring":
+        for k in range(1, seeds_per_member + 1):
+            view = view.at[idx, (idx + k) % n].set(alive_key)
+    elif seed_mode == "hub":
+        k = min(seeds_per_member, n)
+        view = view.at[:, :k].set(alive_key)
+        view = view.at[idx, idx].set(make_key(0, PREC_ALIVE))
+    else:
+        raise ValueError(f"unknown seed_mode {seed_mode!r}")
+
+    # each member starts with an announce of itself in its gossip buffer
+    buf_subj = jnp.full((n, b), n, dtype=jnp.int32)
+    buf_key = jnp.zeros((n, b), dtype=jnp.int32)
+    buf_sent = jnp.full((n, b), INT32_MAX, dtype=jnp.int32)
+    buf_subj = buf_subj.at[:, 0].set(idx.astype(jnp.int32))
+    buf_key = buf_key.at[:, 0].set(alive_key)
+    buf_sent = buf_sent.at[:, 0].set(0)
+
+    return SwimState(
+        t=jnp.int32(0),
+        alive=jnp.ones(n, dtype=bool),
+        inc=jnp.zeros(n, dtype=jnp.int32),
+        view=view,
+        buf_subj=buf_subj,
+        buf_key=buf_key,
+        buf_sent=buf_sent,
+        probe_phase=jnp.zeros(n, dtype=jnp.int32),
+        probe_subj=jnp.full(n, n, dtype=jnp.int32),
+        probe_deadline=jnp.zeros(n, dtype=jnp.int32),
+        probe_ok=jnp.zeros(n, dtype=bool),
+        susp_subj=jnp.full((n, s), n, dtype=jnp.int32),
+        susp_inc=jnp.zeros((n, s), dtype=jnp.int32),
+        susp_deadline=jnp.zeros((n, s), dtype=jnp.int32),
+    )
+
+
+def _pick_known_alive(view_rows, self_idx, rng, params: SwimParams, tries: int):
+    """Per member, return a subject its view says is alive (excluding
+    self); n if none found. Tries `tries` random offsets first (uniform
+    member sampling once views are populated), then falls back to small
+    ring offsets — the bootstrap seeds — so freshly-booted members with
+    near-empty views can still find their seed peers at any cluster size."""
+    n = params.n
+    offs = jax.random.randint(rng, (view_rows.shape[0], tries), 1, n)
+    ring = jax.random.randint(rng, (view_rows.shape[0], 2), 1, 4)
+    offs = jnp.concatenate([offs, ring], axis=1)
+    cands = (self_idx[:, None] + offs) % n
+    keys = jnp.take_along_axis(view_rows, cands, axis=1)
+    ok = key_known(keys) & (key_prec(keys) == PREC_ALIVE) & (cands != self_idx[:, None])
+    first = jnp.argmax(ok, axis=1)
+    found = jnp.any(ok, axis=1)
+    pick = jnp.take_along_axis(cands, first[:, None], axis=1)[:, 0]
+    return jnp.where(found, pick, n)
+
+
+def _buffer_merge(params: SwimParams, buf_subj, buf_key, buf_sent,
+                  in_subj, in_key):
+    """Merge incoming updates (send_count 0) into each member's buffer:
+    dedupe by subject keeping the highest key, then keep the
+    `buffer_slots` least-transmitted entries (drop-most-sent overflow,
+    like the reference's queue trim at broadcast/mod.rs:793-812)."""
+    n = params.n
+    subj = jnp.concatenate([buf_subj, in_subj], axis=1)
+    key = jnp.concatenate([buf_key, in_key], axis=1)
+    sent = jnp.concatenate(
+        [buf_sent, jnp.where(in_subj < n, 0, INT32_MAX)], axis=1
+    )
+    # lexicographic sort per row: subject asc, key desc, sent asc
+    subj_s, negkey_s, sent_s = jax.lax.sort(
+        (subj, -key, sent), dimension=1, num_keys=3
+    )
+    key_s = -negkey_s
+    dup = jnp.concatenate(
+        [jnp.zeros((subj.shape[0], 1), bool), subj_s[:, 1:] == subj_s[:, :-1]],
+        axis=1,
+    )
+    subj_s = jnp.where(dup, n, subj_s)
+    key_s = jnp.where(dup, 0, key_s)
+    sent_s = jnp.where(dup, INT32_MAX, sent_s)
+    # keep least-sent first; empties (sent=INT32_MAX) sort last
+    sent_f, subj_f, key_f = jax.lax.sort(
+        (sent_s, subj_s, key_s), dimension=1, num_keys=1
+    )
+    b = params.buffer_slots
+    return subj_f[:, :b], key_f[:, :b], sent_f[:, :b]
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def tick(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState:
+    """Advance every member one SWIM protocol period."""
+    n = params.n
+    idx = jnp.arange(n, dtype=jnp.int32)
+    t = state.t
+    r_probe, r_ack, r_helpers, r_gossip, r_loss = jax.random.split(rng, 5)
+
+    view = state.view
+    inc = state.inc
+    alive = state.alive
+    buf_subj, buf_key, buf_sent = state.buf_subj, state.buf_key, state.buf_sent
+    susp_subj = state.susp_subj
+    susp_inc = state.susp_inc
+    susp_deadline = state.susp_deadline
+
+    # announcements generated this tick, merged into own view + buffer later
+    own_upd_subj = jnp.full((n, 3), n, dtype=jnp.int32)  # suspect/down/refute
+    own_upd_key = jnp.zeros((n, 3), dtype=jnp.int32)
+
+    # ---- 1. probe FSM ----------------------------------------------------
+    phase, psubj, pdl, pok = (
+        state.probe_phase,
+        state.probe_subj,
+        state.probe_deadline,
+        state.probe_ok,
+    )
+
+    # 1a. escalate expired indirect probes to suspicion
+    expire2 = (phase == 2) & (t >= pdl) & alive
+    fail2 = expire2 & ~pok
+    # believed incarnation of the target
+    tgt_key = view[idx, jnp.clip(psubj, 0, n - 1)]
+    binc = jnp.maximum(key_inc(tgt_key), 0)
+    susp_key = make_key(binc, PREC_SUSPECT)
+    own_upd_subj = own_upd_subj.at[:, 0].set(jnp.where(fail2, psubj, n))
+    own_upd_key = own_upd_key.at[:, 0].set(jnp.where(fail2, susp_key, 0))
+    # register suspicion timer in a free slot (or steal the oldest);
+    # every row writes exactly its own (row, slot) cell so masked rows
+    # cannot clobber real writes via duplicate scatter indices
+    slot_score = jnp.where(susp_subj == n, INT32_MAX, -susp_deadline)
+    free_slot = jnp.argmax(slot_score, axis=1)
+    old_subj = susp_subj[idx, free_slot]
+    old_inc = susp_inc[idx, free_slot]
+    old_dl = susp_deadline[idx, free_slot]
+    susp_subj = susp_subj.at[idx, free_slot].set(jnp.where(fail2, psubj, old_subj))
+    susp_inc = susp_inc.at[idx, free_slot].set(jnp.where(fail2, binc, old_inc))
+    susp_deadline = susp_deadline.at[idx, free_slot].set(
+        jnp.where(fail2, t + params.suspicion_ticks, old_dl)
+    )
+    phase = jnp.where(expire2, 0, phase)
+
+    # 1b. escalate expired direct probes to indirect probes
+    expire1 = (phase == 1) & (t >= pdl) & alive
+    fail1 = expire1 & ~pok
+    helpers = jax.random.randint(
+        r_helpers, (n, params.indirect_probes), 0, n
+    )
+    tgt_alive = alive[jnp.clip(psubj, 0, n - 1)] & (psubj < n)
+    leg = jax.random.uniform(
+        r_ack, (n, params.indirect_probes + 1)
+    ) >= params.loss  # [:, 0] = direct legs, rest = per-helper path
+    helper_ok = alive[helpers] & leg[:, 1:] & tgt_alive[:, None]
+    ind_ok = jnp.any(helper_ok, axis=1)
+    phase = jnp.where(fail1, 2, jnp.where(expire1, 0, phase))
+    pok = jnp.where(fail1, ind_ok, pok)
+    pdl = jnp.where(fail1, t + params.indirect_timeout, pdl)
+
+    # 1c. idle members start a new probe
+    start = (phase == 0) & alive
+    target = _pick_known_alive(view, idx, r_probe, params, params.probe_candidates)
+    will = start & (target < n)
+    direct_ok = alive[jnp.clip(target, 0, n - 1)] & (target < n) & leg[:, 0]
+    phase = jnp.where(will, 1, phase)
+    psubj = jnp.where(will, target, psubj)
+    pdl = jnp.where(will, t + params.direct_timeout, pdl)
+    pok = jnp.where(will, direct_ok, pok)
+
+    # ---- 2. suspicion timers ---------------------------------------------
+    sdl_hit = (susp_subj < n) & (t >= susp_deadline) & alive[:, None]
+    ssub = jnp.clip(susp_subj, 0, n - 1)
+    cur = view[idx[:, None], ssub]
+    still = sdl_hit & (key_prec(cur) == PREC_SUSPECT) & (key_inc(cur) == susp_inc)
+    down_key = make_key(susp_inc, PREC_DOWN)
+    # at most one down-declaration per member per tick (rest fire next tick)
+    fire_col = jnp.argmax(still, axis=1)
+    fire = jnp.any(still, axis=1)
+    fired_subj = jnp.take_along_axis(susp_subj, fire_col[:, None], axis=1)[:, 0]
+    fired_key = jnp.take_along_axis(down_key, fire_col[:, None], axis=1)[:, 0]
+    own_upd_subj = own_upd_subj.at[:, 1].set(jnp.where(fire, fired_subj, n))
+    own_upd_key = own_upd_key.at[:, 1].set(jnp.where(fire, fired_key, 0))
+    clear = (jnp.arange(params.susp_slots)[None, :] == fire_col[:, None]) & fire[:, None]
+    clear = clear | (sdl_hit & ~still)  # refuted timers just clear
+    susp_subj = jnp.where(clear, n, susp_subj)
+
+    # ---- 3. gossip send --------------------------------------------------
+    m, f = params.piggyback, params.fanout
+    # targets: known-alive picks per fanout slot
+    tg = jnp.stack(
+        [
+            _pick_known_alive(
+                view, idx, jax.random.fold_in(r_gossip, j), params, 2
+            )
+            for j in range(f)
+        ],
+        axis=1,
+    )  # [N, f]
+    # least-sent m buffer entries are already sorted to the front by merge
+    send_subj = buf_subj[:, :m]  # [N, m]
+    send_key = buf_key[:, :m]
+    sendable = (send_subj < n) & (buf_sent[:, :m] < params.max_transmissions)
+    valid_tgt = tg < n  # [N, f]
+    # bump send counts by the number of targets each entry was sent to
+    nt = jnp.sum(valid_tgt & alive[:, None], axis=1)  # [N]
+    buf_sent = buf_sent.at[:, :m].set(
+        jnp.where(
+            sendable & (nt[:, None] > 0),
+            buf_sent[:, :m] + nt[:, None],
+            buf_sent[:, :m],
+        )
+    )
+
+    # anti-entropy tail correction: besides fresh updates, push a few
+    # random entries from the sender's own view so dissemination cannot
+    # die out short of full coverage once send counts decay (foca's
+    # periodic announce/feed exchange plays this role)
+    ae = params.antientropy
+    if ae > 0:
+        r_ae = jax.random.fold_in(r_gossip, 7919)
+        ae_subj = jax.random.randint(r_ae, (n, ae), 0, n).astype(jnp.int32)
+        ae_key = view[idx[:, None], ae_subj]
+        send_subj = jnp.concatenate([send_subj, ae_subj], axis=1)
+        send_key = jnp.concatenate([send_key, ae_key], axis=1)
+        sendable = jnp.concatenate(
+            [sendable, ae_key > 0], axis=1
+        )
+        m = m + ae
+
+    # message triples [N, f, m] → flat [M]
+    msg_ok = (
+        sendable[:, None, :]
+        & valid_tgt[:, :, None]
+        & alive[:, None, None]
+    )
+    drop = (
+        jax.random.uniform(r_loss, msg_ok.shape) < params.loss
+    )
+    msg_ok = msg_ok & ~drop
+    dst = jnp.broadcast_to(jnp.clip(tg, 0, n - 1)[:, :, None], msg_ok.shape)
+    subj = jnp.broadcast_to(send_subj[:, None, :], msg_ok.shape)
+    key = jnp.broadcast_to(send_key[:, None, :], msg_ok.shape)
+    # masked → deliver key 0 about self: guaranteed no-op
+    dst = jnp.where(msg_ok, dst, idx[:, None, None]).reshape(-1)
+    subj = jnp.where(msg_ok, subj, idx[:, None, None]).reshape(-1)
+    key = jnp.where(msg_ok, key, 0).reshape(-1)
+
+    # include own announcements (suspicions/downs) as self-delivered msgs
+    dst = jnp.concatenate([dst, jnp.repeat(idx, own_upd_subj.shape[1])])
+    subj = jnp.concatenate(
+        [subj, jnp.where(own_upd_subj < n, own_upd_subj, idx[:, None]).reshape(-1)]
+    )
+    key = jnp.concatenate(
+        [key, jnp.where(own_upd_subj < n, own_upd_key, 0).reshape(-1)]
+    )
+
+    # ---- 4. delivery: refutation, scatter-max, buffer relay --------------
+    # refutation: a live member hearing itself suspect/down at ≥ its inc
+    about_self = (subj == dst) & (key_prec(key) >= PREC_SUSPECT)
+    off_inc = jnp.where(about_self, key_inc(key), -1)
+    worst = jnp.zeros(n, jnp.int32).at[dst].max(off_inc)
+    refute = alive & (worst >= inc)
+    inc = jnp.where(refute, worst + 1, inc)
+    own_upd_subj = own_upd_subj.at[:, 2].set(jnp.where(refute, idx, n))
+    own_upd_key = own_upd_key.at[:, 2].set(
+        jnp.where(refute, make_key(inc, PREC_ALIVE), 0)
+    )
+
+    improved = key > view[dst, subj]
+    view = view.at[dst, subj].max(key)
+    # self-entries stay fresh (and reflect refutations immediately)
+    self_key = make_key(inc, PREC_ALIVE)
+    view = view.at[idx, idx].max(jnp.where(alive, self_key, 0))
+
+    # ---- 4b. announce/feed exchange --------------------------------------
+    # Each member pulls one packet's worth of member records from a random
+    # known-alive partner: a rotating window over subject space, so every
+    # subject is fed within ceil(n / feed_entries) exchanges. This is the
+    # batched form of foca's Announce→Feed bulk member-list transfer, and
+    # it is what bootstraps large clusters (per-update infection alone
+    # cannot push 10^4+ simultaneous joins through bounded buffers).
+    fe = params.feed_entries
+    if fe > 0 and params.feeds_per_tick > 0:
+
+        def one_feed(k, v):
+            r_feed = jax.random.fold_in(r_gossip, 104729 + k)
+            partner = _pick_known_alive(v, idx, r_feed, params, 2)
+            has_partner = (partner < n) & alive
+            psafe = jnp.clip(partner, 0, n - 1)
+            # per-member rotating window offset, decorrelated by member
+            # index; gather only the [N, feed_entries] window (not whole
+            # partner rows) so each feed stays O(N·F) at 10^5+ members
+            w = ((t * params.feeds_per_tick + k) * fe + idx * 40503) % n
+            cols = (w[:, None] + jnp.arange(fe, dtype=jnp.int32)[None, :]) % n
+            pkeys = v[psafe[:, None], cols]  # [N, F] partner window
+            pkeys = jnp.where(has_partner[:, None], pkeys, 0)
+            return v.at[idx[:, None], cols].max(pkeys)
+
+        view = jax.lax.fori_loop(0, params.feeds_per_tick, one_feed, view)
+
+    # relay: improved updates about third parties enter receiver buffers
+    relay_ok = improved & (subj != dst)
+    # rank messages within destination: sort by (dst, arrival)
+    order = jnp.argsort(jnp.where(relay_ok, dst, n), stable=True)
+    dst_s = jnp.where(relay_ok, dst, n)[order]
+    subj_s = subj[order]
+    key_s = key[order]
+    pos = jnp.arange(dst_s.shape[0])
+    first = jnp.searchsorted(dst_s, dst_s, side="left")
+    rank = pos - first
+    ok = (dst_s < n) & (rank < params.incoming_slots)
+    # scatter with min/max so masked duplicate (0, 0) writes are no-ops:
+    # each real (row, rank) cell receives at most one message (ranks are
+    # unique per destination), so min(subj)/max(key) both pick that message
+    in_subj = jnp.full((n, params.incoming_slots), n, dtype=jnp.int32)
+    in_key = jnp.zeros((n, params.incoming_slots), dtype=jnp.int32)
+    rows = jnp.where(ok, dst_s, 0)
+    cols = jnp.where(ok, rank, 0)
+    in_subj = in_subj.at[rows, cols].min(jnp.where(ok, subj_s, n))
+    in_key = in_key.at[rows, cols].max(jnp.where(ok, key_s, 0))
+
+    # own announcements also enter own buffer (send_count 0)
+    in_subj = jnp.concatenate([in_subj, own_upd_subj], axis=1)
+    in_key = jnp.concatenate([in_key, own_upd_key], axis=1)
+
+    buf_subj, buf_key, buf_sent = _buffer_merge(
+        params, buf_subj, buf_key, buf_sent, in_subj, in_key
+    )
+
+    return SwimState(
+        t=t + 1,
+        alive=alive,
+        inc=inc,
+        view=view,
+        buf_subj=buf_subj,
+        buf_key=buf_key,
+        buf_sent=buf_sent,
+        probe_phase=phase,
+        probe_subj=psubj,
+        probe_deadline=pdl,
+        probe_ok=pok,
+        susp_subj=susp_subj,
+        susp_inc=susp_inc,
+        susp_deadline=susp_deadline,
+    )
+
+
+def set_alive(state: SwimState, member: int, value: bool) -> SwimState:
+    """Churn injection: crash or (re)start a member process."""
+    alive = state.alive.at[member].set(value)
+    inc = jnp.where(
+        value, state.inc.at[member].add(1), state.inc
+    )  # restart = renewed identity (actor.rs:199 renew())
+    return state._replace(alive=alive, inc=inc)
+
+
+@jax.jit
+def _stats_impl(view, alive):
+    n = view.shape[0]
+    eye = jnp.eye(n, dtype=bool)
+    prec = key_prec(view)
+    known = key_known(view)
+    pair_mask = alive[:, None] & ~eye
+    alive_subj = pair_mask & alive[None, :]
+    dead_subj = pair_mask & ~alive[None, :]
+    knows_alive = known & (prec == PREC_ALIVE)
+    thinks_down = known & (prec == PREC_DOWN)
+    n_alive_pairs = jnp.maximum(jnp.sum(alive_subj), 1)
+    n_dead_pairs = jnp.maximum(jnp.sum(dead_subj), 1)
+    coverage = jnp.sum(knows_alive & alive_subj) / n_alive_pairs
+    detected = jnp.sum(thinks_down & dead_subj) / n_dead_pairs
+    false_pos = jnp.sum((prec >= PREC_SUSPECT) & known & alive_subj) / n_alive_pairs
+    return coverage, detected, false_pos
+
+
+def membership_stats(state: SwimState) -> dict:
+    """Convergence metrics over live observers."""
+    coverage, detected, false_pos = _stats_impl(state.view, state.alive)
+    return {
+        "coverage": float(coverage),  # live members known-alive by live peers
+        "detected": float(detected),  # dead members marked down
+        "false_positive": float(false_pos),  # live members suspected/downed
+    }

@@ -1,0 +1,9 @@
+"""Device-mesh sharding for the member axis (ICI-scaled SWIM)."""
+
+from corrosion_tpu.parallel.mesh import (
+    member_mesh,
+    shard_swim_state,
+    sharded_tick,
+)
+
+__all__ = ["member_mesh", "shard_swim_state", "sharded_tick"]

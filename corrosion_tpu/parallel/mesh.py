@@ -1,0 +1,76 @@
+"""Member-axis sharding over a jax.sharding.Mesh.
+
+The reference scales clusters by spawning more processes; the TPU design
+shards the *member dimension* across devices (SURVEY.md §2.6): every
+per-member array — and the [N, N] view matrix's observer axis — is laid
+out `P("members", ...)` so each device owns a contiguous block of
+observers. Cross-shard message delivery (gossip scatter-max, feed-window
+gathers of other shards' view rows) compiles to XLA collectives over ICI;
+we annotate shardings and let the compiler insert them rather than
+hand-writing NCCL-style exchanges.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from corrosion_tpu.ops import swim
+
+MEMBER_AXIS = "members"
+
+
+def member_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devs = np.array(devices if devices is not None else jax.devices())
+    return Mesh(devs, axis_names=(MEMBER_AXIS,))
+
+
+def _sharding_for(mesh: Mesh, ndim: int) -> NamedSharding:
+    # observer axis sharded, every other axis replicated-dim
+    spec = [MEMBER_AXIS] + [None] * (ndim - 1)
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_swim_state(state: swim.SwimState, mesh: Mesh) -> swim.SwimState:
+    """Lay every per-member array out row-sharded over the mesh.
+
+    Scalars (the tick counter) stay replicated.
+    """
+    out = {}
+    for name, arr in state._asdict().items():
+        if getattr(arr, "ndim", 0) == 0:
+            out[name] = jax.device_put(arr, NamedSharding(mesh, P()))
+        else:
+            out[name] = jax.device_put(arr, _sharding_for(mesh, arr.ndim))
+    return swim.SwimState(**out)
+
+
+def sharded_tick(params: swim.SwimParams, mesh: Mesh):
+    """A jitted tick whose outputs are constrained to the member sharding
+    (inputs carry their shardings; XLA inserts the ICI collectives for the
+    cross-shard gather/scatter in delivery and feed)."""
+
+    out_shardings = swim.SwimState(
+        t=NamedSharding(mesh, P()),
+        alive=_sharding_for(mesh, 1),
+        inc=_sharding_for(mesh, 1),
+        view=_sharding_for(mesh, 2),
+        buf_subj=_sharding_for(mesh, 2),
+        buf_key=_sharding_for(mesh, 2),
+        buf_sent=_sharding_for(mesh, 2),
+        probe_phase=_sharding_for(mesh, 1),
+        probe_subj=_sharding_for(mesh, 1),
+        probe_deadline=_sharding_for(mesh, 1),
+        probe_ok=_sharding_for(mesh, 1),
+        susp_subj=_sharding_for(mesh, 2),
+        susp_inc=_sharding_for(mesh, 2),
+        susp_deadline=_sharding_for(mesh, 2),
+    )
+
+    def _tick(state: swim.SwimState, rng: jax.Array) -> swim.SwimState:
+        return swim.tick.__wrapped__(state, rng, params)
+
+    return jax.jit(_tick, out_shardings=out_shardings)

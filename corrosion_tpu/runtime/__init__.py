@@ -1,0 +1,1 @@
+"""Host runtime: shutdown tripwire, instrumented channels, config, agent."""

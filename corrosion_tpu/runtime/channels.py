@@ -1,0 +1,103 @@
+"""Bounded channels with metrics, the runtime's nervous system.
+
+Counterpart of `klukai-types/src/channel.rs` (mpsc wrappers emitting
+send/recv/failed counters, capacity gauges, and send-delay histograms per
+named channel) over asyncio queues. The same names flow into the metrics
+registry so dashboards match the reference's series.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Generic, Optional, Tuple, TypeVar
+
+from corrosion_tpu.runtime.metrics import METRICS
+
+T = TypeVar("T")
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class Sender(Generic[T]):
+    def __init__(self, ch: "_Chan[T]"):
+        self._ch = ch
+
+    async def send(self, item: T) -> None:
+        if self._ch.closed:
+            METRICS.counter(
+                "corro.channel.message.send.failed", channel=self._ch.name
+            ).inc()
+            raise ChannelClosed(self._ch.name)
+        start = time.monotonic()
+        await self._ch.queue.put(item)
+        METRICS.counter("corro.channel.message.sent", channel=self._ch.name).inc()
+        METRICS.histogram(
+            "corro.channel.message.send.delay.seconds", channel=self._ch.name
+        ).observe(time.monotonic() - start)
+
+    def try_send(self, item: T) -> bool:
+        try:
+            self._ch.queue.put_nowait(item)
+            METRICS.counter(
+                "corro.channel.message.sent", channel=self._ch.name
+            ).inc()
+            return True
+        except asyncio.QueueFull:
+            METRICS.counter(
+                "corro.channel.message.send.failed", channel=self._ch.name
+            ).inc()
+            return False
+
+    def close(self) -> None:
+        self._ch.closed = True
+
+    @property
+    def capacity_left(self) -> int:
+        return max(0, self._ch.queue.maxsize - self._ch.queue.qsize())
+
+
+class Receiver(Generic[T]):
+    def __init__(self, ch: "_Chan[T]"):
+        self._ch = ch
+
+    async def recv(self) -> T:
+        item = await self._ch.queue.get()
+        METRICS.counter(
+            "corro.channel.message.received", channel=self._ch.name
+        ).inc()
+        return item
+
+    def try_recv(self) -> Optional[T]:
+        try:
+            item = self._ch.queue.get_nowait()
+            METRICS.counter(
+                "corro.channel.message.received", channel=self._ch.name
+            ).inc()
+            return item
+        except asyncio.QueueEmpty:
+            return None
+
+    async def recv_timeout(self, timeout: float) -> Optional[T]:
+        try:
+            return await asyncio.wait_for(self.recv(), timeout)
+        except asyncio.TimeoutError:
+            return None
+
+    def qsize(self) -> int:
+        return self._ch.queue.qsize()
+
+
+class _Chan(Generic[T]):
+    def __init__(self, size: int, name: str):
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=size)
+        self.name = name
+        self.closed = False
+
+
+def bounded(size: int, name: str) -> Tuple[Sender[T], Receiver[T]]:
+    ch: _Chan[T] = _Chan(size, name)
+    METRICS.gauge("corro.channel.bounded.capacity", channel=name).set(size)
+    return Sender(ch), Receiver(ch)

@@ -1,0 +1,152 @@
+"""Configuration: TOML file + environment overrides + builder.
+
+Counterpart of `klukai-types/src/config.rs:62-458`. Same sections (db, api,
+gossip, perf, admin, telemetry, log, consul) and the same env-override
+convention: `CORRO_DB__PATH=/x` overrides `db.path` (double underscore as
+the section separator, config.rs:304-310). PerfConfig carries the channel
+sizes and protocol knobs with the reference's defaults (config.rs:11-59,
+179-235).
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field, fields, is_dataclass, replace
+from typing import List, Optional
+
+
+@dataclass
+class DbConfig:
+    path: str = "./corrosion.db"
+    schema_paths: List[str] = field(default_factory=list)
+    subscriptions_path: Optional[str] = None
+
+
+@dataclass
+class ApiConfig:
+    bind_addr: List[str] = field(default_factory=lambda: ["127.0.0.1:8080"])
+    authz_bearer: Optional[str] = None
+
+
+@dataclass
+class GossipConfig:
+    bind_addr: str = "0.0.0.0:8787"
+    external_addr: Optional[str] = None
+    bootstrap: List[str] = field(default_factory=list)
+    cluster_id: int = 0
+    plaintext: bool = True  # no TLS yet; mirrors quinn_plaintext mode
+    max_mtu: Optional[int] = None
+    idle_timeout_secs: int = 30
+
+
+@dataclass
+class PerfConfig:
+    # channel sizes (config.rs:179-235)
+    changes_channel_len: int = 2048
+    bcast_channel_len: int = 10_000
+    apply_channel_len: int = 512
+    foca_channel_len: int = 1024
+    # ingestion (config.rs:15-47)
+    processing_queue_len: int = 20_000
+    apply_queue_len: int = 50
+    apply_queue_timeout_ms: int = 10
+    max_concurrent_applies: int = 5
+    # sync (config.rs:11-13, 53-59)
+    sync_interval_min_secs: float = 1.0
+    sync_interval_max_secs: float = 15.0
+    sync_peers_min: int = 3
+    sync_peers_max: int = 10
+    max_concurrent_inbound_syncs: int = 3
+    # broadcast
+    broadcast_interval_ms: int = 500
+    broadcast_cutoff_bytes: int = 64 * 1024
+    broadcast_rate_limit_bytes: int = 10 * 1024 * 1024
+    max_inflight_broadcasts: int = 500
+    # maintenance
+    wal_threshold_gb: float = 5.0
+
+
+@dataclass
+class AdminConfig:
+    uds_path: str = "./admin.sock"
+
+
+@dataclass
+class TelemetryConfig:
+    prometheus_bind_addr: Optional[str] = None
+
+
+@dataclass
+class LogConfig:
+    format: str = "plaintext"  # or "json"
+    colors: bool = True
+    level: str = "info"
+
+
+@dataclass
+class Config:
+    db: DbConfig = field(default_factory=DbConfig)
+    api: ApiConfig = field(default_factory=ApiConfig)
+    gossip: GossipConfig = field(default_factory=GossipConfig)
+    perf: PerfConfig = field(default_factory=PerfConfig)
+    admin: AdminConfig = field(default_factory=AdminConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    log: LogConfig = field(default_factory=LogConfig)
+
+
+_ENV_PREFIX = "CORRO_"
+
+
+def _coerce(value: str, target_type):
+    if target_type is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if target_type is int:
+        return int(value)
+    if target_type is float:
+        return float(value)
+    if target_type in (List[str], list):
+        return [v.strip() for v in value.split(",") if v.strip()]
+    return value
+
+
+def _apply_dict(cfg, data: dict):
+    for f in fields(cfg):
+        if f.name in data:
+            v = data[f.name]
+            cur = getattr(cfg, f.name)
+            if is_dataclass(cur) and isinstance(v, dict):
+                _apply_dict(cur, v)
+            else:
+                setattr(cfg, f.name, v)
+
+
+def load_config(path: Optional[str] = None, env: Optional[dict] = None) -> Config:
+    """TOML file (optional) overlaid with CORRO_SECTION__FIELD env vars."""
+    cfg = Config()
+    if path:
+        with open(path, "rb") as f:
+            _apply_dict(cfg, tomllib.load(f))
+    env = env if env is not None else os.environ
+    for key, value in env.items():
+        if not key.startswith(_ENV_PREFIX):
+            continue
+        parts = key[len(_ENV_PREFIX):].lower().split("__")
+        if len(parts) != 2:
+            continue
+        section, name = parts
+        sec = getattr(cfg, section, None)
+        if sec is None or not hasattr(sec, name):
+            continue
+        ftype = {f.name: f.type for f in fields(sec)}.get(name)
+        target = str
+        if ftype in ("int", int):
+            target = int
+        elif ftype in ("float", float):
+            target = float
+        elif ftype in ("bool", bool):
+            target = bool
+        elif "List" in str(ftype) or "list" in str(ftype):
+            target = list
+        setattr(sec, name, _coerce(value, target))
+    return cfg

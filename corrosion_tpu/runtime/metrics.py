@@ -1,0 +1,126 @@
+"""Minimal metrics registry (counters/gauges/histograms) with a Prometheus
+text exposition, standing in for the reference's `metrics` facade +
+Prometheus exporter (`klukai/src/command/agent.rs:29-63`). ~Same series
+names are emitted by the runtime so dashboards translate directly.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_right
+from typing import Dict, List, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _labels_key(labels: Dict[str, str]) -> LabelKey:
+    return tuple(sorted(labels.items()))
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+    def add(self, v: float) -> None:
+        self.value += v
+
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
+)
+
+
+class Histogram:
+    __slots__ = ("buckets", "counts", "total", "count")
+
+    def __init__(self, buckets=_DEFAULT_BUCKETS):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        self.counts[bisect_right(self.buckets, v)] += 1
+        self.total += v
+        self.count += 1
+
+
+class Registry:
+    def __init__(self):
+        self._counters: Dict[Tuple[str, LabelKey], Counter] = {}
+        self._gauges: Dict[Tuple[str, LabelKey], Gauge] = {}
+        self._histograms: Dict[Tuple[str, LabelKey], Histogram] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, **labels: str) -> Counter:
+        key = (name, _labels_key(labels))
+        with self._lock:
+            c = self._counters.get(key)
+            if c is None:
+                c = self._counters[key] = Counter()
+            return c
+
+    def gauge(self, name: str, **labels: str) -> Gauge:
+        key = (name, _labels_key(labels))
+        with self._lock:
+            g = self._gauges.get(key)
+            if g is None:
+                g = self._gauges[key] = Gauge()
+            return g
+
+    def histogram(self, name: str, **labels: str) -> Histogram:
+        key = (name, _labels_key(labels))
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None:
+                h = self._histograms[key] = Histogram()
+            return h
+
+    def render_prometheus(self) -> str:
+        """Prometheus text format 0.0.4."""
+        out: List[str] = []
+
+        def fmt(name: str, labels: LabelKey, extra: Dict[str, str] = ()) -> str:
+            norm = name.replace(".", "_").replace("-", "_")
+            items = list(labels) + list(dict(extra).items() if extra else [])
+            if items:
+                lbl = ",".join(f'{k}="{v}"' for k, v in items)
+                return f"{norm}{{{lbl}}}"
+            return norm
+
+        with self._lock:
+            for (name, labels), c in sorted(self._counters.items()):
+                out.append(f"{fmt(name, labels)} {c.value}")
+            for (name, labels), g in sorted(self._gauges.items()):
+                out.append(f"{fmt(name, labels)} {g.value}")
+            for (name, labels), h in sorted(self._histograms.items()):
+                cum = 0
+                for i, b in enumerate(h.buckets):
+                    cum += h.counts[i]
+                    out.append(
+                        f"{fmt(name + '_bucket', labels, {'le': str(b)})} {cum}"
+                    )
+                out.append(
+                    f"{fmt(name + '_bucket', labels, {'le': '+Inf'})} {h.count}"
+                )
+                out.append(f"{fmt(name + '_sum', labels)} {h.total}")
+                out.append(f"{fmt(name + '_count', labels)} {h.count}")
+        return "\n".join(out) + "\n"
+
+
+METRICS = Registry()

@@ -1,0 +1,97 @@
+"""Tripwire: cooperative shutdown signal threaded through every loop.
+
+Counterpart of `klukai-types/src/tripwire/` (watch-channel future completed
+on SIGTERM/SIGINT or programmatic trip, plus the `preemptible` combinator
+every loop wraps its awaits in) and `spawn.rs`'s counted-task graceful
+shutdown (`wait_for_all_pending_handles`, ≤60 s drain).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import signal
+from enum import Enum
+from typing import Awaitable, Optional, Set, TypeVar
+
+T = TypeVar("T")
+
+
+class Outcome(Enum):
+    COMPLETED = "completed"
+    PREEMPTED = "preempted"
+
+
+class Tripwire:
+    def __init__(self):
+        self._event = asyncio.Event()
+
+    @classmethod
+    def from_signals(cls) -> "Tripwire":
+        tw = cls()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            with contextlib.suppress(NotImplementedError):
+                loop.add_signal_handler(sig, tw.trip)
+        return tw
+
+    def trip(self) -> None:
+        self._event.set()
+
+    @property
+    def tripped(self) -> bool:
+        return self._event.is_set()
+
+    async def wait(self) -> None:
+        await self._event.wait()
+
+    async def preemptible(self, aw: Awaitable[T]):
+        """Run `aw` unless the tripwire fires first.
+
+        Returns (Outcome.COMPLETED, result) or (Outcome.PREEMPTED, None);
+        the inner awaitable is cancelled on preemption.
+        """
+        task = asyncio.ensure_future(aw)
+        trip_task = asyncio.ensure_future(self._event.wait())
+        try:
+            done, _ = await asyncio.wait(
+                {task, trip_task}, return_when=asyncio.FIRST_COMPLETED
+            )
+            if task in done:
+                return Outcome.COMPLETED, task.result()
+            task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await task
+            return Outcome.PREEMPTED, None
+        finally:
+            trip_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await trip_task
+
+
+class TaskTracker:
+    """Counted critical tasks: shutdown waits for them (spawn.rs:17-134)."""
+
+    def __init__(self):
+        self._tasks: Set[asyncio.Task] = set()
+
+    def spawn(self, coro) -> asyncio.Task:
+        task = asyncio.ensure_future(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
+
+    @property
+    def pending(self) -> int:
+        return len(self._tasks)
+
+    async def wait_all(self, timeout: float = 60.0) -> bool:
+        """Wait ≤timeout for all tracked tasks; returns True if drained."""
+        if not self._tasks:
+            return True
+        done, pending = await asyncio.wait(
+            set(self._tasks), timeout=timeout
+        )
+        for t in pending:
+            t.cancel()
+        return not pending

@@ -1,0 +1,135 @@
+"""Sync set-algebra tests, mirroring the reference's unit scenarios
+(`klukai-types/src/sync.rs:542-817` exercises compute_available_needs over
+heads/needs/partials combinations)."""
+
+from corrosion_tpu.store.bookkeeping import (
+    Bookie,
+    NULL_GAP_STORE,
+    PartialVersion,
+)
+from corrosion_tpu.sync import (
+    chunk_range,
+    compute_available_needs,
+    generate_sync,
+    state_need_len,
+)
+from corrosion_tpu.types.actor import ActorId
+from corrosion_tpu.types.base import Timestamp
+from corrosion_tpu.types.codec import NeedFull, NeedPartial, SyncState
+from corrosion_tpu.types.rangeset import RangeSet
+
+ME = ActorId(b"\x01" * 16)
+PEER = ActorId(b"\x02" * 16)
+ORIGIN = ActorId(b"\x03" * 16)
+
+
+def st(actor, heads=None, need=None, partial=None):
+    return SyncState(
+        actor_id=actor,
+        heads=heads or {},
+        need=need or {},
+        partial_need=partial or {},
+    )
+
+
+def test_missing_everything():
+    ours = st(ME)
+    theirs = st(PEER, heads={ORIGIN: 10})
+    needs = compute_available_needs(ours, theirs)
+    assert needs == {ORIGIN: [NeedFull((1, 10))]}
+
+
+def test_head_catchup():
+    ours = st(ME, heads={ORIGIN: 6})
+    theirs = st(PEER, heads={ORIGIN: 10})
+    needs = compute_available_needs(ours, theirs)
+    assert needs == {ORIGIN: [NeedFull((7, 10))]}
+
+
+def test_no_needs_when_equal():
+    ours = st(ME, heads={ORIGIN: 10})
+    theirs = st(PEER, heads={ORIGIN: 10})
+    assert compute_available_needs(ours, theirs) == {}
+
+
+def test_skip_own_actor_and_zero_heads():
+    ours = st(ME)
+    theirs = st(PEER, heads={ME: 10, ORIGIN: 0})
+    assert compute_available_needs(ours, theirs) == {}
+
+
+def test_gap_intersected_with_their_haves():
+    # we need 3..8; they have 1..10 except their own need 5..6
+    ours = st(ME, heads={ORIGIN: 10}, need={ORIGIN: [(3, 8)]})
+    theirs = st(PEER, heads={ORIGIN: 10}, need={ORIGIN: [(5, 6)]})
+    needs = compute_available_needs(ours, theirs)
+    assert needs == {ORIGIN: [NeedFull((3, 4)), NeedFull((7, 8))]}
+
+
+def test_their_partial_excluded_from_full_haves():
+    ours = st(ME, heads={ORIGIN: 10}, need={ORIGIN: [(4, 6)]})
+    theirs = st(
+        PEER, heads={ORIGIN: 10}, partial={ORIGIN: {5: [(0, 3)]}}
+    )
+    needs = compute_available_needs(ours, theirs)
+    # version 5 is partial on their side → only 4 and 6 are requestable
+    assert needs == {ORIGIN: [NeedFull((4, 4)), NeedFull((6, 6))]}
+
+
+def test_partial_when_they_have_it_fully():
+    ours = st(
+        ME, heads={ORIGIN: 10}, partial={ORIGIN: {7: [(3, 9)]}}
+    )
+    theirs = st(PEER, heads={ORIGIN: 10})
+    needs = compute_available_needs(ours, theirs)
+    assert needs == {ORIGIN: [NeedPartial(7, ((3, 9),))]}
+
+
+def test_partial_intersection_when_both_partial():
+    # we miss seqs 2..8 of version 7; they miss 6..9 → they can serve 2..5
+    ours = st(ME, heads={ORIGIN: 10}, partial={ORIGIN: {7: [(2, 8)]}})
+    theirs = st(PEER, heads={ORIGIN: 10}, partial={ORIGIN: {7: [(6, 9)]}})
+    needs = compute_available_needs(ours, theirs)
+    assert needs == {ORIGIN: [NeedPartial(7, ((2, 5),))]}
+
+
+def test_both_partial_disjoint_is_empty():
+    ours = st(ME, heads={ORIGIN: 10}, partial={ORIGIN: {7: [(0, 4)]}})
+    theirs = st(PEER, heads={ORIGIN: 10}, partial={ORIGIN: {7: [(0, 5)]}})
+    assert compute_available_needs(ours, theirs) == {}
+
+
+def test_generate_sync_from_bookie():
+    bookie = Bookie()
+    with bookie.ensure(ORIGIN).write() as bv:
+        snap = bv.snapshot()
+        snap.insert_db(NULL_GAP_STORE, RangeSet([(1, 4), (8, 10)]))
+        bv.commit_snapshot(snap)
+        bv.insert_partial(
+            9, PartialVersion(seqs=RangeSet([(0, 2)]), last_seq=9, ts=Timestamp(1))
+        )
+    state = generate_sync(bookie, ME)
+    assert state.heads == {ORIGIN: 10}
+    assert state.need == {ORIGIN: [(5, 7)]}
+    assert state.partial_need == {ORIGIN: {9: [(3, 9)]}}
+    assert state_need_len(state) == 3
+
+
+def test_roundtrip_two_nodes_converge_needs():
+    # A has 1..10 complete; B has nothing; B's needs against A cover 1..10
+    bookie_a = Bookie()
+    with bookie_a.ensure(ORIGIN).write() as bv:
+        snap = bv.snapshot()
+        snap.insert_db(NULL_GAP_STORE, RangeSet([(1, 10)]))
+        bv.commit_snapshot(snap)
+    sa = generate_sync(bookie_a, ME)
+    sb = generate_sync(Bookie(), PEER)
+    needs = compute_available_needs(sb, sa)
+    assert needs == {ORIGIN: [NeedFull((1, 10))]}
+    # and A needs nothing from B
+    assert compute_available_needs(sa, sb) == {}
+
+
+def test_chunk_range():
+    assert chunk_range(1, 25, 10) == [(1, 10), (11, 20), (21, 25)]
+    assert chunk_range(5, 5, 10) == [(5, 5)]
