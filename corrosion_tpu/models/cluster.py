@@ -47,11 +47,13 @@ class ClusterSim:
             self.params, init_key, seeds_per_member, seed_mode
         )
         self.history: List[TickMetrics] = []
+        self.ticks = 0  # host-side mirror of state.t (no device readback)
 
     def step(self, ticks: int = 1) -> None:
         for _ in range(ticks):
             self._rng, key = jax.random.split(self._rng)
             self.state = swim.tick(self.state, key, self.params)
+            self.ticks += 1
 
     def crash(self, member: int) -> None:
         self.state = swim.set_alive(self.state, member, False)
@@ -68,17 +70,18 @@ class ClusterSim:
         max_ticks: int = 10_000,
         record_every: int = 1,
     ) -> Optional[int]:
-        """Advance until live-member coverage reaches the target; returns
-        the tick count at stability or None. Records metric history."""
+        """Advance up to `max_ticks` further steps until live-member
+        coverage reaches the target; returns the (global) tick count at
+        stability or None. Records metric history. Tick counting is
+        host-side so no device readback happens between stats checks."""
         start = time.monotonic()
-        while int(self.state.t) < max_ticks:
+        for i in range(1, max_ticks + 1):
             self.step()
-            t = int(self.state.t)
-            if t % record_every == 0:
+            if i % record_every == 0:
                 s = self.stats()
                 self.history.append(
                     TickMetrics(
-                        tick=t,
+                        tick=self.ticks,
                         coverage=s["coverage"],
                         detected=s["detected"],
                         false_positive=s["false_positive"],
@@ -86,7 +89,7 @@ class ClusterSim:
                     )
                 )
                 if s["coverage"] >= coverage_target:
-                    return t
+                    return self.ticks
         return None
 
     def run_until_detected(
@@ -94,9 +97,8 @@ class ClusterSim:
     ) -> Optional[int]:
         """After a crash, advance until every live member marked the dead
         ones down; returns ticks taken or None."""
-        t0 = int(self.state.t)
-        while int(self.state.t) - t0 < max_extra_ticks:
+        for i in range(1, max_extra_ticks + 1):
             self.step()
             if self.stats()["detected"] >= detect_target:
-                return int(self.state.t) - t0
+                return i
         return None

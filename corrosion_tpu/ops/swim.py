@@ -355,7 +355,8 @@ def tick(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState:
     msg_ok = (
         sendable[:, None, :]
         & valid_tgt[:, :, None]
-        & alive[:, None, None]
+        & alive[:, None, None]  # sender must be up
+        & alive[jnp.clip(tg, 0, n - 1)][:, :, None]  # receiver must be up
     )
     drop = (
         jax.random.uniform(r_loss, msg_ok.shape) < params.loss
@@ -382,8 +383,8 @@ def tick(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState:
     # refutation: a live member hearing itself suspect/down at ≥ its inc
     about_self = (subj == dst) & (key_prec(key) >= PREC_SUSPECT)
     off_inc = jnp.where(about_self, key_inc(key), -1)
-    worst = jnp.zeros(n, jnp.int32).at[dst].max(off_inc)
-    refute = alive & (worst >= inc)
+    worst = jnp.full(n, -1, jnp.int32).at[dst].max(off_inc)
+    refute = alive & (worst >= 0) & (worst >= inc)
     inc = jnp.where(refute, worst + 1, inc)
     own_upd_subj = own_upd_subj.at[:, 2].set(jnp.where(refute, idx, n))
     own_upd_key = own_upd_key.at[:, 2].set(
@@ -409,8 +410,9 @@ def tick(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState:
         def one_feed(k, v):
             r_feed = jax.random.fold_in(r_gossip, 104729 + k)
             partner = _pick_known_alive(v, idx, r_feed, params, 2)
-            has_partner = (partner < n) & alive
             psafe = jnp.clip(partner, 0, n - 1)
+            # both ends of the exchange must actually be up
+            has_partner = (partner < n) & alive & alive[psafe]
             # per-member rotating window offset, decorrelated by member
             # index; gather only the [N, feed_entries] window (not whole
             # partner rows) so each feed stays O(N·F) at 10^5+ members

@@ -53,6 +53,7 @@ class Sender(Generic[T]):
 
     def close(self) -> None:
         self._ch.closed = True
+        self._ch.closed_event.set()
 
     @property
     def capacity_left(self) -> int:
@@ -64,11 +65,32 @@ class Receiver(Generic[T]):
         self._ch = ch
 
     async def recv(self) -> T:
-        item = await self._ch.queue.get()
-        METRICS.counter(
-            "corro.channel.message.received", channel=self._ch.name
-        ).inc()
-        return item
+        """Receive the next item; raises ChannelClosed once the channel is
+        closed AND drained (the Rust mpsc recv-returns-None contract)."""
+        while True:
+            if self._ch.closed and self._ch.queue.empty():
+                raise ChannelClosed(self._ch.name)
+            get = asyncio.ensure_future(self._ch.queue.get())
+            closed = asyncio.ensure_future(self._ch.closed_event.wait())
+            done, _ = await asyncio.wait(
+                {get, closed}, return_when=asyncio.FIRST_COMPLETED
+            )
+            closed.cancel()
+            if get in done:
+                METRICS.counter(
+                    "corro.channel.message.received", channel=self._ch.name
+                ).inc()
+                return get.result()
+            get.cancel()
+            try:
+                await get
+                # a race can complete the get during cancellation
+                METRICS.counter(
+                    "corro.channel.message.received", channel=self._ch.name
+                ).inc()
+                return get.result()
+            except asyncio.CancelledError:
+                pass
 
     def try_recv(self) -> Optional[T]:
         try:
@@ -95,6 +117,7 @@ class _Chan(Generic[T]):
         self.queue: asyncio.Queue = asyncio.Queue(maxsize=size)
         self.name = name
         self.closed = False
+        self.closed_event = asyncio.Event()
 
 
 def bounded(size: int, name: str) -> Tuple[Sender[T], Receiver[T]]:

@@ -138,15 +138,17 @@ def load_config(path: Optional[str] = None, env: Optional[dict] = None) -> Confi
         sec = getattr(cfg, section, None)
         if sec is None or not hasattr(sec, name):
             continue
-        ftype = {f.name: f.type for f in fields(sec)}.get(name)
+        ftype = str({f.name: f.type for f in fields(sec)}.get(name))
+        # with `from __future__ import annotations` field types are strings
+        # like 'Optional[int]'; match on the contained scalar type
         target = str
-        if ftype in ("int", int):
-            target = int
-        elif ftype in ("float", float):
-            target = float
-        elif ftype in ("bool", bool):
-            target = bool
-        elif "List" in str(ftype) or "list" in str(ftype):
+        if "List" in ftype or "list" in ftype:
             target = list
+        elif "bool" in ftype:
+            target = bool
+        elif "int" in ftype:
+            target = int
+        elif "float" in ftype:
+            target = float
         setattr(sec, name, _coerce(value, target))
     return cfg

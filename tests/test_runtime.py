@@ -1,0 +1,136 @@
+"""Runtime primitives: tripwire, channels, config, metrics."""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.runtime.channels import ChannelClosed, bounded
+from corrosion_tpu.runtime.config import Config, load_config
+from corrosion_tpu.runtime.metrics import Registry
+from corrosion_tpu.runtime.tripwire import Outcome, TaskTracker, Tripwire
+
+
+def test_config_defaults_and_env_overrides():
+    cfg = load_config(env={})
+    assert cfg.perf.processing_queue_len == 20_000
+    assert cfg.perf.apply_queue_len == 50
+    assert cfg.perf.max_concurrent_applies == 5
+    cfg = load_config(
+        env={
+            "CORRO_DB__PATH": "/tmp/x.db",
+            "CORRO_GOSSIP__MAX_MTU": "1400",
+            "CORRO_GOSSIP__PLAINTEXT": "false",
+            "CORRO_PERF__SYNC_INTERVAL_MAX_SECS": "30.5",
+            "CORRO_API__BIND_ADDR": "0.0.0.0:1234,0.0.0.0:1235",
+        }
+    )
+    assert cfg.db.path == "/tmp/x.db"
+    assert cfg.gossip.max_mtu == 1400  # Optional[int] coerced
+    assert cfg.gossip.plaintext is False
+    assert cfg.perf.sync_interval_max_secs == 30.5
+    assert cfg.api.bind_addr == ["0.0.0.0:1234", "0.0.0.0:1235"]
+
+
+def test_config_toml(tmp_path):
+    p = tmp_path / "corro.toml"
+    p.write_text(
+        '[db]\npath = "/data/c.db"\n[gossip]\nbootstrap = ["a:1", "b:2"]\n'
+        "[perf]\napply_queue_len = 99\n"
+    )
+    cfg = load_config(str(p))
+    assert cfg.db.path == "/data/c.db"
+    assert cfg.gossip.bootstrap == ["a:1", "b:2"]
+    assert cfg.perf.apply_queue_len == 99
+
+
+def test_metrics_registry():
+    r = Registry()
+    r.counter("x.count", kind="a").inc()
+    r.counter("x.count", kind="a").inc(2)
+    r.gauge("x.gauge").set(5)
+    r.histogram("x.lat").observe(0.3)
+    text = r.render_prometheus()
+    assert 'x_count{kind="a"} 3.0' in text
+    assert "x_gauge 5" in text
+    assert "x_lat_count 1" in text
+
+
+def test_channel_send_recv_close():
+    async def main():
+        tx, rx = bounded(4, "test")
+        await tx.send(1)
+        assert tx.try_send(2)
+        assert await rx.recv() == 1
+        assert rx.try_recv() == 2
+        # close wakes a blocked receiver
+        async def consumer():
+            items = []
+            try:
+                while True:
+                    items.append(await rx.recv())
+            except ChannelClosed:
+                return items
+
+        task = asyncio.create_task(consumer())
+        await asyncio.sleep(0.01)
+        await tx.send(3)
+        tx.close()
+        items = await asyncio.wait_for(task, 2.0)
+        assert items == [3]
+        with pytest.raises(ChannelClosed):
+            await tx.send(4)
+
+    asyncio.run(main())
+
+
+def test_channel_backpressure():
+    async def main():
+        tx, rx = bounded(2, "bp")
+        assert tx.try_send(1) and tx.try_send(2)
+        assert not tx.try_send(3)  # full
+        assert tx.capacity_left == 0
+
+    asyncio.run(main())
+
+
+def test_tripwire_preemptible():
+    async def main():
+        tw = Tripwire()
+
+        async def slow():
+            await asyncio.sleep(30)
+            return "done"
+
+        async def quick():
+            return "fast"
+
+        outcome, val = await tw.preemptible(quick())
+        assert outcome is Outcome.COMPLETED and val == "fast"
+
+        task = asyncio.create_task(tw.preemptible(slow()))
+        await asyncio.sleep(0.01)
+        tw.trip()
+        outcome, val = await asyncio.wait_for(task, 2.0)
+        assert outcome is Outcome.PREEMPTED and val is None
+        assert tw.tripped
+
+    asyncio.run(main())
+
+
+def test_task_tracker():
+    async def main():
+        tracker = TaskTracker()
+        done = []
+
+        async def work(i):
+            await asyncio.sleep(0.01)
+            done.append(i)
+
+        for i in range(5):
+            tracker.spawn(work(i))
+        assert tracker.pending == 5
+        assert await tracker.wait_all(5.0)
+        assert sorted(done) == [0, 1, 2, 3, 4]
+        assert tracker.pending == 0
+
+    asyncio.run(main())
