@@ -1,0 +1,8 @@
+"""Agent runtime: membership, broadcast, ingestion, sync, orchestration.
+
+Counterpart of the `klukai-agent` crate. The compute-heavy cluster
+simulation lives in `corrosion_tpu.ops.swim` (batched TPU kernel); this
+package is the host runtime for *real* agents — event-driven asyncio over
+the Transport seam, structured like the reference's tokio task tree but
+with channels/tripwire from `corrosion_tpu.runtime`.
+"""

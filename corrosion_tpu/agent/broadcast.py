@@ -1,0 +1,178 @@
+"""Epidemic broadcast dissemination loop.
+
+Counterpart of `klukai-agent/src/broadcast/mod.rs:410-812`: batches
+`AddBroadcast` (fresh local changes) and `Rebroadcast` inputs on a 500 ms
+/ 64 KiB cadence; ring-0 members (median RTT < 6 ms) receive local
+changes first, everyone else is reached by random infection-style fanout
+`max(num_indirect_probes, (members - ring0)/(max_transmissions*10))`;
+items re-queue with a linearly growing delay until `max_transmissions`;
+a global 10 MiB/s token bucket rate-limits egress and halves the fanout
+while saturated; the most-sent items are dropped once the pending queue
+exceeds `processing_queue_len`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Set, Tuple
+
+from corrosion_tpu.agent.handle import Agent, BroadcastInput
+from corrosion_tpu.net.transport import TransportError
+from corrosion_tpu.runtime.channels import ChannelClosed
+from corrosion_tpu.runtime.metrics import METRICS
+from corrosion_tpu.types.actor import Actor
+from corrosion_tpu.types.codec import encode_uni_payload
+
+
+class TokenBucket:
+    """10 MiB/s egress limiter (governor at broadcast/mod.rs:460-463)."""
+
+    def __init__(self, rate_bytes_per_s: float, burst: Optional[float] = None):
+        self.rate = rate_bytes_per_s
+        self.capacity = burst or rate_bytes_per_s
+        self.tokens = self.capacity
+        self.last = time.monotonic()
+
+    def try_take(self, n: int) -> bool:
+        now = time.monotonic()
+        self.tokens = min(self.capacity, self.tokens + (now - self.last) * self.rate)
+        self.last = now
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+
+@dataclass(order=True)
+class _Pending:
+    due: float
+    seq: int  # tiebreaker
+    payload: bytes = field(compare=False)
+    origin: bytes = field(compare=False)  # actor id bytes to exclude
+    send_count: int = field(compare=False, default=0)
+
+
+async def broadcast_loop(agent: Agent) -> None:
+    perf = agent.config.perf
+    bucket = TokenBucket(perf.broadcast_rate_limit_bytes)
+    pending: List[_Pending] = []  # heap by due time
+    seq = 0
+    interval = perf.broadcast_interval_ms / 1000.0
+
+    while not agent.tripwire.tripped:
+        # gather inputs for up to one interval or until the byte cutoff
+        batch: List[BroadcastInput] = []
+        batch_bytes = 0
+        deadline = time.monotonic() + interval
+        while batch_bytes < perf.broadcast_cutoff_bytes:
+            timeout = deadline - time.monotonic()
+            if timeout <= 0:
+                break
+            try:
+                item = await asyncio.wait_for(agent.rx_bcast.recv(), timeout)
+            except asyncio.TimeoutError:
+                break
+            except ChannelClosed:
+                return
+            batch.append(item)
+            cs = item.change.changeset
+            batch_bytes += sum(
+                c.estimated_byte_size() for c in getattr(cs, "changes", ())
+            )
+
+        now = time.monotonic()
+        for item in batch:
+            payload = encode_uni_payload(item.change, agent.cluster_id)
+            seq += 1
+            heapq.heappush(
+                pending,
+                _Pending(
+                    due=now,
+                    seq=seq,
+                    payload=payload,
+                    origin=item.change.actor_id.bytes16,
+                    send_count=0,
+                ),
+            )
+
+        # transmit everything due
+        max_tx = agent.membership.config.max_transmissions(
+            max(1, len(agent.members) + 1)
+        )
+        requeue: List[_Pending] = []
+        while pending and pending[0].due <= now:
+            p = heapq.heappop(pending)
+            limited = await _transmit(agent, bucket, p)
+            p.send_count += 1
+            if p.send_count < max_tx:
+                # decaying resend: 100–500 ms × count (mod.rs:759-775)
+                delay = min(0.5, 0.1 * p.send_count) * p.send_count
+                p.due = now + max(0.1, delay)
+                requeue.append(p)
+            if limited:
+                METRICS.counter("corro.broadcast.rate_limited").inc()
+        for p in requeue:
+            heapq.heappush(pending, p)
+
+        # overflow: drop the most-sent items first (mod.rs:793-812)
+        if len(pending) > perf.max_inflight_broadcasts:
+            pending.sort(key=lambda p: p.send_count)
+            dropped = len(pending) - perf.max_inflight_broadcasts
+            del pending[perf.max_inflight_broadcasts :]
+            heapq.heapify(pending)
+            METRICS.counter("corro.broadcast.dropped").inc(dropped)
+
+
+async def _transmit(agent: Agent, bucket: TokenBucket, p: _Pending) -> bool:
+    """Send one payload to its chosen targets; True if rate-limited."""
+    exclude = {agent.actor_id}
+    members = agent.members
+    cfg = agent.membership.config
+    limited = False
+    if len(p.payload) > bucket.capacity:
+        # can never pass the bucket: drop instead of spinning forever
+        METRICS.counter("corro.broadcast.oversized.dropped").inc()
+        return False
+
+    targets: List[Actor] = []
+    if p.send_count == 0:
+        # ring0 gets first-transmission priority (mod.rs:591-651)
+        targets.extend(
+            a for a in members.ring0(exclude) if a.id.bytes16 != p.origin
+        )
+    others = [
+        a
+        for a in (members.not_ring0(exclude) if p.send_count == 0 else members.all_actors())
+        if a.id.bytes16 != p.origin and a.id not in exclude
+    ]
+    n_members = len(members)
+    fanout = max(
+        cfg.num_indirect_probes,
+        (n_members - len(targets)) // (cfg.max_transmissions(n_members + 1) * 10),
+    )
+    agent.membership.rng.shuffle(others)
+    targets.extend(others[:fanout])
+
+    i = 0
+    while i < len(targets):
+        if not bucket.try_take(len(p.payload)):
+            # halve remaining fanout under rate pressure (mod.rs:668-671)
+            limited = True
+            remaining = targets[i:]
+            targets = targets[:i] + remaining[: max(1, len(remaining) // 2)]
+            await asyncio.sleep(0.01)  # let the bucket refill a little
+            continue
+        await _send_one(agent, targets[i], p.payload)
+        i += 1
+    return limited
+
+
+async def _send_one(agent: Agent, actor: Actor, payload: bytes) -> None:
+    try:
+        await agent.transport.send_uni(actor.addr, payload)
+        METRICS.counter("corro.broadcast.sent").inc()
+    except TransportError:
+        METRICS.counter("corro.broadcast.send.failed").inc()

@@ -1,0 +1,82 @@
+"""The Agent handle: shared state every subsystem hangs off.
+
+Counterpart of the `Agent` god-handle in `klukai-types/src/agent.rs:64-273`
+(actor id, pools, HLC clock, channels, members, booked versions, write
+semaphore, schema, subs/updates managers, sync-concurrency limits).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, List, Optional
+
+from corrosion_tpu.agent.members import Members
+from corrosion_tpu.agent.membership import Membership
+from corrosion_tpu.net.transport import Listener, Transport
+from corrosion_tpu.runtime.channels import Receiver, Sender
+from corrosion_tpu.runtime.config import Config
+from corrosion_tpu.runtime.tripwire import TaskTracker, Tripwire
+from corrosion_tpu.store.bookkeeping import Bookie
+from corrosion_tpu.store.crdt import CrdtStore
+from corrosion_tpu.types.actor import Actor, ActorId, ClusterId
+from corrosion_tpu.types.base import HLClock
+from corrosion_tpu.types.change import Change, ChangeV1
+
+
+class ChangeSource(Enum):
+    BROADCAST = "broadcast"
+    SYNC = "sync"
+
+
+@dataclass
+class BroadcastInput:
+    """AddBroadcast (our own fresh change) or Rebroadcast (relayed)."""
+
+    change: ChangeV1
+    is_local: bool  # True = AddBroadcast, False = Rebroadcast
+
+
+# subs/updates hook: called with every batch of impactful committed changes
+ChangeHook = Callable[[List[Change]], None]
+
+
+@dataclass
+class Agent:
+    actor: Actor
+    config: Config
+    store: CrdtStore
+    bookie: Bookie
+    clock: HLClock
+    members: Members
+    membership: Membership
+    transport: Transport
+    listener: Listener
+    tripwire: Tripwire
+    tracker: TaskTracker
+
+    tx_bcast: Sender
+    rx_bcast: Receiver
+    tx_changes: Sender
+    rx_changes: Receiver
+    tx_apply: Sender
+    rx_apply: Receiver
+
+    # SplitPool write-permit analog: one writer at a time, waiters queued
+    write_sem: asyncio.Semaphore = field(default_factory=lambda: asyncio.Semaphore(1))
+    # ≤3 concurrent inbound sync serves (agent.rs:144-146)
+    sync_serve_sem: asyncio.Semaphore = field(default_factory=lambda: asyncio.Semaphore(3))
+    change_hooks: List[ChangeHook] = field(default_factory=list)
+
+    @property
+    def actor_id(self) -> ActorId:
+        return self.actor.id
+
+    @property
+    def cluster_id(self) -> ClusterId:
+        return self.actor.cluster_id
+
+    def notify_change_hooks(self, changes: List[Change]) -> None:
+        for hook in list(self.change_hooks):
+            hook(changes)

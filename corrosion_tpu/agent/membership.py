@@ -1,0 +1,500 @@
+"""Per-node SWIM failure detector and membership gossip (foca equivalent).
+
+Behavioral counterpart of the foca-driven runtime loop in
+`klukai-agent/src/broadcast/mod.rs:121-386` plus foca's own protocol:
+round-robin probing with direct + indirect pings, suspicion with
+incarnation-numbered refutation, piggybacked membership updates with
+infection-style retransmission decay, announce/feed join, graceful leave,
+and identity `renew()` auto-rejoin when declared down
+(`klukai-types/src/actor.rs:199-206`).
+
+This is the event-driven path for *real* agents (a handful of nodes per
+process over real sockets). The 10⁴–10⁶-member batched path — the same
+state machine vectorized over the member axis — is
+`corrosion_tpu.ops.swim`; parity between the two is asserted in tests.
+
+Config scaling mirrors `foca::Config::new_wan` as applied at
+`broadcast/mod.rs:951-960`: probe cadence and suspicion windows grow with
+log(cluster size), packets stay ≤1178 B.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import math
+import random
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, Dict, List, Optional, Tuple
+
+from corrosion_tpu.net.gossip_codec import (
+    MAX_PACKET,
+    MemberState,
+    MemberUpdate,
+    MsgKind,
+    SwimMessage,
+    actor_wire_size,
+    decode_swim,
+    encode_swim,
+    update_wire_size,
+)
+from corrosion_tpu.net.transport import Transport, TransportError
+from corrosion_tpu.runtime.metrics import METRICS
+from corrosion_tpu.runtime.tripwire import Tripwire
+from corrosion_tpu.types.actor import Actor, ActorId
+
+
+@dataclass
+class SwimConfig:
+    probe_period: float = 1.0
+    probe_rtt: float = 0.4  # wait for a direct ack
+    num_indirect_probes: int = 3
+    suspicion_mult: float = 4.0  # suspect window = mult * log2(n+2) * period
+    max_transmissions_base: int = 10  # scaled down for big clusters
+    remove_down_after: float = 48 * 3600.0  # broadcast/mod.rs:953
+    announce_backoff_start: float = 5.0
+    announce_backoff_max: float = 120.0
+    announce_steady_period: float = 300.0
+
+    def suspect_timeout(self, n: int) -> float:
+        return self.suspicion_mult * math.log2(n + 2) * self.probe_period
+
+    def max_transmissions(self, n: int) -> int:
+        # infection-style: O(log n) sends suffice; foca's new_wan keeps ~10
+        return max(3, min(self.max_transmissions_base, int(math.log2(n + 2)) + 3))
+
+
+class Notification(Enum):
+    MEMBER_UP = "up"
+    MEMBER_DOWN = "down"
+    ACTIVE = "active"  # we joined / rejoined a cluster
+    DEFUNCT = "defunct"  # our identity was declared down (pre-renew)
+
+
+# precedence within one incarnation: Down > Suspect > Alive
+_PREC = {MemberState.ALIVE: 0, MemberState.SUSPECT: 1, MemberState.DOWN: 2}
+
+
+def _supersedes(
+    new_state: MemberState, new_inc: int, old_state: MemberState, old_inc: int
+) -> bool:
+    """Standard SWIM update-precedence rule."""
+    if new_inc != old_inc:
+        return new_inc > old_inc
+    return _PREC[new_state] > _PREC[old_state]
+
+
+@dataclass
+class _Member:
+    actor: Actor
+    incarnation: int = 0
+    state: MemberState = MemberState.ALIVE
+    state_since: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class _Dissemination:
+    update: MemberUpdate
+    sends_left: int
+
+
+@dataclass
+class _Probe:
+    target: Actor
+    started: float
+    indirect_sent: bool = False
+
+
+class Membership:
+    """One node's SWIM instance driving the three-way datagram dance."""
+
+    def __init__(
+        self,
+        identity: Actor,
+        transport: Transport,
+        config: Optional[SwimConfig] = None,
+        rng: Optional[random.Random] = None,
+        on_notification: Optional[Callable[[Notification, Actor], None]] = None,
+    ):
+        self.identity = identity
+        self.transport = transport
+        self.config = config or SwimConfig()
+        self.rng = rng or random.Random()
+        self.on_notification = on_notification or (lambda n, a: None)
+        self.members: Dict[ActorId, _Member] = {}
+        self.downed: Dict[ActorId, float] = {}  # id -> when declared down
+        self._queue: List[_Dissemination] = []
+        self._incarnation = 0
+        self._probe_no = 0
+        self._pending: Dict[int, _Probe] = {}
+        self._probe_ring: List[ActorId] = []
+        self._probe_pos = 0
+        self._tasks: List[asyncio.Task] = []
+
+    # -- public surface ----------------------------------------------------
+
+    @property
+    def cluster_size(self) -> int:
+        return 1 + sum(
+            1 for m in self.members.values() if m.state != MemberState.DOWN
+        )
+
+    def active_members(self) -> List[Actor]:
+        return [
+            m.actor
+            for m in self.members.values()
+            if m.state != MemberState.DOWN
+        ]
+
+    def start(self, tripwire: Tripwire) -> None:
+        self._tasks = [
+            asyncio.ensure_future(self._probe_loop(tripwire)),
+            asyncio.ensure_future(self._suspicion_loop(tripwire)),
+        ]
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await t
+
+    async def announce(self, addr: str) -> None:
+        """Join via a bootstrap address (handlers.rs:197-248)."""
+        await self._send(addr, SwimMessage(MsgKind.ANNOUNCE, 0, self.identity))
+
+    async def leave(self) -> None:
+        """Graceful departure: tell peers we're down at our own incarnation
+        (broadcast/mod.rs:327-366 leave_cluster)."""
+        update = MemberUpdate(
+            self.identity, self._incarnation, MemberState.DOWN
+        )
+        targets = self.active_members()
+        self.rng.shuffle(targets)
+        for actor in targets[: max(3, self.config.num_indirect_probes)]:
+            msg = SwimMessage(
+                MsgKind.LEAVE, 0, self.identity, updates=[update]
+            )
+            await self._send(actor.addr, msg)
+
+    def apply_many(self, states: List[Tuple[Actor, int, MemberState]]) -> None:
+        """Resurrect persisted member states on startup (util.rs:74-111)."""
+        for actor, incarnation, state in states:
+            self._apply_update(MemberUpdate(actor, incarnation, state))
+
+    # -- sending -----------------------------------------------------------
+
+    async def _send(self, addr: str, msg: SwimMessage) -> None:
+        self._piggyback(msg)
+        data = encode_swim(msg)
+        try:
+            await self.transport.send_datagram(addr, data)
+            METRICS.counter("corro.gossip.message.sent", kind=msg.kind.name).inc()
+        except TransportError:
+            METRICS.counter("corro.gossip.send.failed").inc()
+
+    def _piggyback(self, msg: SwimMessage) -> None:
+        """Fill the remaining packet budget with queued updates, fewest
+        sends first (infection-style dissemination)."""
+        budget = MAX_PACKET - 64 - actor_wire_size(msg.sender)
+        if msg.target:
+            budget -= actor_wire_size(msg.target)
+        if msg.origin:
+            budget -= actor_wire_size(msg.origin)
+        self._queue.sort(key=lambda d: -d.sends_left)
+        kept: List[_Dissemination] = []
+        for d in self._queue:
+            size = update_wire_size(d.update)
+            if budget - size >= 0 and len(msg.updates) < 64:
+                msg.updates.append(d.update)
+                budget -= size
+                d.sends_left -= 1
+                if d.sends_left > 0:
+                    kept.append(d)
+            else:
+                kept.append(d)
+        self._queue = kept
+
+    def _disseminate(self, update: MemberUpdate) -> None:
+        n = self.cluster_size
+        # replace any queued assertion about the same actor
+        self._queue = [
+            d for d in self._queue if d.update.actor.id != update.actor.id
+        ]
+        self._queue.append(
+            _Dissemination(update, self.config.max_transmissions(n))
+        )
+
+    # -- update application -------------------------------------------------
+
+    def _apply_update(self, u: MemberUpdate) -> bool:
+        """Merge one membership assertion; True if it changed our view."""
+        if u.actor.id == self.identity.id:
+            return self._apply_self_update(u)
+        cur = self.members.get(u.actor.id)
+        replaced_old: Optional[_Member] = None
+        if cur is not None:
+            cur_identity = (cur.actor.ts, cur.actor.bump)
+            new_identity = (u.actor.ts, u.actor.bump)
+            if new_identity < cur_identity:
+                return False  # stale assertion about a renewed identity
+            if new_identity > cur_identity:
+                # renewed identity: brand-new member lifecycle
+                replaced_old = cur
+                cur = None
+        if cur is None:
+            if u.state == MemberState.DOWN:
+                self.downed.setdefault(u.actor.id, time.monotonic())
+                if replaced_old is not None:
+                    # the renewed identity died: retire the stale record
+                    del self.members[u.actor.id]
+                    self._disseminate(u)
+                    if replaced_old.state != MemberState.DOWN:
+                        self.on_notification(
+                            Notification.MEMBER_DOWN, u.actor
+                        )
+                        METRICS.counter("corro.gossip.member.removed").inc()
+                    return True
+                return False
+            self.members[u.actor.id] = _Member(
+                actor=u.actor, incarnation=u.incarnation, state=u.state
+            )
+            self.downed.pop(u.actor.id, None)
+            if u.actor.id not in self._probe_ring:
+                self._probe_ring.append(u.actor.id)
+            self._disseminate(u)
+            # fires for renewed identities too: Members.add_member must
+            # refresh to the new ts/bump
+            self.on_notification(Notification.MEMBER_UP, u.actor)
+            METRICS.counter("corro.gossip.member.added").inc()
+            return True
+        if not _supersedes(u.state, u.incarnation, cur.state, cur.incarnation):
+            return False
+        was_active = cur.state != MemberState.DOWN
+        cur.actor = u.actor
+        cur.incarnation = u.incarnation
+        cur.state = u.state
+        cur.state_since = time.monotonic()
+        self._disseminate(u)
+        if u.state == MemberState.DOWN:
+            del self.members[u.actor.id]
+            self.downed[u.actor.id] = time.monotonic()
+            if was_active:
+                self.on_notification(Notification.MEMBER_DOWN, u.actor)
+                METRICS.counter("corro.gossip.member.removed").inc()
+        return True
+
+    def _apply_self_update(self, u: MemberUpdate) -> bool:
+        """Refute suspicion; renew identity if declared down (actor.rs:199)."""
+        if (u.actor.ts, u.actor.bump) < (self.identity.ts, self.identity.bump):
+            return False  # about an identity we already renewed past
+        if u.state == MemberState.SUSPECT and u.incarnation >= self._incarnation:
+            self._incarnation = u.incarnation + 1
+            self._disseminate(
+                MemberUpdate(
+                    self.identity, self._incarnation, MemberState.ALIVE
+                )
+            )
+            METRICS.counter("corro.gossip.self.refuted").inc()
+            return True
+        if u.state == MemberState.DOWN and u.incarnation >= self._incarnation:
+            self.on_notification(Notification.DEFUNCT, self.identity)
+            self.identity = self.identity.renew()
+            self._incarnation = 0
+            self._disseminate(
+                MemberUpdate(self.identity, 0, MemberState.ALIVE)
+            )
+            self.on_notification(Notification.ACTIVE, self.identity)
+            METRICS.counter("corro.gossip.self.renewed").inc()
+            return True
+        return False
+
+    # -- inbound -----------------------------------------------------------
+
+    async def handle_datagram(self, src: str, data: bytes) -> None:
+        try:
+            msg = decode_swim(data)
+        except (ValueError, IndexError):
+            METRICS.counter("corro.gossip.decode.failed").inc()
+            return
+        if msg.sender.cluster_id != self.identity.cluster_id:
+            return
+        if msg.sender.id != self.identity.id:
+            self._apply_update(
+                MemberUpdate(msg.sender, 0, MemberState.ALIVE)
+            )
+        for u in msg.updates:
+            self._apply_update(u)
+
+        k, me = msg.kind, self.identity
+        if k == MsgKind.PING:
+            await self._send(
+                msg.sender.addr, SwimMessage(MsgKind.ACK, msg.probe_no, me)
+            )
+        elif k == MsgKind.ACK:
+            self._on_ack(msg.probe_no, msg.sender)
+        elif k == MsgKind.PING_REQ and msg.target is not None:
+            await self._send(
+                msg.target.addr,
+                SwimMessage(
+                    MsgKind.INDIRECT_PING,
+                    msg.probe_no,
+                    me,
+                    target=msg.target,
+                    origin=msg.sender,
+                ),
+            )
+        elif k == MsgKind.INDIRECT_PING and msg.origin is not None:
+            await self._send(
+                msg.sender.addr,
+                SwimMessage(
+                    MsgKind.INDIRECT_ACK,
+                    msg.probe_no,
+                    me,
+                    origin=msg.origin,
+                ),
+            )
+        elif k == MsgKind.INDIRECT_ACK and msg.origin is not None:
+            await self._send(
+                msg.origin.addr,
+                SwimMessage(
+                    MsgKind.FORWARDED_ACK,
+                    msg.probe_no,
+                    me,
+                    target=msg.sender,
+                ),
+            )
+        elif k == MsgKind.FORWARDED_ACK:
+            acked = msg.target or msg.sender
+            self._on_ack(msg.probe_no, acked)
+        elif k == MsgKind.ANNOUNCE:
+            await self._on_announce(msg.sender)
+        elif k == MsgKind.FEED:
+            pass  # updates already applied above
+        elif k == MsgKind.LEAVE:
+            pass  # the DOWN update rode in msg.updates
+
+    async def _on_announce(self, joiner: Actor) -> None:
+        """Reply with a membership snapshot that fits one packet."""
+        self._disseminate(MemberUpdate(joiner, 0, MemberState.ALIVE))
+        feed = SwimMessage(MsgKind.FEED, 0, self.identity)
+        sample = [
+            MemberUpdate(m.actor, m.incarnation, m.state)
+            for m in self.members.values()
+            if m.actor.id != joiner.id
+        ]
+        self.rng.shuffle(sample)
+        budget = MAX_PACKET - 64 - actor_wire_size(self.identity)
+        for u in sample:
+            size = update_wire_size(u)
+            if budget - size < 0:
+                break
+            feed.updates.append(u)
+            budget -= size
+        await self.transport.send_datagram(joiner.addr, encode_swim(feed))
+
+    def _on_ack(self, probe_no: int, from_actor: Actor) -> None:
+        probe = self._pending.get(probe_no)
+        if probe is None or probe.target.id != from_actor.id:
+            return
+        del self._pending[probe_no]
+        rtt = time.monotonic() - probe.started
+        self.transport.observe_rtt(probe.target.addr, rtt)
+        m = self.members.get(from_actor.id)
+        if m is not None and m.state == MemberState.SUSPECT:
+            # direct evidence of life clears our own suspicion
+            self._apply_update(
+                MemberUpdate(m.actor, m.incarnation + 1, MemberState.ALIVE)
+            )
+
+    # -- probe cycle ---------------------------------------------------------
+
+    def _next_probe_target(self) -> Optional[Actor]:
+        ring = [
+            aid
+            for aid in self._probe_ring
+            if aid in self.members
+            and self.members[aid].state != MemberState.DOWN
+        ]
+        self._probe_ring = ring
+        if not ring:
+            return None
+        if self._probe_pos >= len(ring):
+            self.rng.shuffle(self._probe_ring)
+            self._probe_pos = 0
+        actor_id = self._probe_ring[self._probe_pos]
+        self._probe_pos += 1
+        return self.members[actor_id].actor
+
+    async def _probe_loop(self, tripwire: Tripwire) -> None:
+        cfg = self.config
+        while not tripwire.tripped:
+            await asyncio.sleep(cfg.probe_period)
+            target = self._next_probe_target()
+            if target is None:
+                continue
+            self._probe_no += 1
+            probe_no = self._probe_no
+            self._pending[probe_no] = _Probe(target, time.monotonic())
+            await self._send(
+                target.addr, SwimMessage(MsgKind.PING, probe_no, self.identity)
+            )
+            asyncio.ensure_future(self._probe_escalation(probe_no))
+
+    async def _probe_escalation(self, probe_no: int) -> None:
+        cfg = self.config
+        await asyncio.sleep(cfg.probe_rtt)
+        probe = self._pending.get(probe_no)
+        if probe is None:
+            return  # acked
+        probe.indirect_sent = True
+        target = probe.target
+        helpers = [
+            m.actor
+            for m in self.members.values()
+            if m.state == MemberState.ALIVE and m.actor.id != target.id
+        ]
+        self.rng.shuffle(helpers)
+        for helper in helpers[: cfg.num_indirect_probes]:
+            await self._send(
+                helper.addr,
+                SwimMessage(
+                    MsgKind.PING_REQ,
+                    probe_no,
+                    self.identity,
+                    target=target,
+                ),
+            )
+        await asyncio.sleep(2 * cfg.probe_rtt)
+        probe = self._pending.pop(probe_no, None)
+        if probe is None:
+            return  # indirectly acked
+        m = self.members.get(target.id)
+        if m is not None and m.state == MemberState.ALIVE:
+            self._apply_update(
+                MemberUpdate(m.actor, m.incarnation, MemberState.SUSPECT)
+            )
+            METRICS.counter("corro.gossip.member.suspected").inc()
+
+    async def _suspicion_loop(self, tripwire: Tripwire) -> None:
+        """Expire suspects to Down; forget long-Down members."""
+        cfg = self.config
+        while not tripwire.tripped:
+            await asyncio.sleep(cfg.probe_period)
+            now = time.monotonic()
+            timeout = cfg.suspect_timeout(self.cluster_size)
+            expired = [
+                m
+                for m in self.members.values()
+                if m.state == MemberState.SUSPECT
+                and now - m.state_since > timeout
+            ]
+            for m in expired:
+                self._apply_update(
+                    MemberUpdate(m.actor, m.incarnation, MemberState.DOWN)
+                )
+            cutoff = now - cfg.remove_down_after
+            self.downed = {
+                aid: t for aid, t in self.downed.items() if t > cutoff
+            }

@@ -1,0 +1,269 @@
+"""Agent assembly and lifecycle: setup → run → shutdown.
+
+Counterparts:
+  - `setup()` (`klukai-agent/src/agent/setup.rs:74-289`): open the store,
+    derive the actor identity from the site id, apply schema files, bind
+    the gossip endpoint, create the channel graph from PerfConfig, warm
+    the bookie from durable state.
+  - `start_with_config`/`run` (`agent/run_root.rs:32-234`): wire the SWIM
+    loop, broadcast loop, ingestion loop, apply loop, sync loop, gossip
+    server handlers and announcers, then hand back the Agent handle.
+  - local write path `make_broadcastable_changes`
+    (`api/public/mod.rs:57-258`) + `broadcast_changes`
+    (`klukai-types/src/broadcast.rs:605-675`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import random
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, List, Optional, Tuple
+
+from corrosion_tpu.agent.broadcast import broadcast_loop
+from corrosion_tpu.agent.handle import Agent, BroadcastInput, ChangeSource
+from corrosion_tpu.agent.ingest import (
+    apply_fully_buffered_loop,
+    handle_changes,
+)
+from corrosion_tpu.agent.members import Members
+from corrosion_tpu.agent.membership import (
+    Membership,
+    Notification,
+    SwimConfig,
+)
+from corrosion_tpu.agent.syncer import serve_sync, sync_loop
+from corrosion_tpu.net.mem import MemNetwork
+from corrosion_tpu.net.tcp import TcpListener, TcpTransport
+from corrosion_tpu.net.transport import BiStream
+from corrosion_tpu.runtime.channels import bounded
+from corrosion_tpu.runtime.config import Config
+from corrosion_tpu.runtime.metrics import METRICS
+from corrosion_tpu.runtime.tripwire import TaskTracker, Tripwire
+from corrosion_tpu.store.bookkeeping import Bookie
+from corrosion_tpu.store.crdt import CrdtStore
+from corrosion_tpu.types.actor import Actor, ClusterId
+from corrosion_tpu.types.base import HLClock, Timestamp
+from corrosion_tpu.types.change import ChangeV1, ChangesetFull, chunk_changes
+from corrosion_tpu.types.codec import decode_uni_payload
+from corrosion_tpu.types.rangeset import RangeSet
+
+
+async def setup(
+    config: Config,
+    network: Optional[MemNetwork] = None,
+    tripwire: Optional[Tripwire] = None,
+) -> Agent:
+    tripwire = tripwire or Tripwire()
+    store = CrdtStore(config.db.path)
+    for schema_path in config.db.schema_paths:
+        sql = Path(schema_path).read_text()
+        store.apply_schema_sql(sql)
+    clock = HLClock()
+
+    if network is not None:
+        addr = config.gossip.bind_addr
+        listener = network.listener(addr)
+        transport = network.transport(addr)
+    else:
+        host, _, port = config.gossip.bind_addr.rpartition(":")
+        listener = await TcpListener.bind(host or "127.0.0.1", int(port))
+        transport = TcpTransport(listener)
+
+    gossip_addr = config.gossip.external_addr or listener.addr
+    actor = Actor(
+        id=store.site_id,
+        addr=gossip_addr,
+        ts=clock.new_timestamp(),
+        cluster_id=ClusterId(config.gossip.cluster_id),
+    )
+
+    perf = config.perf
+    tx_bcast, rx_bcast = bounded(perf.bcast_channel_len, "broadcast")
+    tx_changes, rx_changes = bounded(perf.changes_channel_len, "changes")
+    tx_apply, rx_apply = bounded(perf.apply_channel_len, "apply")
+
+    members = Members()
+    membership = Membership(
+        actor,
+        transport,
+        SwimConfig(),
+        rng=random.Random(actor.id.bytes16[:8].hex()),
+    )
+    transport.set_rtt_sink(members.observe_rtt)
+
+    bookie = Bookie()
+    for aid in store.booked_actor_ids():
+        bookie.insert(aid, store.load_booked_versions(aid))
+
+    agent = Agent(
+        actor=actor,
+        config=config,
+        store=store,
+        bookie=bookie,
+        clock=clock,
+        members=members,
+        membership=membership,
+        transport=transport,
+        listener=listener,
+        tripwire=tripwire,
+        tracker=TaskTracker(),
+        tx_bcast=tx_bcast,
+        rx_bcast=rx_bcast,
+        tx_changes=tx_changes,
+        rx_changes=rx_changes,
+        tx_apply=tx_apply,
+        rx_apply=rx_apply,
+    )
+
+    # SWIM notifications keep the member view current (handlers.rs:283-373)
+    def on_notification(note: Notification, peer: Actor) -> None:
+        if note == Notification.MEMBER_UP:
+            agent.members.add_member(peer)
+        elif note == Notification.MEMBER_DOWN:
+            agent.members.remove_member(peer)
+        elif note == Notification.ACTIVE and peer.id == agent.actor.id:
+            agent.actor = peer  # renewed identity after being declared down
+
+    membership.on_notification = on_notification
+    return agent
+
+
+async def run(agent: Agent) -> None:
+    """Start every loop; returns immediately (tasks run until tripwire)."""
+
+    async def on_datagram(src: str, data: bytes) -> None:
+        await agent.membership.handle_datagram(src, data)
+
+    async def on_uni(src: str, frame: bytes) -> None:
+        try:
+            cv, cluster_id = decode_uni_payload(frame)
+        except (ValueError, IndexError):
+            METRICS.counter("corro.agent.uni.decode.failed").inc()
+            return
+        if cluster_id != agent.cluster_id:
+            return
+        if cv.actor_id == agent.actor_id:
+            return  # our own broadcast reflected back
+        agent.tx_changes.try_send((cv, ChangeSource.BROADCAST))
+
+    async def on_bi(stream: BiStream) -> None:
+        await serve_sync(agent, stream)
+
+    agent.listener.serve(on_datagram, on_uni, on_bi)
+    agent.membership.start(agent.tripwire)
+    t = agent.tracker
+    t.spawn(handle_changes(agent))
+    t.spawn(apply_fully_buffered_loop(agent))
+    t.spawn(broadcast_loop(agent))
+    t.spawn(sync_loop(agent))
+    if agent.config.gossip.bootstrap:
+        t.spawn(_announcer(agent))
+    # schedule fully-buffered applies for partials already complete on disk
+    for actor_id, booked in agent.bookie.items().items():
+        with booked.read() as bv:
+            done = [v for v, p in bv.partials.items() if p.is_complete()]
+        for version in done:
+            agent.tx_apply.try_send((actor_id, version))
+
+
+async def _announcer(agent: Agent) -> None:
+    """Announce to bootstrap addresses with backoff 5 s → 120 s, then a
+    steady 300 s re-announce (handlers.rs:197-248)."""
+    cfg = agent.membership.config
+    delay = cfg.announce_backoff_start
+    while not agent.tripwire.tripped:
+        for addr in agent.config.gossip.bootstrap:
+            if addr != agent.actor.addr:
+                await agent.membership.announce(addr)
+        if len(agent.members) > 0:
+            delay = cfg.announce_steady_period
+        else:
+            delay = min(delay * 2, cfg.announce_backoff_max)
+        with contextlib.suppress(asyncio.TimeoutError):
+            await asyncio.wait_for(agent.tripwire.wait(), delay)
+
+
+async def shutdown(agent: Agent) -> None:
+    """Graceful: leave the cluster, trip, drain counted tasks ≤60 s."""
+    with contextlib.suppress(Exception):
+        await agent.membership.leave()
+    agent.tripwire.trip()
+    agent.tx_changes.close()
+    agent.tx_bcast.close()
+    agent.tx_apply.close()
+    await agent.membership.stop()
+    await agent.tracker.wait_all(timeout=60.0)
+    await agent.transport.close()
+    await agent.listener.close()
+    agent.store.close()
+
+
+# -- local write path ------------------------------------------------------
+
+
+@dataclass
+class ExecResult:
+    rows_affected: int
+    results: List[object]
+    version: int  # db_version assigned (0 = no changes)
+
+
+async def make_broadcastable_changes(
+    agent: Agent, fn: Callable[["object"], List[object]]
+) -> ExecResult:
+    """Run local statements in one write tx, then broadcast the committed
+    changes (the `/v1/transactions` path, api/public/mod.rs:57-258).
+
+    `fn(tx)` executes statements against the WriteTx and returns
+    per-statement results.
+    """
+    async with agent.write_sem:
+        ts = agent.clock.new_timestamp()
+        booked = agent.bookie.ensure(agent.actor_id)
+
+        def txn() -> Tuple[List[object], list, int, int]:
+            with booked.write("make_broadcastable_changes"):
+                with agent.store.write_tx(ts) as tx:
+                    results = fn(tx)
+                    changes, db_version, last_seq = tx.commit()
+                if db_version:
+                    agent.store.record_last_seq(
+                        agent.actor_id, db_version, last_seq
+                    )
+                with booked.write("commit bookkeeping") as bv:
+                    if db_version:
+                        snap = bv.snapshot()
+                        snap.insert_db(
+                            agent.store.gap_store(),
+                            RangeSet([(db_version, db_version)]),
+                        )
+                        bv.commit_snapshot(snap)
+                return results, changes, db_version, last_seq
+
+        results, changes, db_version, last_seq = await asyncio.to_thread(txn)
+
+    if changes:
+        agent.notify_change_hooks(changes)
+        for chunk, seqs in chunk_changes(changes, last_seq):
+            cv = ChangeV1(
+                actor_id=agent.actor_id,
+                changeset=ChangesetFull(
+                    version=db_version,
+                    changes=tuple(chunk),
+                    seqs=seqs,
+                    last_seq=last_seq,
+                    ts=ts,
+                ),
+            )
+            await agent.tx_bcast.send(BroadcastInput(change=cv, is_local=True))
+    rows = sum(r for r in _int_results(results))
+    return ExecResult(rows_affected=rows, results=results, version=db_version)
+
+
+def _int_results(results: List[object]):
+    for r in results:
+        if isinstance(r, int):
+            yield r

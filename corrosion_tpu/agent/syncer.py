@@ -1,0 +1,507 @@
+"""Anti-entropy sync sessions over bi-streams.
+
+Counterparts:
+  - client `parallel_sync` (`klukai-agent/src/api/peer/mod.rs:1082-1482`):
+    open a bi-stream to N chosen peers concurrently, exchange
+    SyncStart + Clock for State + Clock, derive requests with
+    `compute_available_needs`, dedupe ranges across peers, stream
+    received changesets into the ingestion pipeline.
+  - server `serve_sync` (`peer/mod.rs:1485-1728`): reject foreign
+    clusters and >3 concurrent sessions, send own State + Clock, then
+    serve each request batch from the store (`handle_need`,
+    `peer/mod.rs:450-984`) — live versions stream as ≤8 KiB Full chunks,
+    overwritten versions collapse into `ChangesetEmptySet`, partially
+    buffered versions serve their buffered seq ranges.
+  - scheduler (`agent/handlers.rs:796-897`): every 1–15 s pick
+    `clamp(members/100, min, max)` peers by (need, last-sync, RTT ring).
+
+The wire protocol frames `SyncMessage`s with the u32-BE length prefix; a
+side that has nothing more to say half-closes, and a session ends when
+both sides have seen EOF — the same stop condition as the reference's
+peer-stopped-stream bookkeeping.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Dict, List, Optional, Tuple
+
+from corrosion_tpu.agent.handle import Agent, ChangeSource
+from corrosion_tpu.net.transport import BiStream, TransportError
+from corrosion_tpu.runtime.metrics import METRICS
+from corrosion_tpu.sync import (
+    chunk_range,
+    compute_available_needs,
+    generate_sync,
+    state_need_len,
+)
+from corrosion_tpu.types.actor import Actor, ActorId
+from corrosion_tpu.types.base import Timestamp
+from corrosion_tpu.types.change import (
+    ChangeV1,
+    ChangesetEmptySet,
+    ChangesetFull,
+    chunk_changes,
+)
+from corrosion_tpu.types.codec import (
+    NeedEmpty,
+    NeedFull,
+    NeedPartial,
+    SyncRejection,
+    SyncState,
+    decode_bi_payload,
+    decode_sync_msg,
+    encode_bi_payload_sync_start,
+    encode_sync_msg,
+)
+from corrosion_tpu.types.rangeset import RangeSet
+
+MAX_NEEDS_PER_TURN = 10  # peer/mod.rs: round-robin ≤10 needs/peer/turn
+VERSIONS_PER_CHUNK = 10  # chunk Full ranges to ≤10 versions
+RECV_TIMEOUT = 10.0
+
+
+# -- server ----------------------------------------------------------------
+
+
+async def serve_sync(agent: Agent, stream: BiStream) -> None:
+    """Handle one inbound sync session."""
+    try:
+        first = await asyncio.wait_for(stream.recv(), RECV_TIMEOUT)
+        if first is None:
+            return
+        peer_actor_id, _trace, cluster_id = decode_bi_payload(first)
+        if cluster_id != agent.cluster_id:
+            await stream.send(encode_sync_msg(SyncRejection(reason=1)))
+            await stream.finish()
+            return
+        if agent.sync_serve_sem.locked():
+            await stream.send(encode_sync_msg(SyncRejection(reason=2)))
+            await stream.finish()
+            return
+        async with agent.sync_serve_sem:
+            await _serve_sync_inner(agent, stream, peer_actor_id)
+    except (asyncio.TimeoutError, TransportError, ValueError):
+        METRICS.counter("corro.sync.server.failed").inc()
+    finally:
+        stream.close()
+
+
+async def _serve_sync_inner(
+    agent: Agent, stream: BiStream, peer_actor_id: ActorId
+) -> None:
+    METRICS.counter("corro.sync.server.started").inc()
+    state = generate_sync(agent.bookie, agent.actor_id)
+    await stream.send(encode_sync_msg(agent.clock.new_timestamp()))
+    await stream.send(encode_sync_msg(state))
+
+    sent = 0
+    while True:
+        frame = await asyncio.wait_for(stream.recv(), RECV_TIMEOUT)
+        if frame is None:
+            break
+        msg = decode_sync_msg(frame)
+        if isinstance(msg, Timestamp):
+            agent.clock.update_with_timestamp(msg)
+            continue
+        if not isinstance(msg, list):
+            continue  # unexpected; ignore like unknown requests
+        for actor_id, needs in msg:
+            for need in needs:
+                sent += await _handle_need(agent, stream, actor_id, need)
+    await stream.finish()
+    METRICS.counter("corro.sync.server.changes.sent").inc(sent)
+
+
+async def _handle_need(
+    agent: Agent, stream: BiStream, actor_id: ActorId, need
+) -> int:
+    """Serve one need from the store; returns changes sent
+    (peer/mod.rs:450-806)."""
+    store = agent.store
+    sent = 0
+    if isinstance(need, NeedFull):
+        start, end = need.versions
+        served = RangeSet()
+        loop = asyncio.get_running_loop()
+
+        def read_versions():
+            # snapshot-isolated read conn: never observe a writer thread's
+            # in-flight BEGIN IMMEDIATE on the shared write connection
+            conn = store.read_conn()
+            try:
+                out = []
+                for version, changes in store.changes_for_versions(
+                    actor_id, start, end, conn=conn
+                ):
+                    out.append(
+                        (
+                            version,
+                            changes,
+                            store.last_seq_for_version(
+                                actor_id, version, conn=conn
+                            ),
+                        )
+                    )
+                return out
+            finally:
+                conn.close()
+
+        version_iter = await loop.run_in_executor(None, read_versions)
+        for version, changes, last_seq in version_iter:
+            served.insert(version, version)
+            if last_seq is None:
+                last_seq = changes[-1].seq if changes else 0
+            for chunk, seqs in chunk_changes(changes, last_seq):
+                cv = ChangeV1(
+                    actor_id=actor_id,
+                    changeset=ChangesetFull(
+                        version=version,
+                        changes=tuple(chunk),
+                        seqs=seqs,
+                        last_seq=last_seq,
+                        ts=chunk[-1].ts if chunk else Timestamp(0),
+                    ),
+                )
+                await stream.send(encode_sync_msg(cv))
+                sent += len(chunk)
+        # versions we know (≤ our head for this actor) but have no live
+        # rows for were overwritten/cleared → EmptySet (peer/mod.rs:532-566)
+        empties = _empty_versions(agent, actor_id, start, end, served)
+        if empties:
+            cv = ChangeV1(
+                actor_id=actor_id,
+                changeset=ChangesetEmptySet(
+                    versions=tuple(empties), ts=agent.clock.new_timestamp()
+                ),
+            )
+            await stream.send(encode_sync_msg(cv))
+    elif isinstance(need, NeedPartial):
+        version = need.version
+
+        def read_partial():
+            conn = store.read_conn()
+            try:
+                buffered = store.take_buffered_version(
+                    actor_id, version, conn=conn
+                )
+                true_last = store.buffered_last_seq(
+                    actor_id, version, conn=conn
+                )
+                covered = store.buffered_seq_ranges(
+                    actor_id, version, conn=conn
+                )
+                live = []
+                if not buffered:
+                    # maybe fully applied since the peer's summary —
+                    # serve from live rows
+                    for v2, changes in store.changes_for_versions(
+                        actor_id, version, version, conn=conn
+                    ):
+                        live.append(
+                            (
+                                v2,
+                                changes,
+                                store.last_seq_for_version(
+                                    actor_id, v2, conn=conn
+                                ),
+                            )
+                        )
+                return buffered, true_last, covered, live
+            finally:
+                conn.close()
+
+        (
+            buffered,
+            true_last,
+            covered,
+            live,
+        ) = await asyncio.get_running_loop().run_in_executor(None, read_partial)
+        # only claim seq ranges we actually hold (wanted ∩ covered)
+        requested = RangeSet(list(need.seqs))
+        wanted = RangeSet()
+        for s, e in requested:
+            for cs_, ce in covered.overlapping(s, e):
+                wanted.insert(max(s, cs_), min(e, ce))
+        chosen = [c for c in buffered if wanted.contains(c.seq)]
+        if chosen:
+            # the version's REAL final seq — never the buffered max, or a
+            # half version would be applied as complete by the peer
+            last_seq = (
+                true_last
+                if true_last is not None
+                else max(c.seq for c in buffered)
+            )
+            for chunk, chunk_seqs in _partial_chunks(chosen, wanted):
+                cv = ChangeV1(
+                    actor_id=actor_id,
+                    changeset=ChangesetFull(
+                        version=version,
+                        changes=tuple(chunk),
+                        seqs=chunk_seqs,
+                        last_seq=last_seq,
+                        ts=chunk[-1].ts if chunk else Timestamp(0),
+                    ),
+                )
+                await stream.send(encode_sync_msg(cv))
+                sent += len(chunk)
+        else:
+            for version2, changes, last_seq in live:
+                if last_seq is None:
+                    last_seq = changes[-1].seq if changes else 0
+                for chunk, seqs in chunk_changes(changes, last_seq):
+                    cv = ChangeV1(
+                        actor_id=actor_id,
+                        changeset=ChangesetFull(
+                            version=version2,
+                            changes=tuple(chunk),
+                            seqs=seqs,
+                            last_seq=last_seq,
+                            ts=chunk[-1].ts if chunk else Timestamp(0),
+                        ),
+                    )
+                    await stream.send(encode_sync_msg(cv))
+                    sent += len(chunk)
+    elif isinstance(need, NeedEmpty):
+        pass  # informational
+    return sent
+
+
+def _partial_chunks(changes, wanted: RangeSet):
+    """Chunk partial-need serves per requested seq range (≤8 KiB each) so
+    each emitted `seqs` range covers exactly a served sub-range
+    (peer/mod.rs:568-614)."""
+    from corrosion_tpu.types.change import MAX_CHANGES_BYTE_SIZE
+
+    for rs, re_ in wanted:
+        in_range = [c for c in changes if rs <= c.seq <= re_]
+        if not in_range:
+            continue
+        buf, size, start = [], 0, rs
+        for c in in_range:
+            buf.append(c)
+            size += c.estimated_byte_size()
+            if size >= MAX_CHANGES_BYTE_SIZE:
+                yield buf, (start, c.seq)
+                start = c.seq + 1
+                buf, size = [], 0
+        if buf:
+            yield buf, (start, re_)
+
+
+def _empty_versions(
+    agent: Agent, actor_id: ActorId, start: int, end: int, served: RangeSet
+) -> List[Tuple[int, int]]:
+    booked = agent.bookie.get(actor_id)
+    if booked is None:
+        return []
+    with booked.read() as bv:
+        head = bv.last() or 0
+        empties = RangeSet()
+        hi = min(end, head)
+        if start <= hi:
+            empties.insert(start, hi)
+        for s, e in served:
+            empties.remove(s, e)
+        # don't claim versions we ourselves still need or only have partially
+        for s, e in bv.needed:
+            empties.remove(s, e)
+        for v in bv.partials:
+            empties.remove(v, v)
+        return list(empties)
+
+
+# -- client ----------------------------------------------------------------
+
+
+async def parallel_sync(
+    agent: Agent, peers: List[Actor], ours: Optional[SyncState] = None
+) -> int:
+    """Sync with several peers concurrently; returns changes received."""
+    if ours is None:
+        ours = generate_sync(agent.bookie, agent.actor_id)
+    # cross-peer dedupe of requested ranges (peer/mod.rs:1274-1351)
+    req_full: Dict[ActorId, RangeSet] = {}
+    req_partials: Dict[Tuple[ActorId, int], RangeSet] = {}
+    lock = asyncio.Lock()
+    results = await asyncio.gather(
+        *(
+            _sync_one_peer(agent, peer, ours, req_full, req_partials, lock)
+            for peer in peers
+        ),
+        return_exceptions=True,
+    )
+    total = 0
+    for peer, res in zip(peers, results):
+        if isinstance(res, BaseException):
+            METRICS.counter("corro.sync.client.failed").inc()
+        else:
+            total += res
+            info = agent.members.get(peer.id)
+            if info is not None:
+                info.last_sync_ts = agent.clock.new_timestamp().ntp64
+    return total
+
+
+async def _sync_one_peer(
+    agent: Agent,
+    peer: Actor,
+    ours: SyncState,
+    req_full: Dict[ActorId, RangeSet],
+    req_partials: Dict[Tuple[ActorId, int], RangeSet],
+    lock: asyncio.Lock,
+) -> int:
+    stream = await agent.transport.open_bi(peer.addr)
+    try:
+        await stream.send(
+            encode_bi_payload_sync_start(
+                agent.actor_id, cluster_id=agent.cluster_id
+            )
+        )
+        await stream.send(encode_sync_msg(agent.clock.new_timestamp()))
+
+        theirs: Optional[SyncState] = None
+        while theirs is None:
+            frame = await asyncio.wait_for(stream.recv(), RECV_TIMEOUT)
+            if frame is None:
+                return 0
+            msg = decode_sync_msg(frame)
+            if isinstance(msg, Timestamp):
+                agent.clock.update_with_timestamp(msg)
+            elif isinstance(msg, SyncRejection):
+                METRICS.counter("corro.sync.client.rejected").inc()
+                return 0
+            elif isinstance(msg, SyncState):
+                theirs = msg
+
+        needs = compute_available_needs(ours, theirs)
+        # claim ranges not already requested from another peer
+        request: List[Tuple[ActorId, List[object]]] = []
+        async with lock:
+            for actor_id, need_list in needs.items():
+                claimed: List[object] = []
+                for need in need_list:
+                    if isinstance(need, NeedFull):
+                        got = req_full.setdefault(actor_id, RangeSet())
+                        s, e = need.versions
+                        fresh = RangeSet([(s, e)])
+                        for gs, ge in got.overlapping(s, e):
+                            fresh.remove(gs, ge)
+                        for fs, fe in list(fresh):
+                            got.insert(fs, fe)
+                            for cs_, ce in chunk_range(
+                                fs, fe, VERSIONS_PER_CHUNK
+                            ):
+                                claimed.append(NeedFull((cs_, ce)))
+                    elif isinstance(need, NeedPartial):
+                        key = (actor_id, need.version)
+                        got = req_partials.setdefault(key, RangeSet())
+                        fresh_seqs = []
+                        for s, e in need.seqs:
+                            seg = RangeSet([(s, e)])
+                            for gs, ge in got.overlapping(s, e):
+                                seg.remove(gs, ge)
+                            for fs, fe in seg:
+                                got.insert(fs, fe)
+                                fresh_seqs.append((fs, fe))
+                        if fresh_seqs:
+                            claimed.append(
+                                NeedPartial(need.version, tuple(fresh_seqs))
+                            )
+                if claimed:
+                    request.append((actor_id, claimed))
+
+        # round-robin the claimed needs in ≤MAX_NEEDS_PER_TURN batches
+        flat: List[Tuple[ActorId, object]] = [
+            (aid, n) for aid, ns in request for n in ns
+        ]
+        for i in range(0, len(flat), MAX_NEEDS_PER_TURN):
+            turn = flat[i : i + MAX_NEEDS_PER_TURN]
+            grouped: Dict[ActorId, List[object]] = {}
+            for aid, n in turn:
+                grouped.setdefault(aid, []).append(n)
+            await stream.send(encode_sync_msg(list(grouped.items())))
+        await stream.finish()
+
+        received = 0
+        while True:
+            frame = await asyncio.wait_for(stream.recv(), RECV_TIMEOUT)
+            if frame is None:
+                break
+            msg = decode_sync_msg(frame)
+            if isinstance(msg, Timestamp):
+                agent.clock.update_with_timestamp(msg)
+            elif isinstance(msg, ChangeV1):
+                # EmptySets from third parties are rejected
+                # (peer/mod.rs:1429-1432)
+                if (
+                    isinstance(msg.changeset, ChangesetEmptySet)
+                    and msg.actor_id != peer.id
+                ):
+                    continue
+                await agent.tx_changes.send((msg, ChangeSource.SYNC))
+                cs = msg.changeset
+                received += len(getattr(cs, "changes", ()))
+        METRICS.counter("corro.sync.client.changes.received").inc(received)
+        return received
+    finally:
+        stream.close()
+
+
+# -- scheduler -------------------------------------------------------------
+
+
+def choose_sync_peers(agent: Agent, rng: random.Random) -> List[Actor]:
+    """clamp(members/100, min, max) peers, sampled 2×, sorted by
+    (most-needed, oldest-last-sync, lowest RTT ring) (handlers.rs:811-866)."""
+    perf = agent.config.perf
+    candidates = [
+        info
+        for aid, info in agent.members.states.items()
+        if aid != agent.actor_id
+    ]
+    if not candidates:
+        return []
+    want = max(
+        perf.sync_peers_min,
+        min(perf.sync_peers_max, len(candidates) // 100),
+    )
+    sample = rng.sample(candidates, min(len(candidates), want * 2))
+    sample.sort(
+        key=lambda info: (
+            info.last_sync_ts or 0,
+            info.ring if info.ring is not None else 99,
+        )
+    )
+    return [info.actor for info in sample[:want]]
+
+
+async def sync_loop(agent: Agent, rng: Optional[random.Random] = None) -> None:
+    """Periodic anti-entropy with exponential backoff 1–15 s
+    (agent/util.rs:359-405)."""
+    perf = agent.config.perf
+    rng = rng or random.Random()
+    interval = perf.sync_interval_min_secs
+    while not agent.tripwire.tripped:
+        await asyncio.sleep(interval)
+        if agent.tripwire.tripped:
+            break
+        peers = choose_sync_peers(agent, rng)
+        if not peers:
+            interval = min(interval * 2, perf.sync_interval_max_secs)
+            continue
+        start = time.monotonic()
+        try:
+            received = await asyncio.wait_for(parallel_sync(agent, peers), 300)
+        except asyncio.TimeoutError:
+            received = 0
+        elapsed = max(time.monotonic() - start, 1e-9)
+        METRICS.histogram("corro.sync.client.changes_per_sec").observe(
+            received / elapsed
+        )
+        if received:
+            interval = perf.sync_interval_min_secs
+        else:
+            interval = min(interval * 2, perf.sync_interval_max_secs)

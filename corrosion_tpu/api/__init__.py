@@ -1,0 +1,10 @@
+"""Client-facing HTTP API (L5) — the 7 public endpoints + NDJSON streams.
+
+Counterpart of `klukai-agent/src/api/public/` served by the axum router
+assembled in `agent/util.rs:181-328`. JSON payload shapes mirror
+`klukai-types/src/api.rs` so reference clients work unchanged.
+"""
+
+from corrosion_tpu.api.http import ApiServer
+
+__all__ = ["ApiServer"]

@@ -1,0 +1,302 @@
+"""HTTP server: transactions, queries, migrations, table_stats (+ pubsub
+routes once a SubsManager/UpdatesManager is attached).
+
+Counterpart of the axum router in `klukai-agent/src/agent/util.rs:181-351`:
+  - POST /v1/transactions   (concurrency 128)
+  - POST /v1/queries        (streams NDJSON QueryEvents, 128)
+  - POST /v1/migrations     (concurrency 4)
+  - POST /v1/table_stats    (concurrency 4)
+  - POST /v1/subscriptions, GET /v1/subscriptions/{id}
+  - POST /v1/updates/{table}
+  - bearer-token authz middleware (`util.rs:330-351`), load-shed → 503
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sqlite3
+import time
+from typing import Any, List, Optional
+
+from aiohttp import web
+
+from corrosion_tpu.agent.handle import Agent
+from corrosion_tpu.agent.run import make_broadcastable_changes
+from corrosion_tpu.api.types import (
+    Statement,
+    dump_value,
+    ev_columns,
+    ev_eoq,
+    ev_error,
+    ev_row,
+    exec_response,
+    parse_statement,
+)
+from corrosion_tpu.runtime.metrics import METRICS
+from corrosion_tpu.store.schema import SchemaError
+
+
+class _Limit:
+    """Load-shedding concurrency limit: full ⇒ 503 (util.rs:181-328)."""
+
+    def __init__(self, n: int):
+        self._sem = asyncio.Semaphore(n)
+
+    async def __aenter__(self):
+        if self._sem.locked():
+            raise web.HTTPServiceUnavailable(text="overloaded")
+        await self._sem.acquire()
+
+    async def __aexit__(self, *exc):
+        self._sem.release()
+
+
+class ApiServer:
+    def __init__(self, agent: Agent, subs=None, updates=None):
+        self.agent = agent
+        self.subs = subs  # SubsManager (set by pubsub wiring)
+        self.updates = updates  # UpdatesManager
+        self._tx_limit = _Limit(128)
+        self._query_limit = _Limit(128)
+        self._slow_limit = _Limit(4)
+        self._runner: Optional[web.AppRunner] = None
+        self.addrs: List[str] = []
+
+    def build_app(self) -> web.Application:
+        app = web.Application(middlewares=[self._authz])
+        app.router.add_post("/v1/transactions", self.h_transactions)
+        app.router.add_post("/v1/queries", self.h_queries)
+        app.router.add_post("/v1/migrations", self.h_migrations)
+        app.router.add_post("/v1/table_stats", self.h_table_stats)
+        app.router.add_post("/v1/subscriptions", self.h_subscribe)
+        app.router.add_get("/v1/subscriptions/{id}", self.h_subscription_by_id)
+        app.router.add_post("/v1/updates/{table}", self.h_updates)
+        return app
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.build_app())
+        await self._runner.setup()
+        for bind in self.agent.config.api.bind_addr:
+            host, _, port = bind.rpartition(":")
+            site = web.TCPSite(self._runner, host or "127.0.0.1", int(port))
+            await site.start()
+            srv = site._server
+            for sock in getattr(srv, "sockets", []) or []:
+                name = sock.getsockname()
+                self.addrs.append(f"{name[0]}:{name[1]}")
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- middleware --------------------------------------------------------
+
+    @web.middleware
+    async def _authz(self, request: web.Request, handler):
+        expected = self.agent.config.api.authz_bearer
+        if expected:
+            got = request.headers.get("Authorization", "")
+            if got != f"Bearer {expected}":
+                raise web.HTTPUnauthorized(text="invalid bearer token")
+        return await handler(request)
+
+    # -- handlers ----------------------------------------------------------
+
+    async def h_transactions(self, request: web.Request) -> web.Response:
+        async with self._tx_limit:
+            start = time.monotonic()
+            try:
+                body = await request.json()
+                stmts = [parse_statement(s) for s in body]
+            except (ValueError, TypeError) as e:
+                return web.json_response(
+                    {"results": [{"error": str(e)}], "time": 0.0},
+                    status=400,
+                )
+
+            results: List[dict] = []
+
+            def apply(tx) -> List[Any]:
+                out = []
+                for stmt in stmts:
+                    t0 = time.monotonic()
+                    n = _execute_stmt(tx, stmt)
+                    out.append(
+                        {
+                            "rows_affected": n,
+                            "time": time.monotonic() - t0,
+                        }
+                    )
+                return out
+
+            try:
+                res = await make_broadcastable_changes(self.agent, apply)
+            except sqlite3.Error as e:
+                return web.json_response(
+                    {"results": [{"error": str(e)}], "time": 0.0},
+                    status=400,
+                )
+            results = res.results
+            return web.json_response(
+                exec_response(
+                    results,
+                    time.monotonic() - start,
+                    res.version or None,
+                    str(self.agent.actor_id),
+                )
+            )
+
+    async def h_queries(self, request: web.Request) -> web.StreamResponse:
+        async with self._query_limit:
+            try:
+                stmt = parse_statement(await request.json())
+            except (ValueError, TypeError) as e:
+                return web.json_response({"error": str(e)}, status=400)
+
+            resp = web.StreamResponse(
+                headers={"content-type": "application/x-ndjson"}
+            )
+            await resp.prepare(request)
+            start = time.monotonic()
+            loop = asyncio.get_running_loop()
+
+            def run_query():
+                conn = self.agent.store.read_conn()
+                try:
+                    cur = conn.execute(
+                        stmt.query, _bind_params(stmt)
+                    )
+                    cols = (
+                        [d[0] for d in cur.description]
+                        if cur.description
+                        else []
+                    )
+                    rows = cur.fetchall()
+                    return cols, rows
+                finally:
+                    conn.close()
+
+            try:
+                cols, rows = await loop.run_in_executor(None, run_query)
+                await resp.write((ev_columns(cols) + "\n").encode())
+                for i, row in enumerate(rows):
+                    line = ev_row(i + 1, [row[k] for k in row.keys()])
+                    await resp.write((line + "\n").encode())
+                await resp.write(
+                    (ev_eoq(time.monotonic() - start) + "\n").encode()
+                )
+            except sqlite3.Error as e:
+                await resp.write((ev_error(str(e)) + "\n").encode())
+            await resp.write_eof()
+            return resp
+
+    async def h_migrations(self, request: web.Request) -> web.Response:
+        async with self._slow_limit:
+            start = time.monotonic()
+            try:
+                body = await request.json()
+                sql = "\n".join(body) if isinstance(body, list) else str(body)
+            except ValueError as e:
+                return web.json_response(
+                    {"results": [{"error": str(e)}], "time": 0.0}, status=400
+                )
+
+            def apply():
+                self.agent.store.apply_schema_sql(sql)
+
+            try:
+                async with self.agent.write_sem:
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, apply
+                    )
+            except (SchemaError, sqlite3.Error) as e:
+                return web.json_response(
+                    {"results": [{"error": str(e)}], "time": 0.0}, status=400
+                )
+            return web.json_response(
+                exec_response(
+                    [{"rows_affected": 0, "time": 0.0}],
+                    time.monotonic() - start,
+                    None,
+                    str(self.agent.actor_id),
+                )
+            )
+
+    async def h_table_stats(self, request: web.Request) -> web.Response:
+        async with self._slow_limit:
+            try:
+                body = await request.json()
+                tables = body.get("tables") if isinstance(body, dict) else None
+            except ValueError:
+                tables = None
+            if not tables:
+                tables = list(self.agent.store.schema.tables)
+
+            def stats():
+                conn = self.agent.store.read_conn()
+                try:
+                    total = 0
+                    invalid = []
+                    for t in tables:
+                        if t not in self.agent.store.schema.tables:
+                            continue
+                        n = conn.execute(
+                            f'SELECT COUNT(*) AS n FROM "{t}"'
+                        ).fetchone()["n"]
+                        total += n
+                        clock_n = conn.execute(
+                            "SELECT COUNT(DISTINCT pk) AS n FROM"
+                            f' "{t}__crdt_clock"'
+                        ).fetchone()["n"]
+                        if clock_n > n:
+                            invalid.append(t)
+                    return total, invalid
+                finally:
+                    conn.close()
+
+            total, invalid = await asyncio.get_running_loop().run_in_executor(
+                None, stats
+            )
+            return web.json_response(
+                {"total_row_count": total, "invalid_tables": invalid}
+            )
+
+    # -- pubsub routes (wired when managers are attached) ------------------
+
+    async def h_subscribe(self, request: web.Request) -> web.StreamResponse:
+        if self.subs is None:
+            raise web.HTTPNotImplemented(text="subscriptions not enabled")
+        from corrosion_tpu.api.pubsub_http import handle_subscribe
+
+        return await handle_subscribe(self, request)
+
+    async def h_subscription_by_id(
+        self, request: web.Request
+    ) -> web.StreamResponse:
+        if self.subs is None:
+            raise web.HTTPNotImplemented(text="subscriptions not enabled")
+        from corrosion_tpu.api.pubsub_http import handle_subscription_by_id
+
+        return await handle_subscription_by_id(self, request)
+
+    async def h_updates(self, request: web.Request) -> web.StreamResponse:
+        if self.updates is None:
+            raise web.HTTPNotImplemented(text="updates not enabled")
+        from corrosion_tpu.api.pubsub_http import handle_updates
+
+        return await handle_updates(self, request)
+
+
+def _bind_params(stmt: Statement):
+    if stmt.named_params:
+        return {k.lstrip(":@$"): v for k, v in stmt.named_params.items()}
+    return tuple(stmt.params)
+
+
+def _execute_stmt(tx, stmt: Statement) -> int:
+    if stmt.named_params:
+        cur = tx.conn.execute(
+            stmt.query, {k.lstrip(":@$"): v for k, v in stmt.named_params.items()}
+        )
+        return cur.rowcount if cur.rowcount > 0 else 0
+    return tx.execute(stmt.query, stmt.params)

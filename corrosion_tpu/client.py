@@ -1,0 +1,213 @@
+"""Python client library for the HTTP API.
+
+Counterpart of `klukai-client` (`crates/klukai-client/src/lib.rs:33-420`,
+`src/sub.rs`): execute/query/schema plus line-framed NDJSON streams for
+queries, subscriptions and table updates. `SubscriptionStream` tracks the
+last observed ChangeId and transparently reconnects + resubscribes from
+it on gap or disconnect (`sub.rs:328-388`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+import aiohttp
+
+
+class CorrosionApiClient:
+    def __init__(self, addr: str, token: Optional[str] = None):
+        self.base = f"http://{addr}"
+        self._headers = {"content-type": "application/json"}
+        if token:
+            self._headers["authorization"] = f"Bearer {token}"
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    async def _ensure(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(headers=self._headers)
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def __aenter__(self) -> "CorrosionApiClient":
+        await self._ensure()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    # -- one-shot calls ----------------------------------------------------
+
+    async def execute(self, statements: List[Any]) -> Dict[str, Any]:
+        s = await self._ensure()
+        async with s.post(
+            f"{self.base}/v1/transactions", json=statements
+        ) as resp:
+            body = await _body_json(resp)
+            if resp.status >= 400:
+                raise ClientError(resp.status, body)
+            return body
+
+    async def schema(self, statements: List[str]) -> Dict[str, Any]:
+        s = await self._ensure()
+        async with s.post(
+            f"{self.base}/v1/migrations", json=statements
+        ) as resp:
+            body = await _body_json(resp)
+            if resp.status >= 400:
+                raise ClientError(resp.status, body)
+            return body
+
+    async def schema_from_paths(self, paths: List[str]) -> Dict[str, Any]:
+        stmts = []
+        for p in paths:
+            with open(p) as f:
+                stmts.append(f.read())
+        return await self.schema(stmts)
+
+    async def table_stats(
+        self, tables: Optional[List[str]] = None
+    ) -> Dict[str, Any]:
+        s = await self._ensure()
+        async with s.post(
+            f"{self.base}/v1/table_stats", json={"tables": tables or []}
+        ) as resp:
+            return await resp.json()
+
+    async def query(self, statement: Any) -> AsyncIterator[Dict[str, Any]]:
+        """Stream QueryEvents for one statement."""
+        s = await self._ensure()
+        async with s.post(
+            f"{self.base}/v1/queries", json=statement
+        ) as resp:
+            if resp.status >= 400:
+                raise ClientError(resp.status, await _body_json(resp))
+            async for line in _lines(resp):
+                yield json.loads(line)
+
+    async def query_rows(self, statement: Any) -> List[List[Any]]:
+        """Convenience: collect just the row values."""
+        rows = []
+        async for ev in self.query(statement):
+            if "row" in ev:
+                rows.append(ev["row"][1])
+            elif "error" in ev:
+                raise ClientError(200, ev)
+        return rows
+
+    # -- streams -----------------------------------------------------------
+
+    def subscribe(
+        self,
+        statement: Any,
+        skip_rows: bool = False,
+        from_change: Optional[int] = None,
+    ) -> "SubscriptionStream":
+        return SubscriptionStream(self, statement, skip_rows, from_change)
+
+    async def updates(self, table: str) -> AsyncIterator[Dict[str, Any]]:
+        s = await self._ensure()
+        async with s.post(f"{self.base}/v1/updates/{table}") as resp:
+            if resp.status >= 400:
+                raise ClientError(resp.status, await resp.text())
+            async for line in _lines(resp):
+                yield json.loads(line)
+
+
+class ClientError(Exception):
+    def __init__(self, status: int, body: Any):
+        super().__init__(f"HTTP {status}: {body}")
+        self.status = status
+        self.body = body
+
+
+class SubscriptionStream:
+    """Auto-resubscribing NDJSON event stream (client/src/sub.rs:328-388).
+
+    Iterate to receive QueryEvents; on disconnect or ChangeId gap the
+    stream reconnects by query-id from `last_change_id`.
+    """
+
+    def __init__(self, client, statement, skip_rows, from_change):
+        self.client = client
+        self.statement = statement
+        self.skip_rows = skip_rows
+        self.last_change_id: Optional[int] = from_change
+        self.query_id: Optional[str] = None
+        self._max_retries = 5
+
+    def __aiter__(self) -> AsyncIterator[Dict[str, Any]]:
+        return self._run()
+
+    async def _run(self):
+        retries = 0
+        while True:
+            try:
+                async for ev in self._connect_once():
+                    retries = 0
+                    yield ev
+                return  # server ended the stream cleanly
+            except (aiohttp.ClientError, asyncio.TimeoutError, ClientError):
+                retries += 1
+                if self.query_id is None or retries > self._max_retries:
+                    raise
+                await asyncio.sleep(min(2.0, 0.1 * 2**retries))
+
+    async def _connect_once(self):
+        s = await self.client._ensure()
+        if self.query_id is not None:
+            url = f"{self.client.base}/v1/subscriptions/{self.query_id}"
+            params = {}
+            if self.last_change_id is not None:
+                params["from"] = str(self.last_change_id)
+            if self.skip_rows:
+                params["skip_rows"] = "true"
+            ctx = s.get(url, params=params)
+        else:
+            params = {}
+            if self.skip_rows:
+                params["skip_rows"] = "true"
+            if self.last_change_id is not None:
+                params["from"] = str(self.last_change_id)
+            ctx = s.post(
+                f"{self.client.base}/v1/subscriptions",
+                json=self.statement,
+                params=params,
+            )
+        async with ctx as resp:
+            if resp.status >= 400:
+                raise ClientError(resp.status, await resp.text())
+            qid = resp.headers.get("corro-query-id")
+            if qid:
+                self.query_id = qid
+            async for line in _lines(resp):
+                ev = json.loads(line)
+                if "change" in ev:
+                    self.last_change_id = ev["change"][3]
+                elif "eoq" in ev and ev["eoq"].get("change_id") is not None:
+                    self.last_change_id = ev["eoq"]["change_id"]
+                yield ev
+
+
+async def _lines(resp) -> AsyncIterator[str]:
+    buf = b""
+    async for chunk in resp.content.iter_any():
+        buf += chunk
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            if line.strip():
+                yield line.decode()
+    if buf.strip():
+        yield buf.decode()
+
+
+async def _body_json(resp) -> Any:
+    raw = await resp.text()
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError:
+        return raw

@@ -1,0 +1,27 @@
+"""Network layer: the 3-lane Transport seam and its implementations.
+
+The reference consumes exactly three transport primitives — unreliable
+datagrams (SWIM packets), fire-and-forget uni-streams (broadcast frames)
+and bi-streams (sync sessions) — behind `Transport`
+(`klukai-agent/src/transport.rs:26,81,108,140`). This package keeps that
+seam: `MemNetwork` delivers in-process (tests, devcluster-in-one-process,
+and the bridge into the TPU-simulated member blocks), `TcpTransport`
+speaks real sockets (UDP datagrams + lane-tagged TCP streams).
+"""
+
+from corrosion_tpu.net.transport import (
+    BiStream,
+    Listener,
+    Transport,
+    TransportError,
+)
+from corrosion_tpu.net.mem import MemNetwork, MemTransport
+
+__all__ = [
+    "BiStream",
+    "Listener",
+    "Transport",
+    "TransportError",
+    "MemNetwork",
+    "MemTransport",
+]

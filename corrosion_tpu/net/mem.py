@@ -1,0 +1,198 @@
+"""In-memory network: loopback-free delivery between in-process nodes.
+
+The reference exercises multi-node behavior with real loopback QUIC in one
+process (`klukai-tests/src/lib.rs:63-89`); our equivalent removes the
+kernel from the loop entirely: a `MemNetwork` routes datagrams/streams
+between registered nodes with optional per-link latency, loss and
+partitions — the fault-injection surface the reference delegates to
+Antithesis. The same network object is the seam where TPU-simulated member
+blocks (corrosion_tpu.models.cluster) can be bridged in as virtual peers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Set, Tuple
+
+from corrosion_tpu.net.transport import (
+    BiHandler,
+    BiStream,
+    DatagramHandler,
+    Listener,
+    Transport,
+    TransportError,
+    UniHandler,
+)
+
+MAX_DATAGRAM = 1452  # quinn datagram ceiling on typical MTU
+
+
+@dataclass
+class LinkFaults:
+    """Per-network fault knobs (applied to every link unless partitioned)."""
+
+    latency: float = 0.0  # one-way delay seconds
+    jitter: float = 0.0
+    datagram_loss: float = 0.0  # [0,1) — datagrams only; streams are reliable
+
+
+class _MemBiStream(BiStream):
+    def __init__(self, peer_addr: str, net: "MemNetwork"):
+        self._peer = peer_addr
+        self._net = net
+        self._inbox: asyncio.Queue = asyncio.Queue()
+        self._closed = False
+        self.other: Optional["_MemBiStream"] = None
+
+    async def send(self, payload: bytes) -> None:
+        if self._closed or self.other is None:
+            raise TransportError("stream closed")
+        await self._net._delay()
+        self.other._inbox.put_nowait(payload)
+
+    async def recv(self) -> Optional[bytes]:
+        item = await self._inbox.get()
+        if item is _EOF:
+            return None
+        return item
+
+    async def finish(self) -> None:
+        if self.other is not None and not self.other._closed:
+            self.other._inbox.put_nowait(_EOF)
+
+    def close(self) -> None:
+        self._closed = True
+        self._inbox.put_nowait(_EOF)
+        if self.other is not None and not self.other._closed:
+            self.other._closed = True
+            self.other._inbox.put_nowait(_EOF)
+
+    @property
+    def peer(self) -> str:
+        return self._peer
+
+
+_EOF = object()
+
+
+@dataclass
+class _Node:
+    on_datagram: DatagramHandler
+    on_uni: UniHandler
+    on_bi: BiHandler
+
+
+class MemNetwork:
+    """Registry + router. One per simulated cluster."""
+
+    def __init__(self, seed: int = 0, faults: Optional[LinkFaults] = None):
+        self._nodes: Dict[str, _Node] = {}
+        self._rng = random.Random(seed)
+        self.faults = faults or LinkFaults()
+        self._partitions: Set[Tuple[str, str]] = set()
+        self._down: Set[str] = set()
+
+    # -- topology faults --------------------------------------------------
+
+    def partition(self, a: str, b: str) -> None:
+        self._partitions.add((a, b))
+        self._partitions.add((b, a))
+
+    def heal(self, a: str, b: str) -> None:
+        self._partitions.discard((a, b))
+        self._partitions.discard((b, a))
+
+    def take_down(self, addr: str) -> None:
+        """Simulate a crashed node: all delivery to it fails."""
+        self._down.add(addr)
+
+    def bring_up(self, addr: str) -> None:
+        self._down.discard(addr)
+
+    def _reachable(self, src: str, dst: str) -> bool:
+        if dst in self._down or src in self._down:
+            return False
+        if (src, dst) in self._partitions:
+            return False
+        return dst in self._nodes
+
+    async def _delay(self) -> None:
+        f = self.faults
+        if f.latency or f.jitter:
+            await asyncio.sleep(f.latency + self._rng.random() * f.jitter)
+        else:
+            await asyncio.sleep(0)
+
+    # -- node registration -------------------------------------------------
+
+    def listener(self, addr: str) -> "MemListener":
+        return MemListener(addr, self)
+
+    def transport(self, addr: str) -> "MemTransport":
+        return MemTransport(addr, self)
+
+
+class MemListener(Listener):
+    def __init__(self, addr: str, net: MemNetwork):
+        self._addr = addr
+        self._net = net
+
+    def serve(self, on_datagram, on_uni, on_bi) -> None:
+        self._net._nodes[self._addr] = _Node(on_datagram, on_uni, on_bi)
+
+    @property
+    def addr(self) -> str:
+        return self._addr
+
+    async def close(self) -> None:
+        self._net._nodes.pop(self._addr, None)
+
+
+class MemTransport(Transport):
+    def __init__(self, src: str, net: MemNetwork):
+        self._src = src
+        self._net = net
+
+    async def send_datagram(self, addr: str, data: bytes) -> None:
+        if len(data) > MAX_DATAGRAM:
+            raise TransportError(f"datagram too large: {len(data)}")
+        net = self._net
+        if not net._reachable(self._src, addr):
+            return  # datagrams are fire-and-forget: silent loss
+        if net.faults.datagram_loss and net._rng.random() < net.faults.datagram_loss:
+            return
+        node = net._nodes[addr]
+
+        async def deliver():
+            await net._delay()
+            await node.on_datagram(self._src, data)
+
+        # detached delivery like real UDP: the sender never blocks on the
+        # receiver's handler (RTT is observed by the SWIM ack path instead)
+        asyncio.ensure_future(deliver())
+
+    async def send_uni(self, addr: str, payload: bytes) -> None:
+        net = self._net
+        if not net._reachable(self._src, addr):
+            raise TransportError(f"unreachable: {addr}")
+        node = net._nodes[addr]
+        start = time.monotonic()
+        await net._delay()
+        # deliver as an independent task, like a uni-stream read loop
+        asyncio.ensure_future(node.on_uni(self._src, payload))
+        self.observe_rtt(addr, 2 * (time.monotonic() - start))
+
+    async def open_bi(self, addr: str) -> BiStream:
+        net = self._net
+        if not net._reachable(self._src, addr):
+            raise TransportError(f"unreachable: {addr}")
+        node = net._nodes[addr]
+        local = _MemBiStream(addr, net)
+        remote = _MemBiStream(self._src, net)
+        local.other, remote.other = remote, local
+        await net._delay()
+        asyncio.ensure_future(node.on_bi(remote))
+        return local

@@ -72,9 +72,23 @@ class Receiver(Generic[T]):
                 raise ChannelClosed(self._ch.name)
             get = asyncio.ensure_future(self._ch.queue.get())
             closed = asyncio.ensure_future(self._ch.closed_event.wait())
-            done, _ = await asyncio.wait(
-                {get, closed}, return_when=asyncio.FIRST_COMPLETED
-            )
+            try:
+                done, _ = await asyncio.wait(
+                    {get, closed}, return_when=asyncio.FIRST_COMPLETED
+                )
+            except asyncio.CancelledError:
+                # external cancellation (e.g. wait_for timeout): don't lose
+                # an item the inner get may already have consumed
+                closed.cancel()
+                if get.done() and not get.cancelled():
+                    q = self._ch.queue
+                    q._queue.appendleft(get.result())
+                    # appendleft bypasses put_nowait's getter wakeup —
+                    # rouse any consumer parked inside queue.get()
+                    q._wakeup_next(q._getters)
+                else:
+                    get.cancel()
+                raise
             closed.cancel()
             if get in done:
                 METRICS.counter(

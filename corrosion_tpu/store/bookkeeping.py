@@ -231,6 +231,14 @@ class Bookie:
         with self._lock:
             return self._map.get(actor_id)
 
+    def insert(self, actor_id: ActorId, bv: BookedVersions) -> Booked:
+        """Install pre-loaded bookkeeping (startup warm-up from durable
+        state, run_root.rs:136-197)."""
+        with self._lock:
+            b = Booked(bv)
+            self._map[actor_id] = b
+            return b
+
     def items(self) -> Dict[ActorId, Booked]:
         with self._lock:
             return dict(self._map)

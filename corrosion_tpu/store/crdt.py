@@ -385,14 +385,48 @@ class CrdtStore:
             changes.sort(key=lambda ch: ch.seq)
             yield v, changes
 
-    def last_seq_for_version(self, site: ActorId, version: int) -> Optional[int]:
+    def last_seq_for_version(
+        self,
+        site: ActorId,
+        version: int,
+        conn: Optional[sqlite3.Connection] = None,
+    ) -> Optional[int]:
         """Max seq ever assigned in `version` (needed because later writes
         can erase clock rows; tracked in __corro_state for local versions)."""
-        row = self._conn.execute(
+        row = (conn or self._conn).execute(
             "SELECT value FROM __corro_state WHERE key = ?",
             (f"last_seq:{site}:{version}",),
         ).fetchone()
         return row["value"] if row else None
+
+    def buffered_last_seq(
+        self,
+        site: ActorId,
+        version: int,
+        conn: Optional[sqlite3.Connection] = None,
+    ) -> Optional[int]:
+        """The true last_seq a partially buffered version will end at
+        (carried on every buffered row and in seq bookkeeping)."""
+        row = (conn or self._conn).execute(
+            "SELECT MAX(last_seq) AS ls FROM __corro_seq_bookkeeping"
+            " WHERE site_id = ? AND db_version = ?",
+            (site.bytes16, version),
+        ).fetchone()
+        return row["ls"] if row and row["ls"] is not None else None
+
+    def buffered_seq_ranges(
+        self,
+        site: ActorId,
+        version: int,
+        conn: Optional[sqlite3.Connection] = None,
+    ) -> RangeSet:
+        """Seq ranges actually buffered for a partial version."""
+        rows = (conn or self._conn).execute(
+            "SELECT start_seq, end_seq FROM __corro_seq_bookkeeping"
+            " WHERE site_id = ? AND db_version = ?",
+            (site.bytes16, version),
+        ).fetchall()
+        return RangeSet([(r["start_seq"], r["end_seq"]) for r in rows])
 
     def record_last_seq(self, site: ActorId, version: int, last_seq: int) -> None:
         self._conn.execute(
@@ -604,11 +638,15 @@ class CrdtStore:
                 raise
 
     def take_buffered_version(
-        self, site: ActorId, version: int
+        self,
+        site: ActorId,
+        version: int,
+        conn: Optional[sqlite3.Connection] = None,
     ) -> List[Change]:
-        """Drain a fully-buffered version into Change objects for apply
-        (process_fully_buffered_changes, util.rs:552-700)."""
-        rows = self._conn.execute(
+        """Read a buffered version's rows as Change objects (non-destructive;
+        clearing is separate — process_fully_buffered_changes,
+        util.rs:552-700)."""
+        rows = (conn or self._conn).execute(
             "SELECT * FROM __corro_buffered_changes"
             " WHERE site_id = ? AND db_version = ? ORDER BY seq",
             (site.bytes16, version),

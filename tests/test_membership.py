@@ -1,0 +1,179 @@
+"""SWIM membership over the in-memory network: join, converge, fail, refute.
+
+Mirrors the reference's in-process multi-agent test pattern
+(`klukai-agent/src/agent/tests.rs`) at the membership layer.
+"""
+
+import asyncio
+import random
+
+from corrosion_tpu.agent.members import Members, ring_for_rtt
+from corrosion_tpu.agent.membership import (
+    Membership,
+    Notification,
+    SwimConfig,
+)
+from corrosion_tpu.net.mem import MemNetwork
+from corrosion_tpu.runtime.tripwire import Tripwire
+from corrosion_tpu.types.actor import Actor, ActorId
+from corrosion_tpu.types.base import Timestamp
+
+FAST = SwimConfig(
+    probe_period=0.05,
+    probe_rtt=0.02,
+    suspicion_mult=1.0,
+)
+
+
+def mk_node(net: MemNetwork, n: int, cfg=FAST):
+    addr = f"node{n}"
+    actor = Actor(
+        id=ActorId(bytes([n]) * 16), addr=addr, ts=Timestamp.from_unix(n)
+    )
+    transport = net.transport(addr)
+    ms = Membership(actor, transport, cfg, rng=random.Random(n))
+
+    async def on_uni(src, data):
+        pass
+
+    async def on_bi(stream):
+        stream.close()
+
+    net.listener(addr).serve(ms.handle_datagram, on_uni, on_bi)
+    return ms
+
+
+async def wait_until(pred, timeout=10.0, step=0.02):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if pred():
+            return True
+        await asyncio.sleep(step)
+    return pred()
+
+
+def test_three_nodes_converge_and_detect_failure():
+    async def main():
+        net = MemNetwork(seed=7)
+        tw = Tripwire()
+        nodes = [mk_node(net, i + 1) for i in range(3)]
+        for ms in nodes:
+            ms.start(tw)
+        # join: 2 and 3 announce to 1
+        await nodes[1].announce("node1")
+        await nodes[2].announce("node1")
+
+        assert await wait_until(
+            lambda: all(ms.cluster_size == 3 for ms in nodes)
+        ), [ms.cluster_size for ms in nodes]
+
+        # no false positives while healthy
+        await asyncio.sleep(0.3)
+        assert all(ms.cluster_size == 3 for ms in nodes)
+
+        # kill node3; 1 and 2 must converge on cluster_size == 2
+        await nodes[2].stop()
+        net.take_down("node3")
+        assert await wait_until(
+            lambda: nodes[0].cluster_size == 2 and nodes[1].cluster_size == 2
+        ), [ms.cluster_size for ms in nodes[:2]]
+
+        tw.trip()
+        for ms in nodes[:2]:
+            await ms.stop()
+
+    asyncio.run(main())
+
+
+def test_suspected_node_refutes_and_survives():
+    async def main():
+        net = MemNetwork(seed=3)
+        tw = Tripwire()
+        notes = []
+        nodes = [mk_node(net, i + 1) for i in range(3)]
+        nodes[2].on_notification = lambda n, a: notes.append(n)
+        for ms in nodes:
+            ms.start(tw)
+        await nodes[1].announce("node1")
+        await nodes[2].announce("node1")
+        assert await wait_until(
+            lambda: all(ms.cluster_size == 3 for ms in nodes)
+        )
+
+        # brief partition: node3 unreachable from 1 and 2, but still alive
+        net.partition("node1", "node3")
+        net.partition("node2", "node3")
+        assert await wait_until(
+            lambda: any(
+                m.state.name == "SUSPECT"
+                for ms in nodes[:2]
+                for m in ms.members.values()
+            ),
+            timeout=5.0,
+        )
+        # heal before the suspicion window expires at 1s (mult=1 ⇒ ~0.1s
+        # base window but state_since resets on re-suspicion) — the
+        # suspect must refute with a higher incarnation and stay a member
+        net.heal("node1", "node3")
+        net.heal("node2", "node3")
+        ok = await wait_until(
+            lambda: all(ms.cluster_size == 3 for ms in nodes), timeout=5.0
+        )
+        if not ok:
+            # a suspect that expired to DOWN must renew and rejoin
+            await nodes[2].announce("node1")
+            assert await wait_until(
+                lambda: all(ms.cluster_size == 3 for ms in nodes),
+                timeout=5.0,
+            )
+        tw.trip()
+        for ms in nodes:
+            await ms.stop()
+
+    asyncio.run(main())
+
+
+def test_graceful_leave():
+    async def main():
+        net = MemNetwork(seed=5)
+        tw = Tripwire()
+        nodes = [mk_node(net, i + 1) for i in range(3)]
+        for ms in nodes:
+            ms.start(tw)
+        await nodes[1].announce("node1")
+        await nodes[2].announce("node1")
+        assert await wait_until(
+            lambda: all(ms.cluster_size == 3 for ms in nodes)
+        )
+        await nodes[2].leave()
+        await nodes[2].stop()
+        assert await wait_until(
+            lambda: nodes[0].cluster_size == 2 and nodes[1].cluster_size == 2,
+            timeout=5.0,
+        )
+        tw.trip()
+        for ms in nodes[:2]:
+            await ms.stop()
+
+    asyncio.run(main())
+
+
+def test_members_rtt_rings():
+    m = Members()
+    a = Actor(id=ActorId(b"\x01" * 16), addr="a:1", ts=Timestamp.from_unix(1))
+    assert m.add_member(a) is True
+    assert m.add_member(a) is False  # refresh, not new
+    m.observe_rtt("a:1", 0.002)  # 2ms -> ring 0
+    assert m.get(a.id).ring == 0
+    for _ in range(20):
+        m.observe_rtt("a:1", 0.120)  # 120ms -> ring 4
+    assert m.get(a.id).ring == 4
+    assert ring_for_rtt(5.9) == 0
+    assert ring_for_rtt(250.0) == 5
+
+    # stale down about an old identity must not remove the renewed one
+    renewed = a.renew()
+    m.add_member(renewed)
+    assert m.remove_member(a) is False
+    assert m.remove_member(renewed) is True
+    assert len(m) == 0

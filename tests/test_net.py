@@ -1,0 +1,178 @@
+"""Transport seam: in-memory network, TCP/UDP sockets, gossip codec."""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.net.gossip_codec import (
+    MAX_PACKET,
+    MemberState,
+    MemberUpdate,
+    MsgKind,
+    SwimMessage,
+    decode_swim,
+    encode_swim,
+)
+from corrosion_tpu.net.mem import LinkFaults, MemNetwork
+from corrosion_tpu.net.tcp import TcpListener, TcpTransport
+from corrosion_tpu.net.transport import TransportError
+from corrosion_tpu.types.actor import Actor, ActorId
+from corrosion_tpu.types.base import Timestamp
+
+
+def mk_actor(n: int, addr: str) -> Actor:
+    return Actor(
+        id=ActorId(bytes([n]) * 16), addr=addr, ts=Timestamp.from_unix(1000 + n)
+    )
+
+
+def test_swim_codec_roundtrip():
+    a = mk_actor(1, "a:1")
+    b = mk_actor(2, "b:2")
+    c = mk_actor(3, "c:3")
+    msg = SwimMessage(
+        kind=MsgKind.PING_REQ,
+        probe_no=42,
+        sender=a,
+        target=b,
+        origin=c,
+        updates=[
+            MemberUpdate(b, 7, MemberState.SUSPECT),
+            MemberUpdate(c, 0, MemberState.ALIVE),
+        ],
+    )
+    out = decode_swim(encode_swim(msg))
+    assert out.kind == MsgKind.PING_REQ
+    assert out.probe_no == 42
+    assert out.sender == a
+    assert out.target == b
+    assert out.origin == c
+    assert out.updates == msg.updates
+    assert len(encode_swim(msg)) < MAX_PACKET
+
+
+def test_mem_network_three_lanes():
+    async def main():
+        net = MemNetwork()
+        got = {"dgram": [], "uni": []}
+
+        async def on_datagram(src, data):
+            got["dgram"].append((src, data))
+
+        async def on_uni(src, data):
+            got["uni"].append((src, data))
+
+        async def on_bi(stream):
+            while True:
+                frame = await stream.recv()
+                if frame is None:
+                    break
+                await stream.send(b"echo:" + frame)
+            await stream.finish()
+
+        net.listener("b").serve(on_datagram, on_uni, on_bi)
+        t = net.transport("a")
+
+        await t.send_datagram("b", b"ping")
+        await t.send_uni("b", b"bcast")
+        bi = await t.open_bi("b")
+        await bi.send(b"hello")
+        await bi.finish()
+        reply = await bi.recv()
+        assert reply == b"echo:hello"
+        assert await bi.recv() is None
+        await asyncio.sleep(0.01)
+        assert got["dgram"] == [("a", b"ping")]
+        assert got["uni"] == [("a", b"bcast")]
+
+    asyncio.run(main())
+
+
+def test_mem_network_faults():
+    async def main():
+        net = MemNetwork(seed=1, faults=LinkFaults(datagram_loss=1.0))
+        seen = []
+
+        async def on_datagram(src, data):
+            seen.append(data)
+
+        async def noop_uni(src, data):
+            pass
+
+        async def noop_bi(stream):
+            stream.close()
+
+        net.listener("b").serve(on_datagram, noop_uni, noop_bi)
+        t = net.transport("a")
+        await t.send_datagram("b", b"x")  # 100% loss: silently dropped
+        assert seen == []
+
+        net.faults.datagram_loss = 0.0
+        net.partition("a", "b")
+        await t.send_datagram("b", b"x")  # partitioned: dropped
+        with pytest.raises(TransportError):
+            await t.send_uni("b", b"x")  # streams fail loudly
+        net.heal("a", "b")
+        await t.send_datagram("b", b"y")
+        await asyncio.sleep(0.01)
+        assert seen == [b"y"]
+
+        net.take_down("b")
+        with pytest.raises(TransportError):
+            await t.open_bi("b")
+        net.bring_up("b")
+        bi = await t.open_bi("b")
+        assert bi is not None
+
+    asyncio.run(main())
+
+
+def test_tcp_transport_three_lanes():
+    async def main():
+        got = {"dgram": asyncio.Event(), "uni": asyncio.Event(), "data": {}}
+
+        async def on_datagram(src, data):
+            got["data"]["dgram"] = data
+            got["dgram"].set()
+
+        async def on_uni(src, data):
+            got["data"].setdefault("uni", []).append(data)
+            got["uni"].set()
+
+        async def on_bi(stream):
+            frame = await stream.recv()
+            await stream.send(b"pong:" + frame)
+            await stream.finish()
+
+        server = await TcpListener.bind()
+        server.serve(on_datagram, on_uni, on_bi)
+
+        client_listener = await TcpListener.bind()
+        client_listener.serve(on_datagram, on_uni, on_bi)
+        t = TcpTransport(client_listener)
+
+        await t.send_datagram(server.addr, b"dg")
+        await asyncio.wait_for(got["dgram"].wait(), 5)
+        assert got["data"]["dgram"] == b"dg"
+
+        # uni lane: two frames over the same cached connection
+        await t.send_uni(server.addr, b"frame1")
+        await t.send_uni(server.addr, b"frame2")
+        await asyncio.wait_for(got["uni"].wait(), 5)
+        for _ in range(50):
+            if len(got["data"].get("uni", [])) == 2:
+                break
+            await asyncio.sleep(0.01)
+        assert got["data"]["uni"] == [b"frame1", b"frame2"]
+
+        bi = await t.open_bi(server.addr)
+        await bi.send(b"syn")
+        reply = await bi.recv()
+        assert reply == b"pong:syn"
+        bi.close()
+
+        await t.close()
+        await server.close()
+        await client_listener.close()
+
+    asyncio.run(main())
