@@ -68,6 +68,9 @@ class Agent:
     # ≤3 concurrent inbound sync serves (agent.rs:144-146)
     sync_serve_sem: asyncio.Semaphore = field(default_factory=lambda: asyncio.Semaphore(3))
     change_hooks: List[ChangeHook] = field(default_factory=list)
+    # live-query + raw-update managers (agent.rs:64-273 subs/updates)
+    subs: Optional[object] = None  # SubsManager
+    updates: Optional[object] = None  # UpdatesManager
 
     @property
     def actor_id(self) -> ActorId:

@@ -118,6 +118,14 @@ async def setup(
         rx_apply=rx_apply,
     )
 
+    # live-query + raw-update engines fed from every committed batch
+    from corrosion_tpu.pubsub import SubsManager, UpdatesManager
+
+    agent.subs = SubsManager(store, config.db.subscriptions_path)
+    agent.updates = UpdatesManager(store)
+    agent.change_hooks.append(agent.subs.match_changes)
+    agent.change_hooks.append(agent.updates.match_changes)
+
     # SWIM notifications keep the member view current (handlers.rs:283-373)
     def on_notification(note: Notification, peer: Actor) -> None:
         if note == Notification.MEMBER_UP:
@@ -154,6 +162,8 @@ async def run(agent: Agent) -> None:
 
     agent.listener.serve(on_datagram, on_uni, on_bi)
     agent.membership.start(agent.tripwire)
+    if agent.subs is not None:
+        await agent.subs.restore()  # setup.rs:296-349
     t = agent.tracker
     t.spawn(handle_changes(agent))
     t.spawn(apply_fully_buffered_loop(agent))
@@ -191,6 +201,10 @@ async def shutdown(agent: Agent) -> None:
     with contextlib.suppress(Exception):
         await agent.membership.leave()
     agent.tripwire.trip()
+    if agent.subs is not None:
+        await agent.subs.stop_all()
+    if agent.updates is not None:
+        await agent.updates.stop_all()
     agent.tx_changes.close()
     agent.tx_bcast.close()
     agent.tx_apply.close()

@@ -54,8 +54,8 @@ class _Limit:
 class ApiServer:
     def __init__(self, agent: Agent, subs=None, updates=None):
         self.agent = agent
-        self.subs = subs  # SubsManager (set by pubsub wiring)
-        self.updates = updates  # UpdatesManager
+        self.subs = subs if subs is not None else agent.subs
+        self.updates = updates if updates is not None else agent.updates
         self._tx_limit = _Limit(128)
         self._query_limit = _Limit(128)
         self._slow_limit = _Limit(4)

@@ -1,0 +1,226 @@
+"""HTTP plumbing for live queries and table updates.
+
+Counterpart of `klukai-agent/src/api/public/pubsub.rs` (api_v1_subs
+:699, api_v1_sub_by_id :38, catch_up_sub :387-651, NDJSON streaming
+:818-980) and `api/public/update.rs:31-290`:
+
+- `POST /v1/subscriptions` — params interpolated into the SQL
+  (pubsub.rs:258-363), `SubsManager::get_or_insert`, response headers
+  `corro-query-id` / `corro-query-hash`, NDJSON body: columns → rows
+  (unless `skip_rows`) → eoq(change_id) → live change events;
+- `GET /v1/subscriptions/{id}` — re-attach; `?from=<change_id>`
+  replays the changes log (a pruned-away `from` is a 404: resubscribe
+  anew), otherwise streams a fresh snapshot;
+- `POST /v1/updates/{table}` — NotifyEvent NDJSON stream.
+
+Event ordering: the subscriber queue is attached *before* the snapshot
+or log replay is read, then live events with ids ≤ the replayed max are
+dropped — every ChangeId is delivered exactly once, in order
+(pubsub.rs:818-980 buffers for the same purpose).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, List, Optional
+
+from aiohttp import web
+
+from corrosion_tpu.api.types import (
+    ev_change,
+    ev_columns,
+    ev_eoq,
+    ev_error,
+    ev_notify,
+    ev_row,
+    parse_statement,
+)
+from corrosion_tpu.pubsub.parse import ParseError
+
+
+def expand_sql(stmt) -> str:
+    """Interpolate params into the SQL text so identical subscriptions
+    dedupe on the final query (pubsub.rs:258-363 uses sqlite's
+    expanded_sql). Token-level substitution: placeholders inside string
+    literals or prefix-colliding names are never touched."""
+    from corrosion_tpu.pubsub.parse import tokenize, _join_tokens
+
+    if not stmt.params and not stmt.named_params:
+        return stmt.query
+    tokens = tokenize(stmt.query)
+    named = {
+        (k if k[0] in ":@$" else ":" + k): v
+        for k, v in (stmt.named_params or {}).items()
+    }
+    out = []
+    pos_iter = iter(stmt.params or [])
+    n_positional = 0
+    for tok in tokens:
+        if tok.kind == "param":
+            if tok.text.startswith("?"):
+                try:
+                    v = next(pos_iter)
+                except StopIteration:
+                    raise ParseError("not enough positional params")
+                n_positional += 1
+                out.append(type(tok)("num", _literal(v)))
+                continue
+            if tok.text in named:
+                out.append(type(tok)("num", _literal(named[tok.text])))
+                continue
+            raise ParseError(f"unbound parameter {tok.text}")
+        out.append(tok)
+    if stmt.params and n_positional != len(stmt.params):
+        raise ParseError(
+            f"statement has {n_positional} placeholders,"
+            f" got {len(stmt.params)} params"
+        )
+    return _join_tokens(out)
+
+
+def _literal(v: Any) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return "X'" + bytes(v).hex() + "'"
+    return "'" + str(v).replace("'", "''") + "'"
+
+
+async def handle_subscribe(api, request: web.Request) -> web.StreamResponse:
+    try:
+        stmt = parse_statement(await request.json())
+        sql = expand_sql(stmt)
+    except (ValueError, TypeError, ParseError) as e:
+        return web.json_response({"error": str(e)}, status=400)
+
+    try:
+        skip_rows, from_id = _stream_params(request)
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
+
+    try:
+        handle, _created, _rows = await api.subs.get_or_insert(sql)
+    except ParseError as e:
+        return web.json_response({"error": str(e)}, status=400)
+
+    return await _stream_sub(request, handle, skip_rows, from_id)
+
+
+async def handle_subscription_by_id(
+    api, request: web.Request
+) -> web.StreamResponse:
+    sub_id = request.match_info["id"]
+    handle = api.subs.get(sub_id)
+    if handle is None:
+        return web.json_response({"error": "unknown subscription"}, status=404)
+    try:
+        skip_rows, from_id = _stream_params(request)
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    return await _stream_sub(request, handle, skip_rows, from_id)
+
+
+def _stream_params(request: web.Request):
+    skip_rows = request.query.get("skip_rows", "") in ("true", "1")
+    from_raw = request.query.get("from")
+    try:
+        from_id = int(from_raw) if from_raw is not None else None
+    except ValueError:
+        raise ValueError(f"malformed 'from' change id: {from_raw!r}")
+    return skip_rows, from_id
+
+
+async def _stream_sub(
+    request: web.Request,
+    handle,
+    skip_rows: bool,
+    from_id: Optional[int],
+) -> web.StreamResponse:
+    resp = web.StreamResponse(
+        headers={
+            "content-type": "application/x-ndjson",
+            "corro-query-id": handle.id,
+            "corro-query-hash": handle.hash,
+        }
+    )
+    await resp.prepare(request)
+
+    async def line(s: str) -> None:
+        await resp.write((s + "\n").encode())
+
+    # attach FIRST so no event can fall between snapshot and live tail
+    q = handle.attach()
+    try:
+        replayed_max = 0
+        if from_id is not None:
+            evs = await asyncio.to_thread(handle.matcher.changes_since, from_id)
+            if evs is None:
+                await line(
+                    ev_error(
+                        f"change id {from_id} is no longer in the log;"
+                        " resubscribe anew"
+                    )
+                )
+                await resp.write_eof()
+                return resp
+            for ev in evs:
+                await line(ev_change(ev.kind, ev.rowid, ev.values, ev.change_id))
+                replayed_max = ev.change_id
+        else:
+            await line(ev_columns(handle.columns))
+            # rows + change id read atomically: no diff can land between
+            rows, snap_id = await asyncio.to_thread(handle.matcher.snapshot)
+            if not skip_rows:
+                for rowid, values in rows:
+                    await line(ev_row(rowid, values))
+            await line(ev_eoq(0.0, snap_id if snap_id else None))
+            replayed_max = snap_id
+
+        while True:
+            ev = await q.get()
+            if ev is None:  # matcher died
+                await line(ev_error(handle.error or "subscription closed"))
+                break
+            if ev.change_id <= replayed_max:
+                continue
+            await line(ev_change(ev.kind, ev.rowid, ev.values, ev.change_id))
+    except (ConnectionResetError, asyncio.CancelledError):
+        pass
+    finally:
+        handle.detach(q)
+    with _suppress_conn_err():
+        await resp.write_eof()
+    return resp
+
+
+async def handle_updates(api, request: web.Request) -> web.StreamResponse:
+    table = request.match_info["table"]
+    try:
+        handle, _created = await api.updates.get_or_insert(table)
+    except KeyError as e:
+        return web.json_response({"error": str(e)}, status=404)
+
+    resp = web.StreamResponse(
+        headers={"content-type": "application/x-ndjson"}
+    )
+    await resp.prepare(request)
+    q = handle.attach()
+    try:
+        while True:
+            kind, pk_values = await q.get()
+            await resp.write((ev_notify(kind, pk_values) + "\n").encode())
+    except (ConnectionResetError, asyncio.CancelledError):
+        pass
+    finally:
+        handle.detach(q)
+    with _suppress_conn_err():
+        await resp.write_eof()
+    return resp
+
+
+def _suppress_conn_err():
+    import contextlib
+
+    return contextlib.suppress(ConnectionResetError, RuntimeError)

@@ -1,0 +1,12 @@
+"""Live-query (subscriptions) and raw-update notification engines.
+
+Counterpart of `klukai-types/src/pubsub.rs` (SubsManager/Matcher, the
+reference's largest single component) and `klukai-types/src/updates.rs`
+(UpdatesManager).
+"""
+
+from corrosion_tpu.pubsub.manager import SubsManager
+from corrosion_tpu.pubsub.matcher import Matcher, MatcherHandle
+from corrosion_tpu.pubsub.updates import UpdatesManager
+
+__all__ = ["SubsManager", "Matcher", "MatcherHandle", "UpdatesManager"]

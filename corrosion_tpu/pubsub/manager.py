@@ -1,0 +1,192 @@
+"""SubsManager: dedupe, lifecycle and restore of live-query matchers.
+
+Counterpart of `SubsManager` in `klukai-types/src/pubsub.rs:54-256`:
+subscriptions are deduped by SQL text hash (`:565`), `get_or_insert`
+(`:115`) returns an existing matcher when one already runs the same
+query, and `restore` (`:164`) re-attaches matchers persisted under
+`<subs_path>/<uuid>/sub.sqlite` on agent start
+(`klukai-agent/src/agent/setup.rs:296-349`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import hashlib
+import shutil
+import sqlite3
+import uuid
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from corrosion_tpu.pubsub.matcher import Matcher, MatcherError, MatcherHandle
+from corrosion_tpu.pubsub.parse import ParseError, parse_select
+from corrosion_tpu.runtime.metrics import METRICS
+from corrosion_tpu.types.change import Change
+
+
+def sql_hash(sql: str) -> str:
+    return hashlib.sha256(sql.encode()).hexdigest()[:16]
+
+
+class SubsManager:
+    """Registry of running matchers, keyed by id and by SQL hash."""
+
+    def __init__(self, store, subs_path: Optional[str] = None):
+        self.store = store
+        self.subs_path = subs_path
+        self._by_id: Dict[str, MatcherHandle] = {}
+        self._by_hash: Dict[str, str] = {}  # sql hash -> id
+        self._lock = asyncio.Lock()
+
+    def get(self, sub_id: str) -> Optional[MatcherHandle]:
+        return self._by_id.get(sub_id)
+
+    def get_by_sql(self, sql: str) -> Optional[MatcherHandle]:
+        sid = self._by_hash.get(sql_hash(sql))
+        return self._by_id.get(sid) if sid else None
+
+    def handles(self) -> List[MatcherHandle]:
+        return list(self._by_id.values())
+
+    async def get_or_insert(
+        self, sql: str
+    ) -> Tuple[MatcherHandle, bool, List]:
+        """Return (handle, created, initial_rows). When created, the
+        initial query has been run and `initial_rows` holds the
+        materialized (rowid, values) rows to stream to the first
+        subscriber; existing matchers return [] (caller reads
+        `all_rows` if it wants a snapshot)."""
+        async with self._lock:
+            existing = self.get_by_sql(sql)
+            if existing is not None:
+                if existing.error is None:
+                    return existing, False, []
+                # dead matcher: tear it down fully before replacing
+                await self._remove_locked(existing.id, purge=True)
+            parsed = parse_select(sql, self.store.schema)
+            sub_id = str(uuid.uuid4())
+            matcher = Matcher(self.store, parsed, sub_id, sql, self.subs_path)
+            loop = asyncio.get_running_loop()
+
+            def build():
+                matcher.create_sub_db()
+                return matcher.run_initial()
+
+            try:
+                _cols, rows = await asyncio.to_thread(build)
+            except (sqlite3.Error, MatcherError) as e:
+                matcher.close()
+                self._purge_dir(sub_id)
+                raise ParseError(str(e)) from e
+            handle = MatcherHandle(matcher, loop)
+            handle.start()
+            self._by_id[sub_id] = handle
+            self._by_hash[sql_hash(sql)] = sub_id
+            METRICS.gauge("corro.subs.count").set(len(self._by_id))
+            return handle, True, rows
+
+    async def restore(self) -> int:
+        """Re-attach matchers persisted on disk; purge incomplete ones.
+        A restored matcher re-checks every pk of its source tables so
+        changes applied while the agent was down surface as events (the
+        reference catches up via `match_changes_from_db_version`)."""
+        if self.subs_path is None:
+            return 0
+        root = Path(self.subs_path)
+        if not root.exists():
+            return 0
+        n = 0
+        for d in sorted(root.iterdir()):
+            db = d / "sub.sqlite"
+            if not d.is_dir() or not db.exists():
+                continue
+            try:
+                sql = self._read_meta_sql(db)
+                parsed = parse_select(sql, self.store.schema)
+                matcher = Matcher(self.store, parsed, d.name, sql, self.subs_path)
+                await asyncio.to_thread(matcher.reattach)
+            except (sqlite3.Error, MatcherError, ParseError, KeyError):
+                shutil.rmtree(d, ignore_errors=True)
+                continue
+            handle = MatcherHandle(matcher, asyncio.get_running_loop())
+            handle.start()
+            self._by_id[d.name] = handle
+            self._by_hash[sql_hash(sql)] = d.name
+            await asyncio.to_thread(self._resync, handle)
+            n += 1
+        METRICS.gauge("corro.subs.count").set(len(self._by_id))
+        return n
+
+    def _resync(self, handle: MatcherHandle) -> None:
+        """Enqueue a full pk sweep of every source table as candidates:
+        live pks ∪ materialized pks, so rows inserted OR deleted while
+        the agent was down both get re-checked (the reference catches up
+        via match_changes_from_db_version, updates.rs:490)."""
+        from corrosion_tpu.types.pack import pack_columns
+
+        conn = self.store.read_conn()
+        try:
+            for t in handle.matcher.parsed.tables:
+                pks = self.store.schema.table(t.name).pk_cols
+                sel = ", ".join(f'"{c}"' for c in pks)
+                rows = conn.execute(f'SELECT {sel} FROM "{t.name}"').fetchall()
+                cands = {pack_columns(tuple(r)) for r in rows}
+                cands.update(handle.matcher.materialized_pks(t.name))
+                if cands:
+                    handle.loop.call_soon_threadsafe(
+                        handle._queue.put_nowait, {t.name: cands}
+                    )
+        finally:
+            conn.close()
+
+    def _read_meta_sql(self, db: Path) -> str:
+        conn = sqlite3.connect(db)
+        try:
+            row = conn.execute(
+                "SELECT v FROM meta WHERE k = 'sql'"
+            ).fetchone()
+            if row is None:
+                raise KeyError("no sql in sub meta")
+            return row[0]
+        finally:
+            conn.close()
+
+    # -- feeding -----------------------------------------------------------
+
+    def match_changes(self, changes: Sequence[Change]) -> None:
+        """Change hook: route committed changes to every matcher
+        (updates.rs:424-488). Thread-safe. Dead matchers are skipped
+        (their queue has no consumer) and torn down from the loop."""
+        for handle in list(self._by_id.values()):
+            if handle.error is not None:
+                handle.loop.call_soon_threadsafe(self._schedule_removal, handle.id)
+                continue
+            handle.match_changes(changes)
+
+    def _schedule_removal(self, sub_id: str) -> None:
+        asyncio.ensure_future(self.remove(sub_id, purge=True))
+
+    # -- teardown ----------------------------------------------------------
+
+    async def remove(self, sub_id: str, purge: bool = False) -> None:
+        async with self._lock:
+            await self._remove_locked(sub_id, purge)
+
+    async def _remove_locked(self, sub_id: str, purge: bool = False) -> None:
+        handle = self._by_id.pop(sub_id, None)
+        if handle is None:
+            return
+        self._by_hash.pop(sql_hash(handle.sql), None)
+        await handle.stop()
+        if purge:
+            self._purge_dir(sub_id)
+        METRICS.gauge("corro.subs.count").set(len(self._by_id))
+
+    def _purge_dir(self, sub_id: str) -> None:
+        if self.subs_path is not None:
+            shutil.rmtree(Path(self.subs_path) / sub_id, ignore_errors=True)
+
+    async def stop_all(self) -> None:
+        for sid in list(self._by_id):
+            await self.remove(sid)

@@ -1,0 +1,682 @@
+"""The live-query matcher: one materialized, incrementally-maintained
+result set per subscription.
+
+Counterpart of `Matcher`/`MatcherHandle` in `klukai-types/src/pubsub.rs`
+(`Matcher::new` :556-803, run/cmd_loop :1029-1226, handle_candidates
+:1401-1673). Same architecture, re-hosted on the sqlite3-backed CRDT
+store:
+
+- each subscription owns its own SQLite db (`sub.sqlite` under
+  `<subs_path>/<uuid>/`) with tables `query` (materialized rows,
+  `__corro_rowid` PK + unique pk-tuple index), `changes` (ChangeId log),
+  `meta`, `columns` (pubsub.rs:893-977);
+- the SELECT is rewritten per source table: pk alias columns
+  `__corro_pk_<tbl>_<pk>` are prepended for every table, and a
+  `(pks) IN temp_<tbl>` membership predicate is AND-injected for the
+  driving table; LEFT joins on the driving table become INNER
+  (pubsub.rs:616-711, table_to_expr :2123);
+- incremental maintenance batches match candidates (table → pk) for
+  600 ms / 1000 entries, inserts the changed pks into `temp_<tbl>`,
+  runs the rewritten query, and set-differences against the
+  materialized `query` table, appending each emitted change to the
+  `changes` log with a monotonically increasing ChangeId
+  (pubsub.rs:1062-1226,1401-1673);
+- the changes log is pruned to the most recent rows every 5 min
+  (pubsub.rs:1171-1192); catch-up from a pruned ChangeId fails and the
+  client must resubscribe anew.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import sqlite3
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from corrosion_tpu.api.types import dump_value
+from corrosion_tpu.pubsub.parse import ParsedSelect, ParseError, parse_select
+from corrosion_tpu.runtime.metrics import METRICS
+from corrosion_tpu.types.change import Change
+from corrosion_tpu.types.pack import unpack_columns
+
+CANDIDATE_BATCH_MAX = 1000  # pubsub.rs cmd_loop batch cap
+CANDIDATE_BATCH_WAIT = 0.6  # 600 ms (pubsub.rs:1069)
+CHANGES_LOG_KEEP = 500  # prune to last 500 (pubsub.rs:1171-1192)
+PRUNE_INTERVAL = 300.0  # every 5 min
+
+
+class MatcherError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class SubEvent:
+    """One row-change event: mirrors QueryEvent::Change."""
+
+    change_id: int
+    kind: str  # insert | update | delete
+    rowid: int
+    values: List[Any]  # JSON-ready cell values
+
+
+def _pk_alias(table: str, col: str) -> str:
+    return f"__corro_pk_{table}_{col}"
+
+
+class Matcher:
+    """Owns the sub db + the rewrite; drives initial fill and diffs.
+
+    All sqlite work happens on executor threads; the async side
+    (cmd_loop) only batches candidates and fans events out.
+    """
+
+    def __init__(
+        self,
+        store,
+        parsed: ParsedSelect,
+        sub_id: str,
+        sql: str,
+        sub_path: Optional[str],
+    ):
+        self.store = store
+        self.parsed = parsed
+        self.id = sub_id
+        self.sql = sql
+        self.sub_path = sub_path
+        self.columns: List[str] = []
+        self._conn: Optional[sqlite3.Connection] = None
+        self._conn_lock = threading.Lock()
+        self.last_change_id = 0
+
+    # -- setup -------------------------------------------------------------
+
+    def _sub_db_file(self) -> str:
+        if self.sub_path is None:
+            return ":memory:"
+        d = Path(self.sub_path) / self.id
+        d.mkdir(parents=True, exist_ok=True)
+        return str(d / "sub.sqlite")
+
+    def connect(self) -> sqlite3.Connection:
+        """Main-db read conn with the sub db ATTACHed writable."""
+        conn = sqlite3.connect(
+            self.store.path,
+            uri=True,
+            check_same_thread=False,
+            isolation_level=None,  # manual BEGIN/COMMIT
+        )
+        conn.row_factory = sqlite3.Row
+        conn.execute("ATTACH ? AS sub", (self._sub_db_file(),))
+        return conn
+
+    def create_sub_db(self) -> None:
+        """Create {query, changes, meta, columns} (pubsub.rs:893-977)."""
+        conn = self.connect()
+        self._conn = conn
+        pk_cols = self._pk_alias_cols()
+        probe = conn.execute(self._probe_query())
+        self.columns = [d[0] for d in probe.description][len(pk_cols):]
+        col_defs = ", ".join(
+            [f'"{c}"' for c in pk_cols]
+            + [f'"col_{i}"' for i in range(len(self.columns))]
+        )
+        uniq = ", ".join(f'"{c}"' for c in pk_cols)
+        with self._conn_lock:
+            conn.executescript(
+                f"""
+                CREATE TABLE IF NOT EXISTS sub.query (
+                  __corro_rowid INTEGER PRIMARY KEY AUTOINCREMENT,
+                  {col_defs}
+                );
+                CREATE UNIQUE INDEX IF NOT EXISTS sub.query_pks
+                  ON query ({uniq});
+                CREATE TABLE IF NOT EXISTS sub.changes (
+                  id INTEGER PRIMARY KEY AUTOINCREMENT,
+                  type TEXT NOT NULL,
+                  __corro_rowid INTEGER NOT NULL,
+                  data TEXT NOT NULL
+                );
+                CREATE TABLE IF NOT EXISTS sub.meta (
+                  k TEXT PRIMARY KEY, v
+                );
+                CREATE TABLE IF NOT EXISTS sub.columns (
+                  idx INTEGER PRIMARY KEY, name TEXT NOT NULL
+                );
+                """
+            )
+            for t in self.parsed.tables:
+                cols = ", ".join(
+                    f'"{c}"' for c in self.store.schema.table(t.name).pk_cols
+                )
+                conn.execute(
+                    f'CREATE TABLE IF NOT EXISTS sub."temp_{t.name}" ({cols})'
+                )
+            conn.executemany(
+                "INSERT OR REPLACE INTO sub.columns (idx, name) VALUES (?, ?)",
+                list(enumerate(self.columns)),
+            )
+            conn.execute(
+                "INSERT OR REPLACE INTO sub.meta (k, v) VALUES ('sql', ?)",
+                (self.sql,),
+            )
+            conn.execute(
+                "INSERT OR REPLACE INTO sub.meta (k, v) VALUES"
+                " ('state', 'created')"
+            )
+
+    def reattach(self) -> None:
+        """Reopen an existing sub db (restore path, pubsub.rs:826-861)."""
+        conn = self.connect()
+        self._conn = conn
+        state = conn.execute(
+            "SELECT v FROM sub.meta WHERE k = 'state'"
+        ).fetchone()
+        if state is None or state["v"] != "completed":
+            raise MatcherError("sub db incomplete; purge and recreate")
+        self.columns = [
+            r["name"]
+            for r in conn.execute(
+                "SELECT name FROM sub.columns ORDER BY idx"
+            )
+        ]
+        row = conn.execute("SELECT MAX(id) AS m FROM sub.changes").fetchone()
+        self.last_change_id = int(row["m"] or 0)
+
+    # -- rewrites ----------------------------------------------------------
+
+    def _pk_alias_cols(self) -> List[str]:
+        out = []
+        for t in self.parsed.tables:
+            for c in self.store.schema.table(t.name).pk_cols:
+                out.append(_pk_alias(t.name, c))
+        return out
+
+    def _pk_select_prefix(self) -> str:
+        parts = []
+        for t in self.parsed.tables:
+            for c in self.store.schema.table(t.name).pk_cols:
+                parts.append(f'"{t.alias}"."{c}" AS "{_pk_alias(t.name, c)}"')
+        return ", ".join(parts)
+
+    def _probe_query(self) -> str:
+        """Initial/probe form: pk aliases + user select list, full scan."""
+        p = self.parsed
+        where = f" WHERE {p.where_clause}" if p.where_clause else ""
+        return (
+            f"SELECT {self._pk_select_prefix()}, {p.select_list}"
+            f" FROM {p.from_clause}{where}"
+        )
+
+    def _table_query(self, driving: str) -> str:
+        """Rewritten per-driving-table query with the temp pk predicate
+        (pubsub.rs:616-711): restricts re-evaluation to changed pks."""
+        p = self.parsed
+        ref = next(t for t in p.tables if t.name == driving)
+        pks = self.store.schema.table(driving).pk_cols
+        tuple_lhs = ", ".join(f'"{ref.alias}"."{c}"' for c in pks)
+        tuple_rhs = ", ".join(f'"{c}"' for c in pks)
+        pred = (
+            f"({tuple_lhs}) IN (SELECT {tuple_rhs} FROM"
+            f' sub."temp_{driving}")'
+        )
+        from_clause = p.from_clause
+        if ref.left_joined:
+            # LEFT JOIN driving → INNER so the pk predicate can bind
+            from_clause = _left_to_inner(from_clause, ref.alias)
+        where = f"({p.where_clause}) AND {pred}" if p.where_clause else pred
+        return (
+            f"SELECT {self._pk_select_prefix()}, {p.select_list}"
+            f" FROM {from_clause} WHERE {where}"
+        )
+
+    # -- initial fill ------------------------------------------------------
+
+    def run_initial(self) -> Tuple[List[str], List[Tuple[int, List[Any]]]]:
+        """Materialize the full result; returns (columns, rows) to stream
+        to the first subscriber (pubsub.rs:1029-1060)."""
+        conn = self._conn
+        assert conn is not None
+        pk_cols = self._pk_alias_cols()
+        ncols = len(self.columns)
+        ins_cols = ", ".join(
+            [f'"{c}"' for c in pk_cols]
+            + [f'"col_{i}"' for i in range(ncols)]
+        )
+        out: List[Tuple[int, List[Any]]] = []
+        with self._conn_lock:
+            conn.execute("BEGIN")
+            try:
+                for row in conn.execute(self._probe_query()):
+                    vals = tuple(row)
+                    cur = conn.execute(
+                        f"INSERT INTO sub.query ({ins_cols}) VALUES"
+                        f" ({', '.join('?' * (len(pk_cols) + ncols))})",
+                        vals,
+                    )
+                    out.append(
+                        (cur.lastrowid, list(vals[len(pk_cols):]))
+                    )
+                conn.execute(
+                    "INSERT OR REPLACE INTO sub.meta (k, v) VALUES"
+                    " ('state', 'completed')"
+                )
+                conn.execute("COMMIT")
+            except BaseException:
+                conn.execute("ROLLBACK")
+                raise
+        return self.columns, out
+
+    def all_rows(self) -> List[Tuple[int, List[Any]]]:
+        """Current materialized rows (re-attach without `from`)."""
+        return self.snapshot()[0]
+
+    def snapshot(self) -> Tuple[List[Tuple[int, List[Any]]], int]:
+        """(rows, last_change_id) read atomically under the conn lock —
+        no diff can commit between the two, so a subscriber that streams
+        these rows then live events > last_change_id misses nothing."""
+        conn = self._conn
+        assert conn is not None
+        ncols = len(self.columns)
+        sel = ", ".join(f'"col_{i}"' for i in range(ncols))
+        with self._conn_lock:
+            rows = conn.execute(
+                f"SELECT __corro_rowid, {sel} FROM sub.query"
+                " ORDER BY __corro_rowid"
+            ).fetchall()
+            snap_id = self.last_change_id
+        return [(r[0], list(r)[1:]) for r in rows], snap_id
+
+    def materialized_pks(self, table: str) -> List[bytes]:
+        """Packed pks of `table` present in the materialized result
+        (restore resync: rows deleted while the agent was down exist
+        here but not in the live table, and must be re-checked)."""
+        from corrosion_tpu.types.pack import pack_columns
+
+        conn = self._conn
+        assert conn is not None
+        aliases = [
+            f'"{_pk_alias(table, c)}"'
+            for c in self.store.schema.table(table).pk_cols
+        ]
+        with self._conn_lock:
+            rows = conn.execute(
+                f"SELECT DISTINCT {', '.join(aliases)} FROM sub.query"
+            ).fetchall()
+        return [pack_columns(tuple(r)) for r in rows]
+
+    # -- candidate filtering ----------------------------------------------
+
+    def filter_candidates(
+        self, changes: Sequence[Change]
+    ) -> Dict[str, Set[bytes]]:
+        """Which (table, pk) pairs could affect this query?
+        (updates.rs:424-488 `match_changes` filter)."""
+        out: Dict[str, Set[bytes]] = {}
+        for ch in changes:
+            deps = self.parsed.col_deps.get(ch.table)
+            if deps is None:
+                continue
+            if ch.is_sentinel() or ch.cid in deps:
+                out.setdefault(ch.table, set()).add(ch.pk)
+        return out
+
+    # -- incremental diff --------------------------------------------------
+
+    def handle_candidates(
+        self, candidates: Dict[str, Set[bytes]]
+    ) -> List[SubEvent]:
+        """Diff changed pks against the materialized result
+        (pubsub.rs:1401-1673). Runs on an executor thread."""
+        conn = self._conn
+        assert conn is not None
+        pk_cols = self._pk_alias_cols()
+        ncols = len(self.columns)
+        ins_cols = [f'"{c}"' for c in pk_cols] + [
+            f'"col_{i}"' for i in range(ncols)
+        ]
+        events: List[SubEvent] = []
+        start = time.monotonic()
+        with self._conn_lock:
+            conn.execute("BEGIN")
+            try:
+                for table, pks in candidates.items():
+                    tbl_pks = self.store.schema.table(table).pk_cols
+                    conn.execute(f'DELETE FROM sub."temp_{table}"')
+                    conn.executemany(
+                        f'INSERT INTO sub."temp_{table}" VALUES'
+                        f" ({', '.join('?' * len(tbl_pks))})",
+                        [tuple(unpack_columns(pk)) for pk in pks],
+                    )
+                conn.execute("DROP TABLE IF EXISTS sub.state_results")
+                selects = [
+                    self._table_query(table) for table in candidates
+                ]
+                conn.execute(
+                    "CREATE TABLE sub.state_results AS "
+                    + " UNION ".join(selects)
+                )
+                res_cols = [
+                    d[1]
+                    for d in conn.execute(
+                        "PRAGMA sub.table_info(state_results)"
+                    )
+                ]
+                # state_results columns = pk aliases then user cols in order
+                sr_pk = [f'"{c}"' for c in res_cols[: len(pk_cols)]]
+                sr_user = [f'"{c}"' for c in res_cols[len(pk_cols):]]
+
+                events.extend(self._diff_updates(conn, pk_cols, sr_pk, sr_user))
+                events.extend(
+                    self._diff_inserts(conn, pk_cols, ins_cols, sr_pk, sr_user)
+                )
+                events.extend(
+                    self._diff_deletes(conn, candidates, pk_cols)
+                )
+                for ev in events:
+                    conn.execute(
+                        "INSERT INTO sub.changes (id, type, __corro_rowid,"
+                        " data) VALUES (?, ?, ?, ?)",
+                        (
+                            ev.change_id,
+                            ev.kind,
+                            ev.rowid,
+                            json.dumps(ev.values, separators=(",", ":")),
+                        ),
+                    )
+                conn.execute("COMMIT")
+            except BaseException:
+                conn.execute("ROLLBACK")
+                raise
+        METRICS.histogram("corro.subs.process.time.seconds", id=self.id).observe(time.monotonic() - start)
+        return events
+
+    def _next_id(self) -> int:
+        self.last_change_id += 1
+        return self.last_change_id
+
+    def _diff_updates(self, conn, pk_cols, sr_pk, sr_user) -> List[SubEvent]:
+        """Rows whose pk exists but whose values changed → update."""
+        ncols = len(self.columns)
+        if ncols == 0:
+            return []
+        on = " AND ".join(
+            f'q."{c}" IS s.{sc}' for c, sc in zip(pk_cols, sr_pk)
+        )
+        differs = " OR ".join(
+            f'q."col_{i}" IS NOT s.{sc}' for i, sc in enumerate(sr_user)
+        )
+        sets = ", ".join(
+            f'"col_{i}" = s.{sc}' for i, sc in enumerate(sr_user)
+        )
+        # RETURNING may not use the update alias in sqlite: unqualified
+        # names resolve against the modified table only
+        ret = ", ".join(f'"col_{i}"' for i in range(ncols))
+        rows = conn.execute(
+            f"UPDATE sub.query AS q SET {sets} FROM sub.state_results s"
+            f" WHERE {on} AND ({differs})"
+            f" RETURNING __corro_rowid, {ret}"
+        ).fetchall()
+        return [
+            SubEvent(
+                self._next_id(),
+                "update",
+                r[0],
+                [dump_value(v) for v in list(r)[1:]],
+            )
+            for r in rows
+        ]
+
+    def _diff_inserts(
+        self, conn, pk_cols, ins_cols, sr_pk, sr_user
+    ) -> List[SubEvent]:
+        missing = " AND ".join(
+            f'q."{c}" IS s.{sc}' for c, sc in zip(pk_cols, sr_pk)
+        )
+        sel = ", ".join(sr_pk + sr_user)
+        rows = conn.execute(
+            f"INSERT INTO sub.query ({', '.join(ins_cols)})"
+            f" SELECT {sel} FROM sub.state_results s"
+            f" WHERE NOT EXISTS (SELECT 1 FROM sub.query q WHERE {missing})"
+            f" RETURNING __corro_rowid,"
+            f" {', '.join(f'col_{i}' for i in range(len(self.columns)))}"
+        ).fetchall()
+        return [
+            SubEvent(
+                self._next_id(),
+                "insert",
+                r[0],
+                [dump_value(v) for v in list(r)[1:]],
+            )
+            for r in rows
+        ]
+
+    def _diff_deletes(self, conn, candidates, pk_cols) -> List[SubEvent]:
+        """Materialized rows whose driving pks were candidates but which
+        no longer appear in state_results → delete."""
+        events: List[SubEvent] = []
+        ncols = len(self.columns)
+        ret = ", ".join(f'"col_{i}"' for i in range(ncols))
+        for table in candidates:
+            tbl_pks = self.store.schema.table(table).pk_cols
+            aliases = [f'"{_pk_alias(table, c)}"' for c in tbl_pks]
+            in_temp = (
+                f"({', '.join('q.' + a for a in aliases)}) IN"
+                f" (SELECT {', '.join(f'\"{c}\"' for c in tbl_pks)}"
+                f' FROM sub."temp_{table}")'
+            )
+            all_aliases = [f'"{c}"' for c in pk_cols]
+            not_in_results = (
+                f"NOT EXISTS (SELECT 1 FROM sub.state_results s WHERE "
+                + " AND ".join(
+                    f"q.{a} IS s.{a}" for a in all_aliases
+                )
+                + ")"
+            )
+            sel = f", {ret}" if ncols else ""
+            rows = conn.execute(
+                f"DELETE FROM sub.query AS q WHERE {in_temp} AND"
+                f" {not_in_results} RETURNING __corro_rowid{sel}"
+            ).fetchall()
+            for r in rows:
+                events.append(
+                    SubEvent(
+                        self._next_id(),
+                        "delete",
+                        r[0],
+                        [dump_value(v) for v in list(r)[1:]],
+                    )
+                )
+        return events
+
+    # -- log / catch-up ----------------------------------------------------
+
+    def changes_since(self, from_id: int) -> Optional[List[SubEvent]]:
+        """Replay the changes log after `from_id`; None if pruned away."""
+        conn = self._conn
+        assert conn is not None
+        with self._conn_lock:
+            row = conn.execute("SELECT MIN(id) AS m FROM sub.changes").fetchone()
+            min_id = row["m"]
+            if min_id is not None and from_id + 1 < min_id:
+                return None  # gap: log pruned past the requested id
+            rows = conn.execute(
+                "SELECT id, type, __corro_rowid, data FROM sub.changes"
+                " WHERE id > ? ORDER BY id",
+                (from_id,),
+            ).fetchall()
+        return [
+            SubEvent(r["id"], r["type"], r["__corro_rowid"], json.loads(r["data"]))
+            for r in rows
+        ]
+
+    def prune_log(self) -> int:
+        conn = self._conn
+        assert conn is not None
+        with self._conn_lock:
+            cur = conn.execute(
+                "DELETE FROM sub.changes WHERE id <= "
+                "(SELECT MAX(id) FROM sub.changes) - ?",
+                (CHANGES_LOG_KEEP,),
+            )
+        return cur.rowcount
+
+    def close(self) -> None:
+        if self._conn is not None:
+            with contextlib.suppress(sqlite3.Error):
+                self._conn.close()
+            self._conn = None
+
+
+def _left_to_inner(from_clause: str, alias: str) -> str:
+    """Replace `LEFT [OUTER] JOIN <tbl> [AS] <alias>` with INNER JOIN for
+    the driving table (pubsub.rs:688-711)."""
+    import re
+
+    pat = re.compile(
+        r"LEFT\s+(?:OUTER\s+)?JOIN(?P<rest>\s+\S+(?:\s+AS)?\s+"
+        + re.escape(alias)
+        + r"\b)",
+        re.IGNORECASE,
+    )
+    def sub(m):
+        return "JOIN" + m.group("rest")
+
+    out = pat.sub(sub, from_clause, count=1)
+    if out == from_clause:
+        # alias == table name, unaliased form
+        pat2 = re.compile(
+            r"LEFT\s+(?:OUTER\s+)?JOIN(?P<rest>\s+" + re.escape(alias) + r"\b)",
+            re.IGNORECASE,
+        )
+        out = pat2.sub(sub, from_clause, count=1)
+    return out
+
+
+class MatcherHandle:
+    """Async face of a Matcher: candidate queue, subscriber fan-out,
+    lifecycle task. Mirrors `MatcherHandle` (pubsub.rs:518)."""
+
+    def __init__(self, matcher: Matcher, loop: asyncio.AbstractEventLoop):
+        self.matcher = matcher
+        self.loop = loop
+        self.id = matcher.id
+        self.sql = matcher.sql
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._subscribers: List[asyncio.Queue] = []
+        self._sub_lock = threading.Lock()
+        self._task: Optional[asyncio.Task] = None
+        self._done = asyncio.Event()
+        self.error: Optional[str] = None
+        self.created_at = time.time()
+        self.processed = 0
+
+    @property
+    def hash(self) -> str:
+        import hashlib
+
+        return hashlib.sha256(self.sql.encode()).hexdigest()[:16]
+
+    @property
+    def columns(self) -> List[str]:
+        return self.matcher.columns
+
+    @property
+    def last_change_id(self) -> int:
+        return self.matcher.last_change_id
+
+    # -- feeding (thread-safe; called from change hooks on any thread) -----
+
+    def match_changes(self, changes: Sequence[Change]) -> None:
+        cands = self.matcher.filter_candidates(changes)
+        if not cands:
+            return
+        METRICS.counter("corro.subs.matched.count", id=self.id).inc(sum(len(v) for v in cands.values()))
+        self.loop.call_soon_threadsafe(self._queue.put_nowait, cands)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._task = self.loop.create_task(self._cmd_loop())
+
+    async def _cmd_loop(self) -> None:
+        """Batch candidates 600 ms / 1000 entries then diff
+        (pubsub.rs:1062-1226)."""
+        last_prune = time.monotonic()
+        try:
+            while True:
+                batch: Dict[str, Set[bytes]] = {}
+                n = 0
+                first = await self._queue.get()
+                if first is None:
+                    break
+                deadline = self.loop.time() + CANDIDATE_BATCH_WAIT
+                for t, pks in first.items():
+                    batch.setdefault(t, set()).update(pks)
+                    n += len(pks)
+                while n < CANDIDATE_BATCH_MAX:
+                    timeout = deadline - self.loop.time()
+                    if timeout <= 0:
+                        break
+                    try:
+                        more = await asyncio.wait_for(
+                            self._queue.get(), timeout
+                        )
+                    except asyncio.TimeoutError:
+                        break
+                    if more is None:
+                        self._queue.put_nowait(None)  # re-signal stop
+                        break
+                    for t, pks in more.items():
+                        batch.setdefault(t, set()).update(pks)
+                        n += len(pks)
+                events = await asyncio.to_thread(
+                    self.matcher.handle_candidates, batch
+                )
+                self.processed += n
+                if events:
+                    self._fan_out(events)
+                if time.monotonic() - last_prune > PRUNE_INTERVAL:
+                    await asyncio.to_thread(self.matcher.prune_log)
+                    last_prune = time.monotonic()
+        except Exception as e:  # matcher died: notify subscribers
+            self.error = str(e)
+            METRICS.counter("corro.subs.errors.count", id=self.id).inc()
+            self._fan_out([None])
+        finally:
+            self._done.set()
+
+    def _fan_out(self, events: List[Optional[SubEvent]]) -> None:
+        with self._sub_lock:
+            subs = list(self._subscribers)
+        for q in subs:
+            for ev in events:
+                q.put_nowait(ev)
+
+    def attach(self) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue()
+        with self._sub_lock:
+            self._subscribers.append(q)
+        return q
+
+    def detach(self, q: asyncio.Queue) -> None:
+        with self._sub_lock:
+            with contextlib.suppress(ValueError):
+                self._subscribers.remove(q)
+
+    @property
+    def subscriber_count(self) -> int:
+        with self._sub_lock:
+            return len(self._subscribers)
+
+    async def stop(self) -> None:
+        self._queue.put_nowait(None)
+        if self._task is not None:
+            await self._done.wait()
+            self._task = None
+        await asyncio.to_thread(self.matcher.close)

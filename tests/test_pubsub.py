@@ -1,0 +1,350 @@
+"""Live-query engine: parse, initial materialization, incremental diff,
+catch-up, updates classification.
+
+Mirrors the reference's pubsub unit coverage
+(`klukai-types/src/pubsub.rs:2407+` and the subscription flows in
+`api/public/pubsub.rs`), driven through the local write path so matcher
+candidates arrive exactly as they do in production.
+"""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.pubsub.parse import ParseError, parse_select
+from corrosion_tpu.pubsub.manager import SubsManager
+from corrosion_tpu.pubsub.updates import UpdatesManager
+from corrosion_tpu.store.crdt import CrdtStore
+from corrosion_tpu.types.base import Timestamp
+
+SCHEMA = """
+CREATE TABLE users (
+  id INTEGER NOT NULL PRIMARY KEY,
+  name TEXT NOT NULL DEFAULT '',
+  age INTEGER NOT NULL DEFAULT 0
+);
+CREATE TABLE posts (
+  user_id INTEGER NOT NULL,
+  post_id INTEGER NOT NULL,
+  title TEXT,
+  PRIMARY KEY (user_id, post_id)
+);
+"""
+
+
+def make_store():
+    store = CrdtStore(":memory:")
+    store.apply_schema_sql(SCHEMA)
+    return store
+
+
+def write(store, sql, params=()):
+    with store.write_tx(Timestamp(0)) as tx:
+        tx.execute(sql, params)
+        changes, version, last_seq = tx.commit()
+    return changes
+
+
+# -- parse ----------------------------------------------------------------
+
+
+def test_parse_single_table():
+    store = make_store()
+    p = parse_select("SELECT name FROM users WHERE age > 21", store.schema)
+    assert p.table_names() == ["users"]
+    assert p.col_deps["users"] == {"name", "age", "id"}
+    assert p.where_clause == "age > 21"
+
+
+def test_parse_join_with_aliases():
+    store = make_store()
+    p = parse_select(
+        "SELECT u.name, p.title FROM users u"
+        " JOIN posts AS p ON p.user_id = u.id",
+        store.schema,
+    )
+    assert p.table_names() == ["users", "posts"]
+    assert "name" in p.col_deps["users"]
+    assert "title" in p.col_deps["posts"]
+    # pks always included
+    assert "id" in p.col_deps["users"]
+    assert {"user_id", "post_id"} <= p.col_deps["posts"]
+
+
+def test_parse_star_marks_all_columns():
+    store = make_store()
+    p = parse_select("SELECT * FROM users", store.schema)
+    assert p.col_deps["users"] == {"id", "name", "age"}
+
+
+def test_parse_rejections():
+    store = make_store()
+    for bad in (
+        "INSERT INTO users VALUES (1, 'x', 2)",
+        "SELECT 1",  # no FROM
+        "SELECT * FROM nope",
+        "SELECT * FROM users UNION SELECT * FROM users",
+        "WITH x AS (SELECT 1) SELECT * FROM x",
+    ):
+        with pytest.raises(ParseError):
+            parse_select(bad, store.schema)
+
+
+# -- matcher lifecycle ----------------------------------------------------
+
+
+def run_async(coro):
+    return asyncio.run(coro)
+
+
+def test_initial_materialization_and_incremental():
+    async def main():
+        store = make_store()
+        write(store, "INSERT INTO users (id, name, age) VALUES (1, 'ann', 30)")
+        write(store, "INSERT INTO users (id, name, age) VALUES (2, 'bob', 17)")
+
+        subs = SubsManager(store)
+        handle, created, rows = await subs.get_or_insert(
+            "SELECT name FROM users WHERE age >= 18"
+        )
+        assert created
+        assert handle.columns == ["name"]
+        assert [v for (_rid, v) in rows] == [["ann"]]
+
+        q = handle.attach()
+
+        # insert matching → insert event
+        subs.match_changes(
+            write(
+                store,
+                "INSERT INTO users (id, name, age) VALUES (3, 'cyn', 44)",
+            )
+        )
+        ev = await asyncio.wait_for(q.get(), 5)
+        assert (ev.kind, ev.values) == ("insert", ["cyn"])
+
+        # update matching row's projected col → update event
+        subs.match_changes(
+            write(store, "UPDATE users SET name = 'ann2' WHERE id = 1")
+        )
+        ev = await asyncio.wait_for(q.get(), 5)
+        assert (ev.kind, ev.values) == ("update", ["ann2"])
+
+        # row falls out of the predicate → delete event
+        subs.match_changes(
+            write(store, "UPDATE users SET age = 10 WHERE id = 3")
+        )
+        ev = await asyncio.wait_for(q.get(), 5)
+        assert (ev.kind, ev.values) == ("delete", ["cyn"])
+
+        # row enters the predicate → insert event
+        subs.match_changes(
+            write(store, "UPDATE users SET age = 18 WHERE id = 2")
+        )
+        ev = await asyncio.wait_for(q.get(), 5)
+        assert (ev.kind, ev.values) == ("insert", ["bob"])
+
+        # real DELETE → delete event
+        subs.match_changes(write(store, "DELETE FROM users WHERE id = 1"))
+        ev = await asyncio.wait_for(q.get(), 5)
+        assert (ev.kind, ev.values) == ("delete", ["ann2"])
+
+        # change ids are monotonically increasing from 1
+        assert handle.last_change_id == 5
+        await subs.stop_all()
+
+    run_async(main())
+
+
+def test_join_subscription():
+    async def main():
+        store = make_store()
+        write(store, "INSERT INTO users (id, name, age) VALUES (1, 'ann', 30)")
+        write(
+            store,
+            "INSERT INTO posts (user_id, post_id, title)"
+            " VALUES (1, 1, 'hello')",
+        )
+        subs = SubsManager(store)
+        handle, created, rows = await subs.get_or_insert(
+            "SELECT u.name, p.title FROM users u"
+            " JOIN posts p ON p.user_id = u.id"
+        )
+        assert [v for (_r, v) in rows] == [["ann", "hello"]]
+        q = handle.attach()
+
+        # new post by the same user → insert event through the join
+        subs.match_changes(
+            write(
+                store,
+                "INSERT INTO posts (user_id, post_id, title)"
+                " VALUES (1, 2, 'world')",
+            )
+        )
+        ev = await asyncio.wait_for(q.get(), 5)
+        assert (ev.kind, ev.values) == ("insert", ["ann", "world"])
+
+        # renaming the user updates every joined row
+        subs.match_changes(
+            write(store, "UPDATE users SET name = 'ANN' WHERE id = 1")
+        )
+        got = {}
+        for _ in range(2):
+            ev = await asyncio.wait_for(q.get(), 5)
+            got[tuple(ev.values)] = ev.kind
+        assert got == {("ANN", "hello"): "update", ("ANN", "world"): "update"}
+        await subs.stop_all()
+
+    run_async(main())
+
+
+def test_dedupe_and_catch_up():
+    async def main():
+        store = make_store()
+        subs = SubsManager(store)
+        h1, c1, _ = await subs.get_or_insert("SELECT name FROM users")
+        h2, c2, _ = await subs.get_or_insert("SELECT name FROM users")
+        assert c1 and not c2 and h1.id == h2.id
+
+        subs.match_changes(
+            write(store, "INSERT INTO users (id, name) VALUES (1, 'a')")
+        )
+        subs.match_changes(
+            write(store, "INSERT INTO users (id, name) VALUES (2, 'b')")
+        )
+        q = h1.attach()
+        ev1 = await asyncio.wait_for(q.get(), 5)
+        ev2 = await asyncio.wait_for(q.get(), 5)
+        h1.detach(q)
+
+        # catch-up replays the log after a given change id
+        evs = h1.matcher.changes_since(ev1.change_id)
+        assert [e.change_id for e in evs] == [ev2.change_id]
+        assert h1.matcher.changes_since(ev2.change_id) == []
+        await subs.stop_all()
+
+    run_async(main())
+
+
+def test_restore_from_disk(tmp_path):
+    async def main():
+        db = str(tmp_path / "main.db")
+        subs_path = str(tmp_path / "subs")
+        store = CrdtStore(db)
+        store.apply_schema_sql(SCHEMA)
+        write(store, "INSERT INTO users (id, name, age) VALUES (1, 'a', 5)")
+
+        subs = SubsManager(store, subs_path)
+        handle, _, rows = await subs.get_or_insert("SELECT name FROM users")
+        sub_id = handle.id
+        assert len(rows) == 1
+        await subs.stop_all()
+
+        # writes land while no matcher is running: one insert, one delete
+        write(store, "INSERT INTO users (id, name, age) VALUES (2, 'late', 9)")
+        write(store, "DELETE FROM users WHERE id = 1")
+
+        subs2 = SubsManager(store, subs_path)
+        n = await subs2.restore()
+        assert n == 1
+        h = subs2.get(sub_id)
+        assert h is not None and h.columns == ["name"]
+        q = h.attach()
+        # the restore resync sweep must surface both the missed insert
+        # AND the missed delete (reference: match_changes_from_db_version)
+        got = {}
+        for _ in range(2):
+            ev = await asyncio.wait_for(q.get(), 5)
+            got[ev.values[0]] = ev.kind
+        assert got == {"late": "insert", "a": "delete"}
+        rows = h.matcher.all_rows()
+        assert sorted(v[0] for (_r, v) in rows) == ["late"]
+        await subs2.stop_all()
+        store.close()
+
+    run_async(main())
+
+
+# -- updates engine -------------------------------------------------------
+
+
+def test_updates_classification():
+    async def main():
+        store = make_store()
+        mgr = UpdatesManager(store)
+        handle, created = await mgr.get_or_insert("users")
+        assert created
+        q = handle.attach()
+
+        mgr.match_changes(
+            write(store, "INSERT INTO users (id, name) VALUES (7, 'x')")
+        )
+        kind, pk = await asyncio.wait_for(q.get(), 5)
+        assert (kind, pk) == ("insert", [7])
+
+        mgr.match_changes(
+            write(store, "UPDATE users SET name = 'y' WHERE id = 7")
+        )
+        kind, pk = await asyncio.wait_for(q.get(), 5)
+        assert (kind, pk) == ("update", [7])
+
+        mgr.match_changes(write(store, "DELETE FROM users WHERE id = 7"))
+        kind, pk = await asyncio.wait_for(q.get(), 5)
+        assert (kind, pk) == ("delete", [7])
+
+        # resurrect: causal length bumps to odd again → insert
+        mgr.match_changes(
+            write(store, "INSERT INTO users (id, name) VALUES (7, 'z')")
+        )
+        kind, pk = await asyncio.wait_for(q.get(), 5)
+        assert (kind, pk) == ("insert", [7])
+
+        with pytest.raises(KeyError):
+            await mgr.get_or_insert("nope")
+        await mgr.stop_all()
+
+    run_async(main())
+
+
+def test_updates_delete_then_reinsert_same_batch():
+    """A delete (cl=2) and re-insert (cl=3) landing in the same 600 ms
+    window must resolve to the later causal length: insert, not delete."""
+
+    async def main():
+        store = make_store()
+        mgr = UpdatesManager(store)
+        handle, _ = await mgr.get_or_insert("users")
+        write(store, "INSERT INTO users (id, name) VALUES (1, 'a')")
+
+        q = handle.attach()
+        deleted = write(store, "DELETE FROM users WHERE id = 1")
+        reinserted = write(store, "INSERT INTO users (id, name) VALUES (1, 'b')")
+        # both classified before the batch flushes
+        mgr.match_changes(deleted + reinserted)
+        kind, pk = await asyncio.wait_for(q.get(), 5)
+        assert (kind, pk) == ("insert", [1])
+        await mgr.stop_all()
+
+    run_async(main())
+
+
+def test_expand_sql_token_level():
+    from corrosion_tpu.api.types import parse_statement
+    from corrosion_tpu.api.pubsub_http import expand_sql
+    from corrosion_tpu.pubsub.parse import ParseError as PE
+
+    # prefix-colliding named params
+    s = parse_statement(
+        ["SELECT * FROM t WHERE x = :a AND y = :ab", {"a": 1, "ab": 2}]
+    )
+    out = expand_sql(s)
+    assert "x = 1" in out and "y = 2" in out
+
+    # placeholder-looking text inside a string literal is untouched
+    s = parse_statement(["SELECT * FROM t WHERE x = ? AND y = ':a ?'", [5]])
+    out = expand_sql(s)
+    assert "x = 5" in out and "':a ?'" in out
+
+    s = parse_statement(["SELECT * FROM t WHERE x = ?", [1, 2]])
+    with pytest.raises(PE):
+        expand_sql(s)
