@@ -1,0 +1,5 @@
+"""`python -m corrosion_tpu` → the corrosion CLI."""
+
+from corrosion_tpu.cli import main
+
+main()

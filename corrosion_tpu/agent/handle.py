@@ -17,6 +17,7 @@ from corrosion_tpu.agent.membership import Membership
 from corrosion_tpu.net.transport import Listener, Transport
 from corrosion_tpu.runtime.channels import Receiver, Sender
 from corrosion_tpu.runtime.config import Config
+from corrosion_tpu.runtime.locks import LockRegistry
 from corrosion_tpu.runtime.tripwire import TaskTracker, Tripwire
 from corrosion_tpu.store.bookkeeping import Bookie
 from corrosion_tpu.store.crdt import CrdtStore
@@ -71,6 +72,8 @@ class Agent:
     # live-query + raw-update managers (agent.rs:64-273 subs/updates)
     subs: Optional[object] = None  # SubsManager
     updates: Optional[object] = None  # UpdatesManager
+    # instrumented-lock registry (agent.rs:707-1066), admin `locks` command
+    lock_registry: LockRegistry = field(default_factory=LockRegistry)
 
     @property
     def actor_id(self) -> ActorId:

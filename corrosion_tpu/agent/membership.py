@@ -183,6 +183,29 @@ class Membership:
         for actor, incarnation, state in states:
             self._apply_update(MemberUpdate(actor, incarnation, state))
 
+    async def rejoin(self) -> Actor:
+        """Operator-triggered full rejoin (admin Cluster Rejoin →
+        FocaCmd::Rejoin, `klukai/src/admin.rs`): renew identity and
+        re-announce to every active member."""
+        self.identity = self.identity.renew()
+        self._incarnation = 0
+        self._disseminate(MemberUpdate(self.identity, 0, MemberState.ALIVE))
+        for actor in self.active_members():
+            await self.announce(actor.addr)
+        return self.identity
+
+    async def change_cluster_id(self, cluster_id) -> Actor:
+        """Admin Cluster SetId → ChangeIdentity: same node id, new cluster.
+        Peers in the old cluster will drop our datagrams from now on."""
+        from dataclasses import replace
+
+        self.identity = replace(
+            self.identity.renew(), cluster_id=cluster_id
+        )
+        self._incarnation = 0
+        self._disseminate(MemberUpdate(self.identity, 0, MemberState.ALIVE))
+        return self.identity
+
     # -- sending -----------------------------------------------------------
 
     async def _send(self, addr: str, msg: SwimMessage) -> None:

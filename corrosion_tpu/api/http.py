@@ -74,7 +74,9 @@ class ApiServer:
         return app
 
     async def start(self) -> None:
-        self._runner = web.AppRunner(self.build_app())
+        # short shutdown grace: live NDJSON subscription streams otherwise
+        # hold the runner open indefinitely on cleanup
+        self._runner = web.AppRunner(self.build_app(), shutdown_timeout=2.0)
         await self._runner.setup()
         for bind in self.agent.config.api.bind_addr:
             host, _, port = bind.rpartition(":")
@@ -86,6 +88,12 @@ class ApiServer:
                 self.addrs.append(f"{name[0]}:{name[1]}")
 
     async def stop(self) -> None:
+        # end live subscription/update streams first (their handlers block
+        # on queue.get() until a None sentinel arrives), then tear down
+        if self.subs is not None:
+            await self.subs.stop_all()
+        if self.updates is not None:
+            await self.updates.stop_all()
         if self._runner is not None:
             await self._runner.cleanup()
 

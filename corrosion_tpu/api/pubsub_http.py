@@ -48,32 +48,40 @@ def expand_sql(stmt) -> str:
     if not stmt.params and not stmt.named_params:
         return stmt.query
     tokens = tokenize(stmt.query)
-    named = {
-        (k if k[0] in ":@$" else ":" + k): v
-        for k, v in (stmt.named_params or {}).items()
-    }
+    # a bare key binds any placeholder style (sqlite accepts :k, @k, $k)
+    named = {}
+    for k, v in (stmt.named_params or {}).items():
+        if k[0] in ":@$":
+            named[k] = v
+        else:
+            for prefix in ":@$":
+                named[prefix + k] = v
     out = []
-    pos_iter = iter(stmt.params or [])
-    n_positional = 0
+    params = stmt.params or []
+    # sqlite ?N semantics: ?N binds params[N-1]; bare ? binds one past the
+    # largest index assigned so far
+    max_idx = 0
     for tok in tokens:
         if tok.kind == "param":
             if tok.text.startswith("?"):
-                try:
-                    v = next(pos_iter)
-                except StopIteration:
-                    raise ParseError("not enough positional params")
-                n_positional += 1
-                out.append(type(tok)("num", _literal(v)))
+                idx = int(tok.text[1:]) if len(tok.text) > 1 else max_idx + 1
+                if not 1 <= idx <= len(params):
+                    raise ParseError(
+                        f"parameter {tok.text} out of range"
+                        f" (got {len(params)} params)"
+                    )
+                max_idx = max(max_idx, idx)
+                out.append(type(tok)("num", _literal(params[idx - 1])))
                 continue
             if tok.text in named:
                 out.append(type(tok)("num", _literal(named[tok.text])))
                 continue
             raise ParseError(f"unbound parameter {tok.text}")
         out.append(tok)
-    if stmt.params and n_positional != len(stmt.params):
+    if params and max_idx != len(params):
         raise ParseError(
-            f"statement has {n_positional} placeholders,"
-            f" got {len(stmt.params)} params"
+            f"statement uses {max_idx} positional params,"
+            f" got {len(params)}"
         )
     return _join_tokens(out)
 
@@ -101,7 +109,7 @@ async def handle_subscribe(api, request: web.Request) -> web.StreamResponse:
         return web.json_response({"error": str(e)}, status=400)
 
     try:
-        handle, _created, _rows = await api.subs.get_or_insert(sql)
+        handle, _created = await api.subs.get_or_insert(sql)
     except ParseError as e:
         return web.json_response({"error": str(e)}, status=400)
 
@@ -115,6 +123,9 @@ async def handle_subscription_by_id(
     handle = api.subs.get(sub_id)
     if handle is None:
         return web.json_response({"error": "unknown subscription"}, status=404)
+    if handle.error is not None:
+        # dead matcher pending removal: re-attaching would hang forever
+        return web.json_response({"error": handle.error}, status=404)
     try:
         skip_rows, from_id = _stream_params(request)
     except ValueError as e:
@@ -209,7 +220,10 @@ async def handle_updates(api, request: web.Request) -> web.StreamResponse:
     q = handle.attach()
     try:
         while True:
-            kind, pk_values = await q.get()
+            ev = await q.get()
+            if ev is None:  # handle stopped
+                break
+            kind, pk_values = ev
             await resp.write((ev_notify(kind, pk_values) + "\n").encode())
     except (ConnectionResetError, asyncio.CancelledError):
         pass

@@ -1,0 +1,425 @@
+"""`corrosion` CLI: the full operator command surface.
+
+Counterpart of `klukai/src/main.rs:569-826`'s command tree:
+
+  agent                      run the agent with a config file
+  backup PATH                VACUUM INTO + scrub per-node state
+  restore PATH               swap the db file under full SQLite locks
+  cluster rejoin|members|membership-states|set-id
+  consul sync                bidirectional Consul <-> store replication
+  query SQL                  one-shot query through the HTTP API
+  exec SQL...                transaction through the HTTP API
+  reload                     re-apply schema files via /v1/migrations
+  sync generate|reconcile-gaps
+  locks [--top N]
+  actor version ACTOR_ID VERSION
+  template FILE...           render templates (optionally watch)
+  tls ca|server|client generate
+  db lock CMD                run CMD while holding every SQLite lock
+  subs list|info
+  log set|reset
+
+Global flags: -c/--config, --api-addr, --db-path, --admin-path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+from typing import List, Optional
+
+from corrosion_tpu.runtime.config import Config, load_config
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="corrosion",
+        description="TPU-native gossip-based multi-writer distributed store",
+    )
+    p.add_argument("-c", "--config", default="corrosion.toml")
+    p.add_argument("--api-addr", default=None)
+    p.add_argument("--db-path", default=None)
+    p.add_argument("--admin-path", default=None)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("agent", help="run the agent")
+
+    b = sub.add_parser("backup", help="back up the database")
+    b.add_argument("path")
+
+    r = sub.add_parser("restore", help="restore a backup over the live db")
+    r.add_argument("path")
+    r.add_argument("--self-actor-id", default=None)
+
+    cluster = sub.add_parser("cluster").add_subparsers(
+        dest="sub", required=True
+    )
+    cluster.add_parser("rejoin")
+    cluster.add_parser("members")
+    cluster.add_parser("membership-states")
+    sid = cluster.add_parser("set-id")
+    sid.add_argument("cluster_id", type=int)
+
+    consul = sub.add_parser("consul").add_subparsers(dest="sub", required=True)
+    consul.add_parser("sync")
+
+    q = sub.add_parser("query")
+    q.add_argument("sql")
+    q.add_argument("--columns", action="store_true")
+    q.add_argument("--timer", action="store_true")
+    q.add_argument("--param", action="append", default=[])
+
+    e = sub.add_parser("exec")
+    e.add_argument("sql", nargs="+")
+
+    sub.add_parser("reload", help="re-apply schema files")
+
+    sy = sub.add_parser("sync").add_subparsers(dest="sub", required=True)
+    sy.add_parser("generate")
+    sy.add_parser("reconcile-gaps")
+
+    lk = sub.add_parser("locks")
+    lk.add_argument("--top", type=int, default=None)
+
+    actor = sub.add_parser("actor").add_subparsers(dest="sub", required=True)
+    av = actor.add_parser("version")
+    av.add_argument("actor_id")
+    av.add_argument("version", type=int)
+
+    t = sub.add_parser("template")
+    t.add_argument("files", nargs="+", help="TEMPLATE[:OUTPUT] specs")
+    t.add_argument("--watch", action="store_true")
+
+    tls = sub.add_parser("tls").add_subparsers(dest="sub", required=True)
+    ca = tls.add_parser("ca").add_subparsers(dest="subsub", required=True)
+    cag = ca.add_parser("generate")
+    cag.add_argument("--cert-file", default="./ca-cert.pem")
+    cag.add_argument("--key-file", default="./ca-key.pem")
+    srv = tls.add_parser("server").add_subparsers(dest="subsub", required=True)
+    srvg = srv.add_parser("generate")
+    srvg.add_argument("ip")
+    srvg.add_argument("--ca-cert", default="./ca-cert.pem")
+    srvg.add_argument("--ca-key", default="./ca-key.pem")
+    srvg.add_argument("--cert-file", default="./server-cert.pem")
+    srvg.add_argument("--key-file", default="./server-key.pem")
+    cli_ = tls.add_parser("client").add_subparsers(dest="subsub", required=True)
+    clig = cli_.add_parser("generate")
+    clig.add_argument("--ca-cert", default="./ca-cert.pem")
+    clig.add_argument("--ca-key", default="./ca-key.pem")
+    clig.add_argument("--cert-file", default="./client-cert.pem")
+    clig.add_argument("--key-file", default="./client-key.pem")
+
+    db = sub.add_parser("db").add_subparsers(dest="sub", required=True)
+    dblock = db.add_parser("lock")
+    dblock.add_argument("cmd")
+
+    subs = sub.add_parser("subs").add_subparsers(dest="sub", required=True)
+    subs.add_parser("list")
+    si = subs.add_parser("info")
+    si.add_argument("--id", default=None)
+    si.add_argument("--hash", default=None)
+
+    lg = sub.add_parser("log").add_subparsers(dest="sub", required=True)
+    ls = lg.add_parser("set")
+    ls.add_argument("filter")
+    lg.add_parser("reset")
+
+    return p
+
+
+def _load_cfg(args) -> Config:
+    try:
+        cfg = load_config(args.config)
+    except FileNotFoundError:
+        cfg = Config()
+    if args.api_addr:
+        cfg.api.bind_addr = [args.api_addr]
+    if args.db_path:
+        cfg.db.path = args.db_path
+    if args.admin_path:
+        cfg.admin.uds_path = args.admin_path
+    return cfg
+
+
+def _api_addr(cfg: Config) -> str:
+    return cfg.api.bind_addr[0]
+
+
+async def _admin_call(cfg: Config, cmd: dict) -> int:
+    from corrosion_tpu.admin import AdminClient
+
+    try:
+        async with AdminClient(cfg.admin.uds_path) as c:
+            r = await c.call(cmd)
+    except (ConnectionError, FileNotFoundError, OSError) as e:
+        print(f"could not reach admin socket {cfg.admin.uds_path}: {e}",
+              file=sys.stderr)
+        return 1
+    for line in r["logs"]:
+        print(line)
+    for value in r["json"]:
+        print(json.dumps(value, indent=2))
+    if not r["ok"]:
+        print(f"error: {r['error']}", file=sys.stderr)
+        return 1
+    return 0
+
+
+async def _cmd_agent(cfg: Config) -> int:
+    import logging
+
+    from corrosion_tpu.admin import AdminServer
+    from corrosion_tpu.agent.run import run, setup, shutdown
+    from corrosion_tpu.api.http import ApiServer
+    from corrosion_tpu.runtime.metrics import serve_prometheus
+    from corrosion_tpu.runtime.tripwire import Tripwire
+
+    logging.basicConfig(
+        level=cfg.log.level.upper(),
+        format=(
+            '{"ts":"%(asctime)s","level":"%(levelname)s",'
+            '"logger":"%(name)s","msg":"%(message)s"}'
+            if cfg.log.format == "json"
+            else "%(asctime)s %(levelname)s %(name)s %(message)s"
+        ),
+    )
+
+    tripwire = Tripwire.from_signals()
+    agent = await setup(cfg, tripwire=tripwire)
+    await run(agent)
+
+    api = ApiServer(agent)
+    await api.start()
+    print(f"api listening on {', '.join(api.addrs)}")
+
+    admin = AdminServer(agent, cfg.admin.uds_path)
+    await admin.start()
+
+    prom_runner = None
+    if cfg.telemetry.prometheus_bind_addr:
+        prom_runner = await serve_prometheus(cfg.telemetry.prometheus_bind_addr)
+
+    consul_task = None
+    if cfg.consul.enabled:
+        from corrosion_tpu.consul import consul_sync_loop
+
+        consul_task = asyncio.ensure_future(
+            consul_sync_loop(agent, cfg.consul, tripwire)
+        )
+
+    print(f"agent {agent.actor_id} up; gossip {agent.actor.addr}")
+    await tripwire.wait()
+    print("shutting down…")
+    if consul_task is not None:
+        consul_task.cancel()
+    if prom_runner is not None:
+        await prom_runner.cleanup()
+    await admin.stop()
+    await api.stop()
+    await shutdown(agent)
+    await agent.tracker.wait_all(60.0)
+    return 0
+
+
+async def _cmd_query(cfg: Config, args) -> int:
+    import time as _time
+
+    from corrosion_tpu.client import CorrosionApiClient
+
+    stmt: object = (
+        [args.sql, list(args.param)] if args.param else args.sql
+    )
+    t0 = _time.monotonic()
+    async with CorrosionApiClient(
+        _api_addr(cfg), token=cfg.api.authz_bearer
+    ) as c:
+        async for ev in c.query(stmt):
+            if "columns" in ev and args.columns:
+                print("|".join(ev["columns"]))
+            elif "row" in ev:
+                _rowid, vals = ev["row"]
+                print("|".join(_render(v) for v in vals))
+            elif "error" in ev:
+                print(f"error: {ev['error']}", file=sys.stderr)
+                return 1
+    if args.timer:
+        print(f"time: {_time.monotonic() - t0:.6f}s", file=sys.stderr)
+    return 0
+
+
+def _render(v) -> str:
+    if v is None:
+        return ""
+    return str(v)
+
+
+async def _cmd_exec(cfg: Config, args) -> int:
+    from corrosion_tpu.client import CorrosionApiClient
+
+    async with CorrosionApiClient(
+        _api_addr(cfg), token=cfg.api.authz_bearer
+    ) as c:
+        resp = await c.execute(list(args.sql))
+    print(json.dumps(resp, indent=2))
+    return 0 if "results" in resp else 1
+
+
+async def _cmd_reload(cfg: Config) -> int:
+    from corrosion_tpu.client import CorrosionApiClient
+
+    if not cfg.db.schema_paths:
+        print("no schema_paths configured", file=sys.stderr)
+        return 1
+    async with CorrosionApiClient(
+        _api_addr(cfg), token=cfg.api.authz_bearer
+    ) as c:
+        resp = await c.schema_from_paths(cfg.db.schema_paths)
+    print(json.dumps(resp, indent=2))
+    return 0
+
+
+def _cmd_db_lock(cfg: Config, cmd: str) -> int:
+    import shlex
+    import subprocess
+    import time as _time
+
+    from corrosion_tpu.store.restore import lock_all
+
+    print(f"Opening DB file at {cfg.db.path}")
+    start = _time.monotonic()
+    locks = lock_all(cfg.db.path, timeout=30.0)
+    print(f"Lock acquired after {_time.monotonic() - start:.3f}s")
+    try:
+        argv = shlex.split(cmd)
+        print(f"Launching command {cmd}")
+        code = subprocess.run(argv).returncode
+        print(f"Exited with code: {code}")
+        return code
+    finally:
+        locks.release()
+
+
+async def _cmd_template(cfg: Config, args) -> int:
+    from corrosion_tpu.tpl import render_specs, watch_specs
+
+    if args.watch:
+        await watch_specs(cfg, args.files)
+        return 0
+    return await render_specs(cfg, args.files)
+
+
+async def _amain(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    cfg = _load_cfg(args)
+    cmd = args.command
+
+    if cmd == "agent":
+        return await _cmd_agent(cfg)
+    if cmd == "backup":
+        from corrosion_tpu.store.restore import backup
+
+        backup(cfg.db.path, args.path)
+        print(f"backed up database to {args.path}")
+        return 0
+    if cmd == "restore":
+        from corrosion_tpu.admin import AdminClient
+        from corrosion_tpu.store.restore import restore, set_self_site_id
+
+        # refuse when an agent is live on the admin socket (main.rs:224-330)
+        try:
+            async with AdminClient(cfg.admin.uds_path) as c:
+                r = await c.call({"cmd": "ping"})
+                if r["ok"]:
+                    print(
+                        "an agent is running on this database; stop it first",
+                        file=sys.stderr,
+                    )
+                    return 1
+        except (ConnectionError, FileNotFoundError, OSError):
+            pass
+        if args.self_actor_id:
+            set_self_site_id(args.path, args.self_actor_id)
+        res = restore(args.path, cfg.db.path)
+        print(
+            f"restored {res.new_len} bytes over {res.old_len}"
+            f" (wal={res.is_wal})"
+        )
+        return 0
+    if cmd == "cluster":
+        if args.sub == "set-id":
+            return await _admin_call(
+                cfg,
+                {"cmd": "cluster", "sub": "set-id",
+                 "cluster_id": args.cluster_id},
+            )
+        return await _admin_call(cfg, {"cmd": "cluster", "sub": args.sub})
+    if cmd == "consul":
+        from corrosion_tpu.consul import run_consul_sync_cli
+
+        return await run_consul_sync_cli(cfg)
+    if cmd == "query":
+        return await _cmd_query(cfg, args)
+    if cmd == "exec":
+        return await _cmd_exec(cfg, args)
+    if cmd == "reload":
+        return await _cmd_reload(cfg)
+    if cmd == "sync":
+        return await _admin_call(cfg, {"cmd": "sync", "sub": args.sub})
+    if cmd == "locks":
+        return await _admin_call(cfg, {"cmd": "locks", "top": args.top})
+    if cmd == "actor":
+        return await _admin_call(
+            cfg,
+            {"cmd": "actor", "sub": "version",
+             "actor_id": args.actor_id, "version": args.version},
+        )
+    if cmd == "template":
+        return await _cmd_template(cfg, args)
+    if cmd == "tls":
+        from corrosion_tpu import tls as _tls
+
+        if args.sub == "ca":
+            _tls.generate_ca(args.cert_file, args.key_file)
+            print(f"wrote {args.cert_file}, {args.key_file}")
+        elif args.sub == "server":
+            _tls.generate_server_cert(
+                args.ca_cert, args.ca_key, args.ip,
+                args.cert_file, args.key_file,
+            )
+            print(f"wrote {args.cert_file}, {args.key_file}")
+        elif args.sub == "client":
+            _tls.generate_client_cert(
+                args.ca_cert, args.ca_key,
+                args.cert_file, args.key_file,
+            )
+            print(f"wrote {args.cert_file}, {args.key_file}")
+        return 0
+    if cmd == "db":
+        return _cmd_db_lock(cfg, args.cmd)
+    if cmd == "subs":
+        if args.sub == "list":
+            return await _admin_call(cfg, {"cmd": "subs", "sub": "list"})
+        payload = {"cmd": "subs", "sub": "info"}
+        if args.id:
+            payload["id"] = args.id
+        if args.hash:
+            payload["hash"] = args.hash
+        return await _admin_call(cfg, payload)
+    if cmd == "log":
+        if args.sub == "set":
+            return await _admin_call(
+                cfg, {"cmd": "log", "sub": "set", "filter": args.filter}
+            )
+        return await _admin_call(cfg, {"cmd": "log", "sub": "reset"})
+    print(f"unknown command {cmd}", file=sys.stderr)
+    return 2
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    sys.exit(asyncio.run(_amain(argv)))
+
+
+if __name__ == "__main__":
+    main()

@@ -12,21 +12,21 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
-import hashlib
 import shutil
 import sqlite3
 import uuid
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from corrosion_tpu.pubsub.matcher import Matcher, MatcherError, MatcherHandle
+from corrosion_tpu.pubsub.matcher import (
+    Matcher,
+    MatcherError,
+    MatcherHandle,
+    sql_hash,
+)
 from corrosion_tpu.pubsub.parse import ParseError, parse_select
 from corrosion_tpu.runtime.metrics import METRICS
 from corrosion_tpu.types.change import Change
-
-
-def sql_hash(sql: str) -> str:
-    return hashlib.sha256(sql.encode()).hexdigest()[:16]
 
 
 class SubsManager:
@@ -49,19 +49,15 @@ class SubsManager:
     def handles(self) -> List[MatcherHandle]:
         return list(self._by_id.values())
 
-    async def get_or_insert(
-        self, sql: str
-    ) -> Tuple[MatcherHandle, bool, List]:
-        """Return (handle, created, initial_rows). When created, the
-        initial query has been run and `initial_rows` holds the
-        materialized (rowid, values) rows to stream to the first
-        subscriber; existing matchers return [] (caller reads
-        `all_rows` if it wants a snapshot)."""
+    async def get_or_insert(self, sql: str) -> Tuple[MatcherHandle, bool]:
+        """Return (handle, created). When created, the initial query has
+        materialized into the sub db; subscribers read rows through
+        `handle.matcher.snapshot()` (attach-then-snapshot protocol)."""
         async with self._lock:
             existing = self.get_by_sql(sql)
             if existing is not None:
                 if existing.error is None:
-                    return existing, False, []
+                    return existing, False
                 # dead matcher: tear it down fully before replacing
                 await self._remove_locked(existing.id, purge=True)
             parsed = parse_select(sql, self.store.schema)
@@ -74,7 +70,7 @@ class SubsManager:
                 return matcher.run_initial()
 
             try:
-                _cols, rows = await asyncio.to_thread(build)
+                await asyncio.to_thread(build)
             except (sqlite3.Error, MatcherError) as e:
                 matcher.close()
                 self._purge_dir(sub_id)
@@ -84,7 +80,7 @@ class SubsManager:
             self._by_id[sub_id] = handle
             self._by_hash[sql_hash(sql)] = sub_id
             METRICS.gauge("corro.subs.count").set(len(self._by_id))
-            return handle, True, rows
+            return handle, True
 
     async def restore(self) -> int:
         """Re-attach matchers persisted on disk; purge incomplete ones.

@@ -65,6 +65,14 @@ class SubEvent:
     values: List[Any]  # JSON-ready cell values
 
 
+def sql_hash(sql: str) -> str:
+    """Dedupe key for subscriptions: also the `corro-query-hash` header
+    (the single definition — manager.py re-exports it)."""
+    import hashlib
+
+    return hashlib.sha256(sql.encode()).hexdigest()[:16]
+
+
 def _pk_alias(table: str, col: str) -> str:
     return f"__corro_pk_{table}_{col}"
 
@@ -191,33 +199,38 @@ class Matcher:
     # -- rewrites ----------------------------------------------------------
 
     def _pk_alias_cols(self) -> List[str]:
+        # keyed by ref *alias* (not table name) so self-joins — two refs to
+        # one table — get distinct materialized pk columns
         out = []
         for t in self.parsed.tables:
             for c in self.store.schema.table(t.name).pk_cols:
-                out.append(_pk_alias(t.name, c))
+                out.append(_pk_alias(t.alias, c))
         return out
 
     def _pk_select_prefix(self) -> str:
         parts = []
         for t in self.parsed.tables:
             for c in self.store.schema.table(t.name).pk_cols:
-                parts.append(f'"{t.alias}"."{c}" AS "{_pk_alias(t.name, c)}"')
+                parts.append(f'"{t.alias}"."{c}" AS "{_pk_alias(t.alias, c)}"')
         return ", ".join(parts)
 
     def _probe_query(self) -> str:
-        """Initial/probe form: pk aliases + user select list, full scan."""
+        """Initial/probe form: pk aliases + user select list, full scan.
+        The ORDER BY tail (the only one parse_select admits) shapes the
+        initial fill; incremental change events are unordered."""
         p = self.parsed
         where = f" WHERE {p.where_clause}" if p.where_clause else ""
+        tail = f" {p.tail}" if p.tail else ""
         return (
             f"SELECT {self._pk_select_prefix()}, {p.select_list}"
-            f" FROM {p.from_clause}{where}"
+            f" FROM {p.from_clause}{where}{tail}"
         )
 
-    def _table_query(self, driving: str) -> str:
-        """Rewritten per-driving-table query with the temp pk predicate
+    def _table_query(self, ref) -> str:
+        """Rewritten per-driving-table-ref query with the temp pk predicate
         (pubsub.rs:616-711): restricts re-evaluation to changed pks."""
         p = self.parsed
-        ref = next(t for t in p.tables if t.name == driving)
+        driving = ref.name
         pks = self.store.schema.table(driving).pk_cols
         tuple_lhs = ", ".join(f'"{ref.alias}"."{c}"' for c in pks)
         tuple_rhs = ", ".join(f'"{c}"' for c in pks)
@@ -237,9 +250,10 @@ class Matcher:
 
     # -- initial fill ------------------------------------------------------
 
-    def run_initial(self) -> Tuple[List[str], List[Tuple[int, List[Any]]]]:
-        """Materialize the full result; returns (columns, rows) to stream
-        to the first subscriber (pubsub.rs:1029-1060)."""
+    def run_initial(self) -> Tuple[List[str], int]:
+        """Materialize the full result into sub.query; returns
+        (columns, row_count). Subscribers read rows via `snapshot()` —
+        the attach-then-snapshot protocol (pubsub.rs:1029-1060)."""
         conn = self._conn
         assert conn is not None
         pk_cols = self._pk_alias_cols()
@@ -248,20 +262,17 @@ class Matcher:
             [f'"{c}"' for c in pk_cols]
             + [f'"col_{i}"' for i in range(ncols)]
         )
-        out: List[Tuple[int, List[Any]]] = []
+        n = 0
         with self._conn_lock:
             conn.execute("BEGIN")
             try:
                 for row in conn.execute(self._probe_query()):
-                    vals = tuple(row)
-                    cur = conn.execute(
+                    conn.execute(
                         f"INSERT INTO sub.query ({ins_cols}) VALUES"
                         f" ({', '.join('?' * (len(pk_cols) + ncols))})",
-                        vals,
+                        tuple(row),
                     )
-                    out.append(
-                        (cur.lastrowid, list(vals[len(pk_cols):]))
-                    )
+                    n += 1
                 conn.execute(
                     "INSERT OR REPLACE INTO sub.meta (k, v) VALUES"
                     " ('state', 'completed')"
@@ -270,7 +281,7 @@ class Matcher:
             except BaseException:
                 conn.execute("ROLLBACK")
                 raise
-        return self.columns, out
+        return self.columns, n
 
     def all_rows(self) -> List[Tuple[int, List[Any]]]:
         """Current materialized rows (re-attach without `from`)."""
@@ -300,15 +311,20 @@ class Matcher:
 
         conn = self._conn
         assert conn is not None
-        aliases = [
-            f'"{_pk_alias(table, c)}"'
-            for c in self.store.schema.table(table).pk_cols
-        ]
+        pks: Set[bytes] = set()
         with self._conn_lock:
-            rows = conn.execute(
-                f"SELECT DISTINCT {', '.join(aliases)} FROM sub.query"
-            ).fetchall()
-        return [pack_columns(tuple(r)) for r in rows]
+            for ref in self.parsed.tables:
+                if ref.name != table:
+                    continue
+                aliases = [
+                    f'"{_pk_alias(ref.alias, c)}"'
+                    for c in self.store.schema.table(table).pk_cols
+                ]
+                rows = conn.execute(
+                    f"SELECT DISTINCT {', '.join(aliases)} FROM sub.query"
+                ).fetchall()
+                pks.update(pack_columns(tuple(r)) for r in rows)
+        return list(pks)
 
     # -- candidate filtering ----------------------------------------------
 
@@ -353,9 +369,14 @@ class Matcher:
                         f" ({', '.join('?' * len(tbl_pks))})",
                         [tuple(unpack_columns(pk)) for pk in pks],
                     )
+                self._expand_left_join_candidates(conn, candidates)
                 conn.execute("DROP TABLE IF EXISTS sub.state_results")
+                # one select per driving *ref* of a changed table, so a
+                # self-joined table re-evaluates through both of its refs
                 selects = [
-                    self._table_query(table) for table in candidates
+                    self._table_query(ref)
+                    for ref in self.parsed.tables
+                    if ref.name in candidates
                 ]
                 conn.execute(
                     "CREATE TABLE sub.state_results AS "
@@ -399,6 +420,47 @@ class Matcher:
     def _next_id(self) -> int:
         self.last_change_id += 1
         return self.last_change_id
+
+    def _expand_left_join_candidates(self, conn, candidates) -> None:
+        """A change on the right side of a LEFT JOIN can invalidate a
+        NULL-extended row (partner appeared) or require re-creating one
+        (last partner vanished). Neither is reachable through the changed
+        table's own driving query — NULL pk aliases never match the temp
+        predicate — so re-evaluate the affected parent rows through every
+        other ref, whose rewritten query preserves the LEFT JOIN."""
+        for ref in self.parsed.tables:
+            if not ref.left_joined or ref.name not in candidates:
+                continue
+            tbl_pks = self.store.schema.table(ref.name).pk_cols
+            p_aliases = [f'"{_pk_alias(ref.alias, c)}"' for c in tbl_pks]
+            null_pred = " AND ".join(f"q.{a} IS NULL" for a in p_aliases)
+            in_temp = (
+                f"({', '.join('q.' + a for a in p_aliases)}) IN"
+                f" (SELECT {', '.join(f'\"{c}\"' for c in tbl_pks)}"
+                f' FROM sub."temp_{ref.name}")'
+            )
+            for other in self.parsed.tables:
+                if other is ref:
+                    continue
+                o_pks = self.store.schema.table(other.name).pk_cols
+                o_aliases = [
+                    f'"{_pk_alias(other.alias, c)}"' for c in o_pks
+                ]
+                rows = conn.execute(
+                    f"SELECT DISTINCT {', '.join('q.' + a for a in o_aliases)}"
+                    f" FROM sub.query q WHERE ({null_pred}) OR {in_temp}"
+                ).fetchall()
+                if not rows:
+                    continue
+                if other.name not in candidates:
+                    # table joins the diff fresh: clear last round's pks
+                    conn.execute(f'DELETE FROM sub."temp_{other.name}"')
+                    candidates[other.name] = set()
+                conn.executemany(
+                    f'INSERT INTO sub."temp_{other.name}" VALUES'
+                    f" ({', '.join('?' * len(o_pks))})",
+                    [tuple(r) for r in rows],
+                )
 
     def _diff_updates(self, conn, pk_cols, sr_pk, sr_user) -> List[SubEvent]:
         """Rows whose pk exists but whose values changed → update."""
@@ -464,12 +526,19 @@ class Matcher:
         ret = ", ".join(f'"col_{i}"' for i in range(ncols))
         for table in candidates:
             tbl_pks = self.store.schema.table(table).pk_cols
-            aliases = [f'"{_pk_alias(table, c)}"' for c in tbl_pks]
-            in_temp = (
-                f"({', '.join('q.' + a for a in aliases)}) IN"
-                f" (SELECT {', '.join(f'\"{c}\"' for c in tbl_pks)}"
-                f' FROM sub."temp_{table}")'
-            )
+            # a materialized row is affected if ANY ref of the changed
+            # table binds a changed pk (self-joins have several refs)
+            ref_preds = []
+            for ref in self.parsed.tables:
+                if ref.name != table:
+                    continue
+                aliases = [f'"{_pk_alias(ref.alias, c)}"' for c in tbl_pks]
+                ref_preds.append(
+                    f"({', '.join('q.' + a for a in aliases)}) IN"
+                    f" (SELECT {', '.join(f'\"{c}\"' for c in tbl_pks)}"
+                    f' FROM sub."temp_{table}")'
+                )
+            in_temp = "(" + " OR ".join(ref_preds) + ")"
             all_aliases = [f'"{c}"' for c in pk_cols]
             not_in_results = (
                 f"NOT EXISTS (SELECT 1 FROM sub.state_results s WHERE "
@@ -578,9 +647,7 @@ class MatcherHandle:
 
     @property
     def hash(self) -> str:
-        import hashlib
-
-        return hashlib.sha256(self.sql.encode()).hexdigest()[:16]
+        return sql_hash(self.sql)
 
     @property
     def columns(self) -> List[str]:
@@ -647,8 +714,9 @@ class MatcherHandle:
         except Exception as e:  # matcher died: notify subscribers
             self.error = str(e)
             METRICS.counter("corro.subs.errors.count", id=self.id).inc()
-            self._fan_out([None])
         finally:
+            # clean stop AND error both release attached streams
+            self._fan_out([None])
             self._done.set()
 
     def _fan_out(self, events: List[Optional[SubEvent]]) -> None:

@@ -155,6 +155,15 @@ def _split_clauses(tokens: List[Token], sql: str) -> Tuple[str, str, Optional[st
     if idx_where is not None:
         where = text(idx_where + 1, idx_tail)
     tail = text(idx_tail, None) if idx_tail is not None else ""
+    # only ORDER BY survives as a tail (it shapes the initial fill); the
+    # incremental diff model cannot honor aggregation or row limits
+    tail_head = tokens[idx_tail].upper if idx_tail is not None else ""
+    if tail_head in ("GROUP", "HAVING", "LIMIT", "WINDOW"):
+        raise ParseError(
+            f"{tail_head} is not supported in subscriptions"
+        )
+    if tail and "LIMIT" in tail.upper().split():
+        raise ParseError("LIMIT is not supported in subscriptions")
     return sel, frm, where, tail
 
 

@@ -49,6 +49,7 @@ class UpdateHandle:
         # (gossip ingestion) and the loop thread (local writes) at once
         self._cl_cache: "OrderedDict[bytes, int]" = OrderedDict()
         self._cl_lock = threading.Lock()
+        self.error: Optional[str] = None
 
     def start(self) -> None:
         self._task = self.loop.create_task(self._run())
@@ -81,6 +82,15 @@ class UpdateHandle:
     async def _run(self) -> None:
         """Flush batches every 600 ms (updates.rs:311-422)."""
         try:
+            await self._run_inner()
+        except Exception as e:  # flush task died: mark dead, don't zombie
+            self.error = str(e)
+            METRICS.counter(
+                "corro.updates.errors.count", table=self.table
+            ).inc()
+
+    async def _run_inner(self) -> None:
+        try:
             while True:
                 first = await self._queue.get()
                 if first is None:
@@ -110,6 +120,11 @@ class UpdateHandle:
                     for ev in events:
                         q.put_nowait(ev)
         finally:
+            # release attached HTTP streams: None = end-of-stream sentinel
+            with self._sub_lock:
+                subs = list(self._subscribers)
+            for q in subs:
+                q.put_nowait(None)
             self._done.set()
 
     def attach(self) -> asyncio.Queue:
@@ -148,6 +163,10 @@ class UpdatesManager:
             raise KeyError(f"unknown table: {table}")
         async with self._lock:
             h = self._by_table.get(table)
+            if h is not None and h.error is not None:
+                # dead flush task: replace the zombie
+                self._by_table.pop(table, None)
+                h = None
             if h is not None:
                 return h, False
             h = UpdateHandle(table, asyncio.get_running_loop())
@@ -161,7 +180,8 @@ class UpdatesManager:
 
     def match_changes(self, changes: Sequence[Change]) -> None:
         for h in list(self._by_table.values()):
-            h.match_changes(changes)
+            if h.error is None:  # dead handles drain nothing; skip
+                h.match_changes(changes)
 
     async def stop_all(self) -> None:
         for t in list(self._by_table):

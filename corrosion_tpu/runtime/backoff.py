@@ -1,0 +1,42 @@
+"""Exponential backoff iterator with jitter.
+
+Counterpart of `klukai-types/src/backoff.rs:7-149` (a vendored
+exponential-backoff crate): an iterator yielding sleep durations that grow
+by `factor` from `min_interval` up to `max_interval`, each multiplied by a
+random jitter in [1-jitter, 1+jitter]. `retries=None` yields forever —
+the reference's sync loop uses `.iter()` endlessly with 1–15 s bounds
+(`klukai-agent/src/agent/util.rs:359-405`).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+
+@dataclass
+class Backoff:
+    min_interval: float = 1.0
+    max_interval: float = 15.0
+    factor: float = 2.0
+    jitter: float = 0.3
+    retries: Optional[int] = None
+    _rng: Optional[random.Random] = None
+
+    def with_seed(self, seed: int) -> "Backoff":
+        self._rng = random.Random(seed)
+        return self
+
+    def iter(self) -> Iterator[float]:
+        rng = self._rng or random
+        base = self.min_interval
+        n = 0
+        while self.retries is None or n < self.retries:
+            jit = 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
+            yield min(base * jit, self.max_interval)
+            base = min(base * self.factor, self.max_interval)
+            n += 1
+
+    def __iter__(self) -> Iterator[float]:
+        return self.iter()

@@ -73,6 +73,12 @@ class AdminConfig:
 
 
 @dataclass
+class ConsulConfig:
+    enabled: bool = False
+    address: str = "127.0.0.1:8500"  # consul agent HTTP address
+
+
+@dataclass
 class TelemetryConfig:
     prometheus_bind_addr: Optional[str] = None
 
@@ -91,6 +97,7 @@ class Config:
     gossip: GossipConfig = field(default_factory=GossipConfig)
     perf: PerfConfig = field(default_factory=PerfConfig)
     admin: AdminConfig = field(default_factory=AdminConfig)
+    consul: ConsulConfig = field(default_factory=ConsulConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     log: LogConfig = field(default_factory=LogConfig)
 

@@ -124,3 +124,27 @@ class Registry:
 
 
 METRICS = Registry()
+
+
+async def serve_prometheus(addr: str, registry: Registry = METRICS):
+    """Serve the registry at GET /metrics on `addr` ("host:port").
+
+    Counterpart of `setup_prometheus` (`klukai/src/command/agent.rs:29-63`).
+    Returns an aiohttp AppRunner; call `.cleanup()` to stop.
+    """
+    from aiohttp import web
+
+    async def h_metrics(_request):
+        return web.Response(
+            text=registry.render_prometheus(),
+            content_type="text/plain",
+        )
+
+    app = web.Application()
+    app.router.add_get("/metrics", h_metrics)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    host, port = addr.rsplit(":", 1)
+    site = web.TCPSite(runner, host, int(port))
+    await site.start()
+    return runner

@@ -710,6 +710,37 @@ class CrdtStore:
             bv.needed.insert(r["start"], r["end"])
         return bv
 
+    def present_versions(self, actor_id: ActorId) -> RangeSet:
+        """Distinct db_versions this actor's changes actually occupy in the
+        clock tables — ground truth for gap reconciliation (the admin
+        ReconcileGaps repair, `klukai/src/admin.rs` Command::ReconcileGaps
+        rebuilds `__corro_bookkeeping_gaps` against `crsql_changes`)."""
+        present = RangeSet()
+        with self._lock:
+            for t in self.schema.tables:
+                for r in self._conn.execute(
+                    f'SELECT DISTINCT db_version FROM "{_clock_table(t)}"'
+                    " WHERE site_id = ?",
+                    (actor_id.bytes16,),
+                ):
+                    v = r["db_version"]
+                    present.insert(v, v)
+        return present
+
+    def rewrite_gaps(self, actor_id: ActorId, needed: RangeSet) -> None:
+        """Replace the persisted gap rows for an actor wholesale."""
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM __corro_bookkeeping_gaps WHERE actor_id = ?",
+                (actor_id.bytes16,),
+            )
+            self._conn.executemany(
+                "INSERT INTO __corro_bookkeeping_gaps (actor_id, start,"
+                ' "end") VALUES (?, ?, ?)',
+                [(actor_id.bytes16, s, e) for s, e in needed],
+            )
+            self._conn.commit()
+
     def booked_actor_ids(self) -> List[ActorId]:
         """All sites we have any state for (bookie warm-up,
         run_root.rs:136-197)."""

@@ -1,0 +1,164 @@
+"""TLS certificate generation for gossip transport security.
+
+Counterpart of `klukai-types/src/tls.rs:17-100` (rcgen-based CA / server /
+client certificate generation) and the `corrosion tls {ca,server,client}
+generate` CLI commands (`klukai/src/command/tls.rs`). Uses the
+`cryptography` package (baked into the image) instead of rcgen.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+from pathlib import Path
+from typing import Optional, Tuple
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+CA_COMMON_NAME = "Corrosion TPU Root CA"
+_ONE_DAY = datetime.timedelta(days=1)
+_TEN_YEARS = datetime.timedelta(days=3650)
+
+
+def _write_pem(path: Path, data: bytes) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_bytes(data)
+
+
+def _key_pems(key) -> bytes:
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+
+
+def _now() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def generate_ca(
+    cert_path: str, key_path: str
+) -> Tuple[x509.Certificate, ec.EllipticCurvePrivateKey]:
+    """Self-signed CA (tls.rs:17-40)."""
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, CA_COMMON_NAME)]
+    )
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(_now() - _ONE_DAY)
+        .not_valid_after(_now() + _TEN_YEARS)
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None), True)
+        .add_extension(
+            x509.KeyUsage(
+                digital_signature=True,
+                key_cert_sign=True,
+                crl_sign=True,
+                content_commitment=False,
+                key_encipherment=False,
+                data_encipherment=False,
+                key_agreement=False,
+                encipher_only=False,
+                decipher_only=False,
+            ),
+            True,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    _write_pem(Path(cert_path), cert.public_bytes(serialization.Encoding.PEM))
+    _write_pem(Path(key_path), _key_pems(key))
+    return cert, key
+
+
+def _load_ca(
+    ca_cert_path: str, ca_key_path: str
+) -> Tuple[x509.Certificate, ec.EllipticCurvePrivateKey]:
+    cert = x509.load_pem_x509_certificate(Path(ca_cert_path).read_bytes())
+    key = serialization.load_pem_private_key(
+        Path(ca_key_path).read_bytes(), password=None
+    )
+    return cert, key
+
+
+def _issue(
+    ca_cert: x509.Certificate,
+    ca_key,
+    common_name: str,
+    san: Optional[x509.SubjectAlternativeName],
+    extended_usage: x509.ExtendedKeyUsage,
+) -> Tuple[x509.Certificate, ec.EllipticCurvePrivateKey]:
+    key = ec.generate_private_key(ec.SECP256R1())
+    builder = (
+        x509.CertificateBuilder()
+        .subject_name(
+            x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+        )
+        .issuer_name(ca_cert.subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(_now() - _ONE_DAY)
+        .not_valid_after(_now() + _TEN_YEARS)
+        .add_extension(x509.BasicConstraints(ca=False, path_length=None), True)
+        .add_extension(extended_usage, False)
+    )
+    if san is not None:
+        builder = builder.add_extension(san, False)
+    cert = builder.sign(ca_key, hashes.SHA256())
+    return cert, key
+
+
+def generate_server_cert(
+    ca_cert_path: str,
+    ca_key_path: str,
+    ip: str,
+    cert_path: str = "./server-cert.pem",
+    key_path: str = "./server-key.pem",
+) -> None:
+    """Server cert with the gossip IP as SAN (tls.rs:42-75)."""
+    ca_cert, ca_key = _load_ca(ca_cert_path, ca_key_path)
+    try:
+        san_entry: x509.GeneralName = x509.IPAddress(
+            ipaddress.ip_address(ip)
+        )
+    except ValueError:
+        san_entry = x509.DNSName(ip)
+    cert, key = _issue(
+        ca_cert,
+        ca_key,
+        common_name=ip,
+        san=x509.SubjectAlternativeName([san_entry]),
+        extended_usage=x509.ExtendedKeyUsage(
+            [x509.oid.ExtendedKeyUsageOID.SERVER_AUTH]
+        ),
+    )
+    _write_pem(Path(cert_path), cert.public_bytes(serialization.Encoding.PEM))
+    _write_pem(Path(key_path), _key_pems(key))
+
+
+def generate_client_cert(
+    ca_cert_path: str,
+    ca_key_path: str,
+    cert_path: str = "./client-cert.pem",
+    key_path: str = "./client-key.pem",
+) -> None:
+    """Client cert for mTLS gossip (tls.rs:77-100)."""
+    ca_cert, ca_key = _load_ca(ca_cert_path, ca_key_path)
+    cert, key = _issue(
+        ca_cert,
+        ca_key,
+        common_name="corrosion-tpu-client",
+        san=None,
+        extended_usage=x509.ExtendedKeyUsage(
+            [x509.oid.ExtendedKeyUsageOID.CLIENT_AUTH]
+        ),
+    )
+    _write_pem(Path(cert_path), cert.public_bytes(serialization.Encoding.PEM))
+    _write_pem(Path(key_path), _key_pems(key))

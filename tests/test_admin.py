@@ -1,0 +1,180 @@
+"""Admin UDS protocol tests: command dispatch end-to-end over a real unix
+socket against a live agent. Mirrors `klukai/src/admin.rs` coverage."""
+
+import asyncio
+import logging
+
+from corrosion_tpu.admin import AdminClient, AdminServer
+from corrosion_tpu.agent.run import make_broadcastable_changes, run, setup, shutdown
+from corrosion_tpu.net.mem import MemNetwork
+from corrosion_tpu.runtime.config import Config
+from corrosion_tpu.types.base import Timestamp
+
+TEST_SCHEMA = (
+    "CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, text TEXT);"
+)
+
+
+def cfg(addr):
+    c = Config()
+    c.db.path = ":memory:"
+    c.gossip.bind_addr = addr
+    return c
+
+
+async def boot_with_admin(tmp_path, net, addr):
+    agent = await setup(cfg(addr), network=net)
+    agent.store.apply_schema_sql(TEST_SCHEMA)
+    await run(agent)
+    sock = str(tmp_path / "admin.sock")
+    server = AdminServer(agent, sock)
+    await server.start()
+    return agent, server, sock
+
+
+async def test_ping_members_states_subs(tmp_path):
+    net = MemNetwork()
+    agent, server, sock = await boot_with_admin(tmp_path, net, "a:1")
+    try:
+        async with AdminClient(sock) as c:
+            r = await c.call({"cmd": "ping"})
+            assert r["ok"] and r["json"] == ["pong"]
+
+            r = await c.call({"cmd": "cluster", "sub": "members"})
+            assert r["ok"] and r["json"] == [[]]
+
+            r = await c.call({"cmd": "cluster", "sub": "membership-states"})
+            assert r["ok"]
+            states = r["json"][0]
+            assert states[-1]["self"] is True
+            assert states[-1]["id"] == str(agent.actor_id)
+
+            r = await c.call({"cmd": "subs", "sub": "list"})
+            assert r["ok"] and r["json"] == [[]]
+
+            r = await c.call({"cmd": "locks"})
+            assert r["ok"]
+
+            r = await c.call({"cmd": "bogus"})
+            assert not r["ok"] and "unknown command" in r["error"]
+    finally:
+        await server.stop()
+        await shutdown(agent)
+
+
+async def test_sync_generate_and_actor_version(tmp_path):
+    net = MemNetwork()
+    agent, server, sock = await boot_with_admin(tmp_path, net, "a:1")
+    try:
+        await make_broadcastable_changes(
+            agent,
+            lambda tx: [tx.execute(
+                "INSERT INTO tests (id, text) VALUES (1, 'x')"
+            )],
+        )
+        async with AdminClient(sock) as c:
+            r = await c.call({"cmd": "sync", "sub": "generate"})
+            assert r["ok"]
+            state = r["json"][0]
+            assert state["heads"] == {str(agent.actor_id): 1}
+
+            r = await c.call(
+                {
+                    "cmd": "actor",
+                    "sub": "version",
+                    "actor_id": str(agent.actor_id),
+                    "version": 1,
+                }
+            )
+            assert r["ok"] and r["json"][0] == {"state": "current"}
+
+            r = await c.call(
+                {
+                    "cmd": "actor",
+                    "sub": "version",
+                    "actor_id": str(agent.actor_id),
+                    "version": 99,
+                }
+            )
+            assert r["ok"] and r["json"][0] == {"state": "unknown"}
+    finally:
+        await server.stop()
+        await shutdown(agent)
+
+
+async def test_reconcile_gaps_repairs_stale_gap(tmp_path):
+    net = MemNetwork()
+    agent, server, sock = await boot_with_admin(tmp_path, net, "a:1")
+    try:
+        await make_broadcastable_changes(
+            agent,
+            lambda tx: [tx.execute(
+                "INSERT INTO tests (id, text) VALUES (1, 'x')"
+            )],
+        )
+        # corrupt: claim version 1 of ourselves is a gap
+        booked = agent.bookie.ensure(agent.actor_id)
+        with booked.write("test") as bv:
+            bv.needed.insert(1, 1)
+        async with AdminClient(sock) as c:
+            r = await c.call({"cmd": "sync", "sub": "reconcile-gaps"})
+            assert r["ok"]
+            assert r["json"][0]["actors_fixed"] == 1
+        with booked.read() as bv:
+            assert list(bv.needed) == []
+        # idempotent
+        async with AdminClient(sock) as c:
+            r = await c.call({"cmd": "sync", "sub": "reconcile-gaps"})
+            assert r["ok"] and r["json"][0]["actors_fixed"] == 0
+    finally:
+        await server.stop()
+        await shutdown(agent)
+
+
+async def test_cluster_rejoin_and_set_id(tmp_path):
+    net = MemNetwork()
+    agent, server, sock = await boot_with_admin(tmp_path, net, "a:1")
+    try:
+        old_bump = agent.membership.identity.bump
+        async with AdminClient(sock) as c:
+            r = await c.call({"cmd": "cluster", "sub": "rejoin"})
+            assert r["ok"]
+            assert agent.membership.identity.bump == old_bump + 1
+
+            r = await c.call(
+                {"cmd": "cluster", "sub": "set-id", "cluster_id": 7}
+            )
+            assert r["ok"]
+            assert agent.membership.identity.cluster_id.value == 7
+            assert agent.actor.cluster_id.value == 7
+    finally:
+        await server.stop()
+        await shutdown(agent)
+
+
+async def test_log_set_reset(tmp_path):
+    net = MemNetwork()
+    agent, server, sock = await boot_with_admin(tmp_path, net, "a:1")
+    try:
+        async with AdminClient(sock) as c:
+            r = await c.call(
+                {
+                    "cmd": "log",
+                    "sub": "set",
+                    "filter": "corrosion_tpu.agent=DEBUG",
+                }
+            )
+            assert r["ok"]
+            assert (
+                logging.getLogger("corrosion_tpu.agent").level
+                == logging.DEBUG
+            )
+            r = await c.call({"cmd": "log", "sub": "reset"})
+            assert r["ok"]
+            assert (
+                logging.getLogger("corrosion_tpu.agent").level
+                == logging.NOTSET
+            )
+    finally:
+        await server.stop()
+        await shutdown(agent)

@@ -1,0 +1,109 @@
+"""Tests for runtime aux: Backoff iterator, LockRegistry/CountedRwLock,
+Prometheus exposition server. Mirrors the reference's coverage of
+`backoff.rs` and `agent.rs:707-1066` (CountedTokioRwLock)."""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.runtime.backoff import Backoff
+from corrosion_tpu.runtime.locks import CountedRwLock, LockRegistry
+from corrosion_tpu.runtime.metrics import Registry, serve_prometheus
+
+
+def test_backoff_growth_and_caps():
+    b = Backoff(min_interval=1.0, max_interval=15.0, factor=2.0,
+                jitter=0.0, retries=6)
+    vals = list(b)
+    assert vals == [1.0, 2.0, 4.0, 8.0, 15.0, 15.0]
+
+
+def test_backoff_jitter_bounds_and_seed():
+    b = Backoff(min_interval=1.0, max_interval=100.0, factor=2.0,
+                jitter=0.3, retries=10).with_seed(42)
+    vals = list(b)
+    base = 1.0
+    for v in vals:
+        assert base * 0.7 - 1e-9 <= v <= base * 1.3 + 1e-9
+        base = min(base * 2.0, 100.0)
+    # deterministic under the same seed
+    assert vals == list(
+        Backoff(min_interval=1.0, max_interval=100.0, factor=2.0,
+                jitter=0.3, retries=10).with_seed(42)
+    )
+
+
+def test_backoff_infinite_when_retries_none():
+    it = iter(Backoff(retries=None, jitter=0.0))
+    for _ in range(50):
+        next(it)  # never raises StopIteration
+
+
+@pytest.mark.asyncio
+async def test_rwlock_readers_shared_writer_exclusive():
+    reg = LockRegistry()
+    lock = CountedRwLock(reg, "bookie")
+    order = []
+
+    async def reader(i):
+        async with lock.read(f"r{i}"):
+            order.append(f"r{i}+")
+            await asyncio.sleep(0.01)
+            order.append(f"r{i}-")
+
+    async def writer():
+        async with lock.write("w"):
+            order.append("w+")
+            await asyncio.sleep(0.01)
+            order.append("w-")
+
+    await asyncio.gather(reader(1), reader(2), writer())
+    # both readers overlap (enter before either exits), writer is exclusive
+    wi = order.index("w+")
+    assert order[wi + 1] == "w-"
+    assert set(order[:2]) == {"r1+", "r2+"} or order[0] == "w+"
+
+
+@pytest.mark.asyncio
+async def test_registry_tracks_and_releases():
+    reg = LockRegistry()
+    lock = CountedRwLock(reg, "members")
+    async with lock.write("apply"):
+        snap = reg.snapshot()
+        assert len(snap) == 1
+        assert snap[0].label == "members:apply"
+        assert snap[0].kind == "write"
+        assert snap[0].state == "locked"
+    assert reg.snapshot() == []
+
+
+@pytest.mark.asyncio
+async def test_registry_snapshot_orders_longest_held_first():
+    reg = LockRegistry()
+    m1 = reg.register("a", "read")
+    reg.acquired(m1)
+    await asyncio.sleep(0.01)
+    m2 = reg.register("b", "read")
+    reg.acquired(m2)
+    snap = reg.snapshot(top=1)
+    assert [m.label for m in snap] == ["a"]
+    reg.release(m1)
+    reg.release(m2)
+
+
+@pytest.mark.asyncio
+async def test_prometheus_exposition_server():
+    import aiohttp
+
+    reg = Registry()
+    reg.counter("corro_test_total", kind="x").inc(3)
+    runner = await serve_prometheus("127.0.0.1:0", reg)
+    port = runner.addresses[0][1]
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{port}/metrics") as resp:
+                assert resp.status == 200
+                body = await resp.text()
+        assert 'corro_test_total{kind="x"} 3' in body
+    finally:
+        await runner.cleanup()

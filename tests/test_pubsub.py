@@ -104,11 +104,12 @@ def test_initial_materialization_and_incremental():
         write(store, "INSERT INTO users (id, name, age) VALUES (2, 'bob', 17)")
 
         subs = SubsManager(store)
-        handle, created, rows = await subs.get_or_insert(
+        handle, created = await subs.get_or_insert(
             "SELECT name FROM users WHERE age >= 18"
         )
         assert created
         assert handle.columns == ["name"]
+        rows, _snap = handle.matcher.snapshot()
         assert [v for (_rid, v) in rows] == [["ann"]]
 
         q = handle.attach()
@@ -166,10 +167,11 @@ def test_join_subscription():
             " VALUES (1, 1, 'hello')",
         )
         subs = SubsManager(store)
-        handle, created, rows = await subs.get_or_insert(
+        handle, created = await subs.get_or_insert(
             "SELECT u.name, p.title FROM users u"
             " JOIN posts p ON p.user_id = u.id"
         )
+        rows, _snap = handle.matcher.snapshot()
         assert [v for (_r, v) in rows] == [["ann", "hello"]]
         q = handle.attach()
 
@@ -202,8 +204,8 @@ def test_dedupe_and_catch_up():
     async def main():
         store = make_store()
         subs = SubsManager(store)
-        h1, c1, _ = await subs.get_or_insert("SELECT name FROM users")
-        h2, c2, _ = await subs.get_or_insert("SELECT name FROM users")
+        h1, c1 = await subs.get_or_insert("SELECT name FROM users")
+        h2, c2 = await subs.get_or_insert("SELECT name FROM users")
         assert c1 and not c2 and h1.id == h2.id
 
         subs.match_changes(
@@ -235,7 +237,8 @@ def test_restore_from_disk(tmp_path):
         write(store, "INSERT INTO users (id, name, age) VALUES (1, 'a', 5)")
 
         subs = SubsManager(store, subs_path)
-        handle, _, rows = await subs.get_or_insert("SELECT name FROM users")
+        handle, _ = await subs.get_or_insert("SELECT name FROM users")
+        rows, _snap = handle.matcher.snapshot()
         sub_id = handle.id
         assert len(rows) == 1
         await subs.stop_all()
@@ -348,3 +351,167 @@ def test_expand_sql_token_level():
     s = parse_statement(["SELECT * FROM t WHERE x = ?", [1, 2]])
     with pytest.raises(PE):
         expand_sql(s)
+
+
+def test_expand_sql_numbered_placeholders():
+    """sqlite ?N semantics: ?N binds params[N-1]; bare ? continues past
+    the largest index assigned so far."""
+    from corrosion_tpu.api.types import parse_statement
+    from corrosion_tpu.api.pubsub_http import expand_sql
+    from corrosion_tpu.pubsub.parse import ParseError as PE
+
+    s = parse_statement(
+        ["SELECT * FROM t WHERE a = ?2 OR b = ?1", [10, 20]]
+    )
+    out = expand_sql(s)
+    assert "a = 20" in out and "b = 10" in out
+
+    # reuse of the same index
+    s = parse_statement(["SELECT * FROM t WHERE a = ?1 OR b = ?1", [7]])
+    out = expand_sql(s)
+    assert out.count("7") == 2
+
+    # mixed: bare ? after ?2 takes index 3
+    s = parse_statement(
+        ["SELECT * FROM t WHERE a = ?2 AND b = ?", [1, 2, 3]]
+    )
+    out = expand_sql(s)
+    assert "a = 2" in out and "b = 3" in out
+
+    # out-of-range index
+    s = parse_statement(["SELECT * FROM t WHERE a = ?5", [1]])
+    with pytest.raises(PE):
+        expand_sql(s)
+
+
+def test_self_join_subscription():
+    """Aliased self-joins get per-ref pk columns; updates through either
+    ref re-evaluate the row (regression: duplicate __corro_pk columns)."""
+    async def main():
+        store = make_store()
+        write(store, "INSERT INTO users (id, name, age) VALUES (1, 'ann', 2)")
+        write(store, "INSERT INTO users (id, name, age) VALUES (2, 'bob', 0)")
+
+        subs = SubsManager(store)
+        # pair each user with the user whose id == their age
+        handle, created = await subs.get_or_insert(
+            "SELECT a.name, b.name FROM users a"
+            " JOIN users b ON b.id = a.age"
+        )
+        assert created
+        rows, _snap = handle.matcher.snapshot()
+        assert [v for (_rid, v) in rows] == [["ann", "bob"]]
+
+        q = handle.attach()
+
+        # update through the second ref (b.name)
+        subs.match_changes(
+            write(store, "UPDATE users SET name = 'bobby' WHERE id = 2")
+        )
+        evs = []
+        ev = await asyncio.wait_for(q.get(), 5)
+        evs.append(ev)
+        # 'bobby' row update seen via ref b; ref a row (bob, age 0) has no
+        # partner so stays out
+        kinds = {(e.kind, tuple(e.values)) for e in evs}
+        assert ("update", ("ann", "bobby")) in kinds
+
+        # break the join → delete
+        subs.match_changes(
+            write(store, "UPDATE users SET age = 99 WHERE id = 1")
+        )
+        ev = await asyncio.wait_for(q.get(), 5)
+        assert (ev.kind, ev.values) == ("delete", ["ann", "bobby"])
+        await subs.stop_all()
+
+    run_async(main())
+
+
+def test_left_join_null_extension_diffs():
+    """LEFT JOIN incremental correctness: a right-side change replaces the
+    NULL-extended row (partner appears) and resurrects it (last partner
+    vanishes) — regression for the temp-predicate NULL hole."""
+    async def main():
+        store = make_store()
+        write(store, "INSERT INTO users (id, name, age) VALUES (1, 'ann', 1)")
+
+        subs = SubsManager(store)
+        handle, _ = await subs.get_or_insert(
+            "SELECT u.name, p.title FROM users u"
+            " LEFT JOIN posts p ON p.user_id = u.id"
+        )
+        rows, _snap = handle.matcher.snapshot()
+        assert [v for (_r, v) in rows] == [["ann", None]]
+        q = handle.attach()
+
+        # partner appears → ('ann', NULL) must go, ('ann', 'T') must come
+        subs.match_changes(
+            write(
+                store,
+                "INSERT INTO posts (user_id, post_id, title)"
+                " VALUES (1, 1, 'T')",
+            )
+        )
+        got = {}
+        for _ in range(2):
+            ev = await asyncio.wait_for(q.get(), 5)
+            got[(ev.kind, tuple(ev.values))] = True
+        assert ("insert", ("ann", "T")) in got
+        assert ("delete", ("ann", None)) in got
+        rows, _ = handle.matcher.snapshot()
+        assert [v for (_r, v) in rows] == [["ann", "T"]]
+
+        # last partner vanishes → NULL-extended row resurrects
+        subs.match_changes(
+            write(store, "DELETE FROM posts WHERE user_id = 1")
+        )
+        got = {}
+        for _ in range(2):
+            ev = await asyncio.wait_for(q.get(), 5)
+            got[(ev.kind, tuple(ev.values))] = True
+        assert ("delete", ("ann", "T")) in got
+        assert ("insert", ("ann", None)) in got
+        rows, _ = handle.matcher.snapshot()
+        assert [v for (_r, v) in rows] == [["ann", None]]
+        await subs.stop_all()
+
+    run_async(main())
+
+
+def test_order_by_respected_limit_group_by_rejected():
+    async def main():
+        store = make_store()
+        for i, (n, a) in enumerate([("c", 30), ("a", 10), ("b", 20)]):
+            write(
+                store,
+                f"INSERT INTO users (id, name, age) VALUES ({i}, '{n}', {a})",
+            )
+        subs = SubsManager(store)
+        handle, _ = await subs.get_or_insert(
+            "SELECT name FROM users ORDER BY age DESC"
+        )
+        rows, _ = handle.matcher.snapshot()
+        assert [v[0] for (_r, v) in rows] == ["c", "b", "a"]
+        await subs.stop_all()
+
+        for bad in (
+            "SELECT name FROM users LIMIT 1",
+            "SELECT age, count(*) FROM users GROUP BY age",
+            "SELECT name FROM users ORDER BY age LIMIT 2",
+        ):
+            with pytest.raises(ParseError):
+                parse_select(bad, store.schema)
+
+    run_async(main())
+
+
+def test_expand_sql_at_dollar_named_params():
+    from corrosion_tpu.api.types import parse_statement
+    from corrosion_tpu.api.pubsub_http import expand_sql
+
+    s = parse_statement(
+        ["SELECT * FROM t WHERE a = @x AND b = $y AND c = :z",
+         {"x": 1, "y": 2, "z": 3}]
+    )
+    out = expand_sql(s)
+    assert "a = 1" in out and "b = 2" in out and "c = 3" in out

@@ -1,0 +1,179 @@
+"""Template engine tests: compiler, rendering against a live API, watch
+mode re-render on data change. Mirrors `klukai/src/tpl` coverage."""
+
+import asyncio
+import os
+
+import pytest
+
+from corrosion_tpu.admin import AdminServer
+from corrosion_tpu.agent.run import make_broadcastable_changes, run, setup, shutdown
+from corrosion_tpu.api.http import ApiServer
+from corrosion_tpu.net.mem import MemNetwork
+from corrosion_tpu.runtime.config import Config
+from corrosion_tpu.tpl import (
+    QueryResponse,
+    TemplateError,
+    compile_template,
+    parse_spec,
+    render_once,
+)
+
+TEST_SCHEMA = (
+    "CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, text TEXT);"
+)
+
+
+def test_compile_literal_and_expr():
+    t = compile_template("hello <%= 1 + 2 %> world")
+    assert t({}) == "hello 3 world"
+
+
+def test_compile_loop_and_if():
+    t = compile_template(
+        "<% for x in items %><% if x > 1 %><%= x %>,<% end %><% end %>"
+    )
+    assert t({"items": [1, 2, 3]}) == "2,3,"
+
+
+def test_compile_else():
+    t = compile_template(
+        "<% for x in items %>"
+        "<% if x % 2 == 0 %>e<% else %>o<% end %>"
+        "<% end %>"
+    )
+    assert t({"items": [1, 2, 3, 4]}) == "oeoe"
+
+
+def test_compile_unbalanced_raises():
+    with pytest.raises(TemplateError):
+        compile_template("<% for x in items %>never closed")
+    with pytest.raises(TemplateError):
+        compile_template("<% end %>")
+
+
+def test_query_response_json_csv():
+    qr = QueryResponse(["id", "name"], [[1, "ann"], [2, "bob"]])
+    assert '"name": "ann"' in qr.to_json(pretty=True)
+    assert qr.to_csv() == "id,name\r\n1,ann\r\n2,bob\r\n"
+    rows = list(qr)
+    assert rows[0]["name"] == "ann"
+    assert rows[0].name == "ann"
+    assert rows[1][0] == 2
+
+
+def test_parse_spec():
+    assert parse_spec("a.tpl:out.txt") == ("a.tpl", "out.txt", None)
+    assert parse_spec("a.tpl:out.txt:echo hi") == ("a.tpl", "out.txt", "echo hi")
+    with pytest.raises(TemplateError):
+        parse_spec("just-a-src")
+
+
+async def boot_api(tmp_path):
+    cfg = Config()
+    cfg.db.path = ":memory:"
+    cfg.gossip.bind_addr = "a:1"
+    cfg.api.bind_addr = ["127.0.0.1:0"]
+    net = MemNetwork()
+    agent = await setup(cfg, network=net)
+    agent.store.apply_schema_sql(TEST_SCHEMA)
+    await run(agent)
+    api = ApiServer(agent)
+    await api.start()
+    return agent, api
+
+
+async def insert(agent, rowid, text):
+    await make_broadcastable_changes(
+        agent,
+        lambda tx: [
+            tx.execute(
+                "INSERT OR REPLACE INTO tests (id, text) VALUES (?, ?)",
+                [rowid, text],
+            )
+        ],
+    )
+
+
+async def test_render_once_with_sql(tmp_path):
+    agent, api = await boot_api(tmp_path)
+    try:
+        await insert(agent, 1, "alpha")
+        await insert(agent, 2, "beta")
+        src = tmp_path / "t.tpl"
+        src.write_text(
+            "entries:\n"
+            "<% for row in sql('SELECT id, text FROM tests ORDER BY id') %>"
+            "- <%= row.id %>: <%= row.text %>\n"
+            "<% end %>"
+            "host: <%= hostname() %>\n"
+        )
+        dst = tmp_path / "out.txt"
+        await render_once(api.addrs[0], None, str(src), str(dst), None)
+        out = dst.read_text()
+        assert "- 1: alpha\n" in out
+        assert "- 2: beta\n" in out
+        assert "host: " in out
+    finally:
+        await api.stop()
+        await shutdown(agent)
+
+
+async def test_render_to_json_and_cmd(tmp_path):
+    agent, api = await boot_api(tmp_path)
+    try:
+        await insert(agent, 1, "x")
+        src = tmp_path / "t.tpl"
+        src.write_text(
+            "<%= sql('SELECT id, text FROM tests').to_json() %>"
+        )
+        dst = tmp_path / "out.json"
+        marker = tmp_path / "ran.marker"
+        await render_once(
+            api.addrs[0], None, str(src), str(dst),
+            f"touch {marker}",
+        )
+        assert dst.read_text() == '[{"id": 1, "text": "x"}]'
+        assert marker.exists()
+    finally:
+        await api.stop()
+        await shutdown(agent)
+
+
+async def test_watch_rerenders_on_data_change(tmp_path):
+    from corrosion_tpu.tpl import _watch_one
+
+    agent, api = await boot_api(tmp_path)
+    try:
+        await insert(agent, 1, "first")
+        src = tmp_path / "t.tpl"
+        src.write_text(
+            "<% for r in sql('SELECT text FROM tests ORDER BY id') %>"
+            "<%= r.text %>;<% end %>"
+        )
+        dst = tmp_path / "out.txt"
+        task = asyncio.ensure_future(
+            _watch_one(api.addrs[0], None, f"{src}:{dst}", None)
+        )
+        # initial render
+        for _ in range(100):
+            if dst.exists() and dst.read_text() == "first;":
+                break
+            await asyncio.sleep(0.05)
+        assert dst.read_text() == "first;"
+
+        # data change → re-render
+        await insert(agent, 2, "second")
+        for _ in range(100):
+            if dst.exists() and dst.read_text() == "first;second;":
+                break
+            await asyncio.sleep(0.05)
+        assert dst.read_text() == "first;second;"
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+    finally:
+        await api.stop()
+        await shutdown(agent)
