@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Tuple
 from corrosion_tpu.agent.handle import Agent, ChangeSource
 from corrosion_tpu.net.transport import BiStream, TransportError
 from corrosion_tpu.runtime.metrics import METRICS
+from corrosion_tpu.runtime.trace import continue_from, span
 from corrosion_tpu.sync import (
     chunk_range,
     compute_available_needs,
@@ -51,6 +52,7 @@ from corrosion_tpu.types.codec import (
     NeedPartial,
     SyncRejection,
     SyncState,
+    SyncTraceContext,
     decode_bi_payload,
     decode_sync_msg,
     encode_bi_payload_sync_start,
@@ -72,7 +74,7 @@ async def serve_sync(agent: Agent, stream: BiStream) -> None:
         first = await asyncio.wait_for(stream.recv(), RECV_TIMEOUT)
         if first is None:
             return
-        peer_actor_id, _trace, cluster_id = decode_bi_payload(first)
+        peer_actor_id, trace, cluster_id = decode_bi_payload(first)
         if cluster_id != agent.cluster_id:
             await stream.send(encode_sync_msg(SyncRejection(reason=1)))
             await stream.finish()
@@ -82,7 +84,12 @@ async def serve_sync(agent: Agent, stream: BiStream) -> None:
             await stream.finish()
             return
         async with agent.sync_serve_sem:
-            await _serve_sync_inner(agent, stream, peer_actor_id)
+            # adopt the client's W3C trace context from the wire
+            # (peer/mod.rs:1494-1496)
+            with continue_from(
+                trace.traceparent, "sync.server", peer=str(peer_actor_id)
+            ):
+                await _serve_sync_inner(agent, stream, peer_actor_id)
     except (asyncio.TimeoutError, TransportError, ValueError):
         METRICS.counter("corro.sync.server.failed").inc()
     finally:
@@ -354,10 +361,16 @@ async def _sync_one_peer(
     lock: asyncio.Lock,
 ) -> int:
     stream = await agent.transport.open_bi(peer.addr)
+    # the whole client session is one span; its W3C context rides the
+    # SyncStart frame (peer/mod.rs:1098-1101 inject)
+    sp = span("sync.client", peer=peer.addr)
+    sp.__enter__()
     try:
         await stream.send(
             encode_bi_payload_sync_start(
-                agent.actor_id, cluster_id=agent.cluster_id
+                agent.actor_id,
+                trace=SyncTraceContext(traceparent=sp.ctx.traceparent()),
+                cluster_id=agent.cluster_id,
             )
         )
         await stream.send(encode_sync_msg(agent.clock.new_timestamp()))
@@ -447,6 +460,7 @@ async def _sync_one_peer(
         METRICS.counter("corro.sync.client.changes.received").inc(received)
         return received
     finally:
+        sp.__exit__(None, None, None)
         stream.close()
 
 

@@ -169,11 +169,14 @@ class ApiServer:
             loop = asyncio.get_running_loop()
 
             def run_query():
+                from corrosion_tpu.runtime.trace import timed_query
+
                 conn = self.agent.store.read_conn()
                 try:
-                    cur = conn.execute(
-                        stmt.query, _bind_params(stmt)
-                    )
+                    with timed_query(stmt.query):
+                        cur = conn.execute(
+                            stmt.query, _bind_params(stmt)
+                        )
                     cols = (
                         [d[0] for d in cur.description]
                         if cur.description

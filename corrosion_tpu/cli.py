@@ -126,6 +126,10 @@ def _build_parser() -> argparse.ArgumentParser:
     ls.add_argument("filter")
     lg.add_parser("reset")
 
+    dc = sub.add_parser("devcluster", help="spawn a local topology")
+    dc.add_argument("topology", help="file of 'A -> B' edges")
+    dc.add_argument("--schema", default=None, help="schema .sql file")
+
     return p
 
 
@@ -407,6 +411,13 @@ async def _amain(argv: Optional[List[str]] = None) -> int:
         if args.hash:
             payload["hash"] = args.hash
         return await _admin_call(cfg, payload)
+    if cmd == "devcluster":
+        from pathlib import Path as _P
+
+        from corrosion_tpu.devcluster import run_devcluster_cli
+
+        schema_sql = _P(args.schema).read_text() if args.schema else ""
+        return await run_devcluster_cli(cfg, args.topology, schema_sql)
     if cmd == "log":
         if args.sub == "set":
             return await _admin_call(

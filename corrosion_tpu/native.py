@@ -1,0 +1,116 @@
+"""Build + load the native CRDT SQLite extension.
+
+The reference embeds prebuilt cr-sqlite binaries and loads them via
+SQLite's extension loader (`klukai-types/src/sqlite.rs:27-31,125-143`).
+We compile our own C++ extension (`native/crdt_ext.cpp`) on first use
+with the system toolchain and cache the .so next to the source; every
+`CrdtStore` connection then loads it so the write-capture triggers call
+native `crdt_pack` instead of a Python callback.
+
+If compilation is impossible (no g++, no SQLite headers), the store
+falls back to the pure-Python functions — same semantics, slower
+trigger path.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import logging
+import os
+import subprocess
+import sysconfig
+import threading
+from pathlib import Path
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_SRC = Path(__file__).resolve().parent.parent / "native" / "crdt_ext.cpp"
+_BUILD_DIR = _SRC.parent / "build"
+_SO = _BUILD_DIR / "crdtext.so"
+ENTRYPOINT = "sqlite3_crdtext_init"
+
+_lock = threading.Lock()
+_built: Optional[str] = None  # path, or "" = build failed (don't retry)
+
+
+def _sqlite_include_dir() -> Optional[Path]:
+    """SQLite headers aren't installed system-wide in this image, but
+    tensorflow vendors them; resolve without importing tensorflow."""
+    for name in ("sqlite3ext.h",):
+        # 1. standard include dirs
+        for d in (
+            Path(sysconfig.get_paths()["include"]),
+            Path("/usr/include"),
+            Path("/usr/local/include"),
+        ):
+            if (d / name).exists():
+                return d
+        # 2. tensorflow's bundled copy
+        spec = importlib.util.find_spec("tensorflow")
+        if spec is not None and spec.origin:
+            cand = (
+                Path(spec.origin).parent
+                / "include"
+                / "external"
+                / "org_sqlite"
+            )
+            if (cand / name).exists():
+                return cand
+    return None
+
+
+def extension_path() -> Optional[str]:
+    """Compile (once) and return the extension path, or None when the
+    native path is unavailable."""
+    global _built
+    with _lock:
+        if _built is not None:
+            return _built or None
+        if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+            _built = str(_SO)
+            return _built
+        include = _sqlite_include_dir()
+        if include is None or not _SRC.exists():
+            log.warning("native crdt extension unavailable: no sqlite headers")
+            _built = ""
+            return None
+        _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+        tmp = _SO.with_suffix(".so.tmp")
+        cmd = [
+            "g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+            f"-I{include}",
+            str(_SRC), "-o", str(tmp),
+        ]
+        try:
+            subprocess.run(
+                cmd, check=True, capture_output=True, text=True, timeout=120
+            )
+            os.replace(tmp, _SO)
+            _built = str(_SO)
+            log.info("built native crdt extension at %s", _SO)
+        except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+                OSError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            log.warning("native crdt extension build failed: %s", detail[:500])
+            _built = ""
+            return None
+        return _built
+
+
+def load_into(conn) -> bool:
+    """Load the extension into a sqlite3 connection; False → caller must
+    register the Python fallbacks."""
+    path = extension_path()
+    if path is None:
+        return False
+    try:
+        conn.enable_load_extension(True)
+        try:
+            conn.load_extension(path, entrypoint=ENTRYPOINT)
+        finally:
+            conn.enable_load_extension(False)
+        return True
+    except Exception as e:  # pragma: no cover - depends on sqlite build
+        log.warning("could not load native crdt extension: %s", e)
+        return False

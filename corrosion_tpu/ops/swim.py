@@ -213,9 +213,9 @@ def _buffer_merge(params: SwimParams, buf_subj, buf_key, buf_sent,
     return subj_f[:, :b], key_f[:, :b], sent_f[:, :b]
 
 
-@functools.partial(jax.jit, static_argnames=("params",))
-def tick(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState:
-    """Advance every member one SWIM protocol period."""
+def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState:
+    """Advance every member one SWIM protocol period (trace-level impl;
+    use `tick` for the jitted form, `tick_n` for k periods per dispatch)."""
     n = params.n
     idx = jnp.arange(n, dtype=jnp.int32)
     t = state.t
@@ -471,6 +471,26 @@ def tick(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState:
     )
 
 
+tick = functools.partial(jax.jit, static_argnames=("params",))(tick_impl)
+
+
+@functools.partial(jax.jit, static_argnames=("params", "k"))
+def tick_n(
+    state: SwimState, rng: jax.Array, params: SwimParams, k: int
+) -> SwimState:
+    """Advance `k` protocol periods in ONE dispatch (lax.scan over tick).
+    Amortizes host→device round-trips — essential when the chip sits
+    behind a high-latency tunnel, and the pattern the sharded multi-chip
+    path uses to keep ICI busy between host syncs."""
+
+    def body(s, key):
+        return tick_impl(s, key, params), None
+
+    keys = jax.random.split(rng, k)
+    out, _ = jax.lax.scan(body, state, keys)
+    return out
+
+
 def set_alive(state: SwimState, member: int, value: bool) -> SwimState:
     """Churn injection: crash or (re)start a member process."""
     alive = state.alive.at[member].set(value)
@@ -496,14 +516,18 @@ def _stats_impl(view, alive):
     coverage = jnp.sum(knows_alive & alive_subj) / n_alive_pairs
     detected = jnp.sum(thinks_down & dead_subj) / n_dead_pairs
     false_pos = jnp.sum((prec >= PREC_SUSPECT) & known & alive_subj) / n_alive_pairs
-    return coverage, detected, false_pos
+    return jnp.stack([coverage, detected, false_pos])
 
 
 def membership_stats(state: SwimState) -> dict:
-    """Convergence metrics over live observers."""
-    coverage, detected, false_pos = _stats_impl(state.view, state.alive)
+    """Convergence metrics over live observers. Fetched as ONE stacked
+    device→host transfer: per-scalar readbacks cost a full round-trip
+    each, which dominates on tunneled TPU links."""
+    import numpy as np
+
+    vals = np.asarray(jax.device_get(_stats_impl(state.view, state.alive)))
     return {
-        "coverage": float(coverage),  # live members known-alive by live peers
-        "detected": float(detected),  # dead members marked down
-        "false_positive": float(false_pos),  # live members suspected/downed
+        "coverage": float(vals[0]),  # live members known-alive by live peers
+        "detected": float(vals[1]),  # dead members marked down
+        "false_positive": float(vals[2]),  # live members suspected/downed
     }

@@ -71,6 +71,6 @@ def sharded_tick(params: swim.SwimParams, mesh: Mesh):
     )
 
     def _tick(state: swim.SwimState, rng: jax.Array) -> swim.SwimState:
-        return swim.tick.__wrapped__(state, rng, params)
+        return swim.tick_impl(state, rng, params)
 
     return jax.jit(_tick, out_shardings=out_shardings)

@@ -1,0 +1,154 @@
+"""Lightweight distributed tracing with W3C context propagation.
+
+Counterpart of the reference's tracing stack (SURVEY §5): `tracing` spans
+with OpenTelemetry OTLP export, and W3C `traceparent`/`tracestate`
+propagated across the sync protocol inside `SyncTraceContextV1`
+(`klukai-types/src/sync.rs:33-67`, injected `peer/mod.rs:1098-1101`,
+extracted `peer/mod.rs:1494-1496`).
+
+This image ships only the opentelemetry API shim (no SDK/exporter), so
+spans here are self-contained: contextvar-scoped, duration-histogrammed
+into the metrics registry, and logged at DEBUG. The wire format is real
+W3C traceparent, so traces stitch across nodes — and across to any
+OTLP-speaking reimplementation later.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import logging
+import os
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from corrosion_tpu.runtime.metrics import METRICS
+
+log = logging.getLogger(__name__)
+
+_TRACEPARENT = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+
+@dataclass
+class SpanContext:
+    trace_id: str  # 32 hex chars
+    span_id: str  # 16 hex chars
+    sampled: bool = True
+
+    def traceparent(self) -> str:
+        flags = "01" if self.sampled else "00"
+        return f"00-{self.trace_id}-{self.span_id}-{flags}"
+
+
+def parse_traceparent(tp: Optional[str]) -> Optional[SpanContext]:
+    if not tp:
+        return None
+    m = _TRACEPARENT.match(tp.strip())
+    if m is None:
+        return None
+    _ver, trace_id, span_id, flags = m.groups()
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return SpanContext(trace_id, span_id, sampled=flags != "00")
+
+
+_current: contextvars.ContextVar[Optional[SpanContext]] = contextvars.ContextVar(
+    "corro_trace", default=None
+)
+
+
+def _rand_hex(n: int) -> str:
+    return os.urandom(n // 2).hex()
+
+
+def current_context() -> Optional[SpanContext]:
+    return _current.get()
+
+
+def current_traceparent() -> Optional[str]:
+    ctx = _current.get()
+    return ctx.traceparent() if ctx is not None else None
+
+
+class Span:
+    """Context manager: opens a child span of the ambient context (or a
+    fresh trace), times it, histograms + logs the duration."""
+
+    def __init__(
+        self,
+        name: str,
+        parent: Optional[SpanContext] = None,
+        attrs: Optional[Dict[str, str]] = None,
+    ):
+        self.name = name
+        self.attrs = attrs or {}
+        ambient = parent if parent is not None else _current.get()
+        self.ctx = SpanContext(
+            trace_id=ambient.trace_id if ambient else _rand_hex(32),
+            span_id=_rand_hex(16),
+            sampled=ambient.sampled if ambient else True,
+        )
+        self.parent = ambient
+        self._token: Optional[contextvars.Token] = None
+        self._start = 0.0
+
+    def __enter__(self) -> "Span":
+        self._token = _current.set(self.ctx)
+        self._start = time.monotonic()
+        return self
+
+    def __exit__(self, et, e, tb) -> None:
+        elapsed = time.monotonic() - self._start
+        if self._token is not None:
+            _current.reset(self._token)
+        METRICS.histogram("corro_span_seconds", span=self.name).observe(elapsed)
+        log.debug(
+            "span %s trace=%s span=%s %.6fs%s %s",
+            self.name,
+            self.ctx.trace_id,
+            self.ctx.span_id,
+            elapsed,
+            " ERROR" if et is not None else "",
+            self.attrs,
+        )
+
+
+def span(name: str, **attrs: str) -> Span:
+    return Span(name, attrs={k: str(v) for k, v in attrs.items()})
+
+
+def continue_from(traceparent: Optional[str], name: str, **attrs: str) -> Span:
+    """Server-side: adopt the peer's trace id from the wire
+    (peer/mod.rs:1494-1496 extract)."""
+    return Span(
+        name, parent=parse_traceparent(traceparent),
+        attrs={k: str(v) for k, v in attrs.items()},
+    )
+
+
+# -- slow-query logging ----------------------------------------------------
+
+SLOW_QUERY_S = 1.0
+
+
+class timed_query:
+    """Logs any wrapped block slower than 1 s with its SQL — the analog of
+    the reference's sqlite trace_v2 slow-query hook
+    (`klukai-types/src/sqlite.rs:55-65`)."""
+
+    def __init__(self, sql: str):
+        self.sql = sql
+        self._start = 0.0
+
+    def __enter__(self) -> "timed_query":
+        self._start = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        elapsed = time.monotonic() - self._start
+        if elapsed >= SLOW_QUERY_S:
+            METRICS.counter("corro_slow_queries_total").inc()
+            log.warning("slow query (%.3fs): %s", elapsed, self.sql[:500])

@@ -178,7 +178,19 @@ class CrdtStore:
         conn.execute("PRAGMA synchronous = NORMAL")
         conn.execute("PRAGMA foreign_keys = OFF")
         conn.execute("PRAGMA recursive_triggers = OFF")
-        conn.create_function("crdt_pack", -1, _sql_pack, deterministic=True)
+        # native C++ extension keeps Python out of the per-row trigger
+        # path (the cr-sqlite-equivalent native layer); Python fallback
+        # has identical semantics
+        from corrosion_tpu import native
+
+        if not native.load_into(conn):
+            conn.create_function(
+                "crdt_pack", -1, _sql_pack, deterministic=True
+            )
+            conn.create_function(
+                "crdt_cmp", 2, lambda a, b: cmp_values(a, b),
+                deterministic=True,
+            )
 
     def read_conn(self) -> sqlite3.Connection:
         """A new read connection (WAL snapshot isolation for file stores,
@@ -782,7 +794,10 @@ class WriteTx:
         return self
 
     def execute(self, sql: str, params: Sequence[SqliteValue] = ()) -> int:
-        cur = self.conn.execute(sql, tuple(params))
+        from corrosion_tpu.runtime.trace import timed_query
+
+        with timed_query(sql):
+            cur = self.conn.execute(sql, tuple(params))
         return cur.rowcount if cur.rowcount > 0 else 0
 
     def commit(self) -> Tuple[List[Change], int, int]:

@@ -1,0 +1,196 @@
+// SQLite loadable extension: native CRDT hot-path functions.
+//
+// The reference's single native component is the cr-sqlite C extension
+// (loaded in klukai-types/src/sqlite.rs:125-143); this is our equivalent
+// native layer. The write-capture triggers call crdt_pack() once per
+// mutated row, so pk packing is the hottest per-write scalar op — doing
+// it in C++ keeps Python out of the trigger path entirely.
+//
+// Functions:
+//   crdt_pack(v1, v2, ...)  -> BLOB   pk encoding, byte-compatible with
+//                                     cr-sqlite (see types/pack.py)
+//   crdt_unpack_n(blob)     -> INT    column count of a packed pk
+//   crdt_cmp(a, b)          -> INT    -1/0/1 cross-type value order
+//                                     (NULL < numeric < TEXT < BLOB) —
+//                                     the LWW "largest value wins"
+//                                     tie-break on equal col_version
+//
+// Build: g++ -O2 -fPIC -shared (see corrosion_tpu/native.py).
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "sqlite3ext.h"
+SQLITE_EXTENSION_INIT1
+
+namespace {
+
+constexpr uint8_t TYPE_INTEGER = 1;
+constexpr uint8_t TYPE_REAL = 2;
+constexpr uint8_t TYPE_TEXT = 3;
+constexpr uint8_t TYPE_BLOB = 4;
+constexpr uint8_t TYPE_NULL = 5;
+
+// Bytes occupied by the two's-complement u64 pattern, matching the
+// reference's byte-mask probing (pubsub.rs:2315-2340): negatives take 8,
+// zero takes 0.
+int num_bytes_needed(int64_t val) {
+  uint64_t u = static_cast<uint64_t>(val);
+  for (int n = 8; n >= 1; --n) {
+    if ((u >> ((n - 1) * 8)) & 0xFF) return n;
+  }
+  return 0;
+}
+
+void put_int_be(std::string& buf, int64_t val, int nbytes) {
+  uint64_t u = static_cast<uint64_t>(val);
+  for (int i = nbytes - 1; i >= 0; --i) {
+    buf.push_back(static_cast<char>((u >> (i * 8)) & 0xFF));
+  }
+}
+
+void crdt_pack(sqlite3_context* ctx, int argc, sqlite3_value** argv) {
+  if (argc > 0xFF) {
+    sqlite3_result_error(ctx, "too many columns to pack", -1);
+    return;
+  }
+  std::string buf;
+  buf.reserve(1 + argc * 10);
+  buf.push_back(static_cast<char>(argc));
+  for (int i = 0; i < argc; ++i) {
+    sqlite3_value* v = argv[i];
+    switch (sqlite3_value_type(v)) {
+      case SQLITE_NULL:
+        buf.push_back(static_cast<char>(TYPE_NULL));
+        break;
+      case SQLITE_INTEGER: {
+        int64_t val = sqlite3_value_int64(v);
+        int n = num_bytes_needed(val);
+        buf.push_back(static_cast<char>((n << 3) | TYPE_INTEGER));
+        put_int_be(buf, val, n);
+        break;
+      }
+      case SQLITE_FLOAT: {
+        double d = sqlite3_value_double(v);
+        uint64_t bits;
+        std::memcpy(&bits, &d, sizeof(bits));
+        buf.push_back(static_cast<char>(TYPE_REAL));
+        put_int_be(buf, static_cast<int64_t>(bits), 8);
+        break;
+      }
+      case SQLITE_TEXT: {
+        const unsigned char* s = sqlite3_value_text(v);
+        int len = sqlite3_value_bytes(v);
+        int n = len ? num_bytes_needed(len) : 0;
+        buf.push_back(static_cast<char>((n << 3) | TYPE_TEXT));
+        put_int_be(buf, len, n);
+        buf.append(reinterpret_cast<const char*>(s), len);
+        break;
+      }
+      case SQLITE_BLOB: {
+        const void* b = sqlite3_value_blob(v);
+        int len = sqlite3_value_bytes(v);
+        int n = len ? num_bytes_needed(len) : 0;
+        buf.push_back(static_cast<char>((n << 3) | TYPE_BLOB));
+        put_int_be(buf, len, n);
+        if (len) buf.append(reinterpret_cast<const char*>(b), len);
+        break;
+      }
+      default:
+        sqlite3_result_error(ctx, "unsupported value type", -1);
+        return;
+    }
+  }
+  sqlite3_result_blob64(ctx, buf.data(), buf.size(), SQLITE_TRANSIENT);
+}
+
+void crdt_unpack_n(sqlite3_context* ctx, int argc, sqlite3_value** argv) {
+  if (argc != 1 || sqlite3_value_type(argv[0]) != SQLITE_BLOB) {
+    sqlite3_result_error(ctx, "crdt_unpack_n expects one blob", -1);
+    return;
+  }
+  int len = sqlite3_value_bytes(argv[0]);
+  if (len < 1) {
+    sqlite3_result_error(ctx, "empty pk buffer", -1);
+    return;
+  }
+  const unsigned char* data =
+      static_cast<const unsigned char*>(sqlite3_value_blob(argv[0]));
+  sqlite3_result_int(ctx, data[0]);
+}
+
+int type_rank(int sqlite_type) {
+  switch (sqlite_type) {
+    case SQLITE_NULL: return 0;
+    case SQLITE_INTEGER:
+    case SQLITE_FLOAT: return 1;
+    case SQLITE_TEXT: return 2;
+    case SQLITE_BLOB: return 3;
+  }
+  return 4;
+}
+
+// Cross-type total order (types/values.py cmp_values): the LWW
+// tie-break on equal col_version ("largest value wins", the semantics
+// behind crsql_config_set('merge-equal-values', 1)).
+void crdt_cmp(sqlite3_context* ctx, int argc, sqlite3_value** argv) {
+  if (argc != 2) {
+    sqlite3_result_error(ctx, "crdt_cmp expects two values", -1);
+    return;
+  }
+  sqlite3_value *a = argv[0], *b = argv[1];
+  int ta = sqlite3_value_type(a), tb = sqlite3_value_type(b);
+  int ra = type_rank(ta), rb = type_rank(tb);
+  if (ra != rb) {
+    sqlite3_result_int(ctx, ra < rb ? -1 : 1);
+    return;
+  }
+  int out = 0;
+  if (ra == 0) {
+    out = 0;
+  } else if (ra == 1) {
+    double da = sqlite3_value_double(a), db = sqlite3_value_double(b);
+    if (ta == SQLITE_INTEGER && tb == SQLITE_INTEGER) {
+      int64_t ia = sqlite3_value_int64(a), ib = sqlite3_value_int64(b);
+      out = ia < ib ? -1 : (ia > ib ? 1 : 0);
+    } else {
+      out = da < db ? -1 : (da > db ? 1 : 0);
+    }
+  } else {
+    int la = sqlite3_value_bytes(a), lb = sqlite3_value_bytes(b);
+    const void* pa = ra == 2 ? static_cast<const void*>(sqlite3_value_text(a))
+                             : sqlite3_value_blob(a);
+    const void* pb = ra == 2 ? static_cast<const void*>(sqlite3_value_text(b))
+                             : sqlite3_value_blob(b);
+    int n = la < lb ? la : lb;
+    int c = n ? std::memcmp(pa, pb, n) : 0;
+    if (c != 0) {
+      out = c < 0 ? -1 : 1;
+    } else {
+      out = la < lb ? -1 : (la > lb ? 1 : 0);
+    }
+  }
+  sqlite3_result_int(ctx, out);
+}
+
+}  // namespace
+
+extern "C" int sqlite3_crdtext_init(sqlite3* db, char** pzErrMsg,
+                                    const sqlite3_api_routines* pApi) {
+  SQLITE_EXTENSION_INIT2(pApi);
+  (void)pzErrMsg;
+  int rc = sqlite3_create_function_v2(
+      db, "crdt_pack", -1, SQLITE_UTF8 | SQLITE_DETERMINISTIC, nullptr,
+      crdt_pack, nullptr, nullptr, nullptr);
+  if (rc != SQLITE_OK) return rc;
+  rc = sqlite3_create_function_v2(
+      db, "crdt_unpack_n", 1, SQLITE_UTF8 | SQLITE_DETERMINISTIC, nullptr,
+      crdt_unpack_n, nullptr, nullptr, nullptr);
+  if (rc != SQLITE_OK) return rc;
+  rc = sqlite3_create_function_v2(
+      db, "crdt_cmp", 2, SQLITE_UTF8 | SQLITE_DETERMINISTIC, nullptr,
+      crdt_cmp, nullptr, nullptr, nullptr);
+  return rc;
+}

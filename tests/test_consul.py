@@ -1,0 +1,235 @@
+"""Consul sync tests: fake Consul agent HTTP server + live corrosion API.
+Mirrors `klukai/src/command/consul/sync.rs` coverage: hash-based change
+detection, upsert/delete flow, notes hash directives, restart warm-up."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import web
+
+from corrosion_tpu.agent.run import run, setup as agent_setup, shutdown
+from corrosion_tpu.api.http import ApiServer
+from corrosion_tpu.client import CorrosionApiClient
+from corrosion_tpu.consul import (
+    AgentCheck,
+    AgentService,
+    ConsulClient,
+    ConsulSetupError,
+    ConsulSync,
+    diff_checks,
+    diff_services,
+    hash_check,
+    hash_service,
+    setup as consul_setup,
+)
+from corrosion_tpu.net.mem import MemNetwork
+from corrosion_tpu.runtime.config import Config
+
+CONSUL_SCHEMA = """
+CREATE TABLE consul_services (
+    node TEXT NOT NULL, id TEXT NOT NULL,
+    name TEXT NOT NULL DEFAULT '', tags TEXT NOT NULL DEFAULT '[]',
+    meta TEXT NOT NULL DEFAULT '{}', port INTEGER NOT NULL DEFAULT 0,
+    address TEXT NOT NULL DEFAULT '', updated_at INTEGER NOT NULL DEFAULT 0,
+    PRIMARY KEY (node, id)
+);
+CREATE TABLE consul_checks (
+    node TEXT NOT NULL, id TEXT NOT NULL,
+    service_id TEXT NOT NULL DEFAULT '', service_name TEXT NOT NULL DEFAULT '',
+    name TEXT NOT NULL DEFAULT '', status TEXT NOT NULL DEFAULT '',
+    output TEXT NOT NULL DEFAULT '', updated_at INTEGER NOT NULL DEFAULT 0,
+    PRIMARY KEY (node, id)
+);
+"""
+
+
+class FakeConsul:
+    """Stands in for the local Consul agent HTTP API."""
+
+    def __init__(self):
+        self.services = {}
+        self.checks = {}
+        self.runner = None
+        self.addr = None
+
+    async def start(self):
+        app = web.Application()
+        app.router.add_get("/v1/agent/services", self.h_services)
+        app.router.add_get("/v1/agent/checks", self.h_checks)
+        self.runner = web.AppRunner(app)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", 0)
+        await site.start()
+        host, port = self.runner.addresses[0][:2]
+        self.addr = f"{host}:{port}"
+
+    async def stop(self):
+        if self.runner:
+            await self.runner.cleanup()
+
+    async def h_services(self, _req):
+        return web.json_response(self.services)
+
+    async def h_checks(self, _req):
+        return web.json_response(self.checks)
+
+
+def svc_json(sid, name, port=80, tags=(), addr="10.0.0.1"):
+    return {
+        "ID": sid,
+        "Service": name,
+        "Tags": list(tags),
+        "Meta": {},
+        "Port": port,
+        "Address": addr,
+    }
+
+
+def check_json(cid, sid, sname, status, output="", notes=""):
+    return {
+        "CheckID": cid,
+        "Name": cid,
+        "Status": status,
+        "Output": output,
+        "ServiceID": sid,
+        "ServiceName": sname,
+        "Notes": notes,
+    }
+
+
+def test_hash_service_stable_and_sensitive():
+    a = AgentService.from_json(svc_json("s1", "web"))
+    b = AgentService.from_json(svc_json("s1", "web"))
+    c = AgentService.from_json(svc_json("s1", "web", port=81))
+    assert hash_service(a) == hash_service(b)
+    assert hash_service(a) != hash_service(c)
+
+
+def test_hash_check_default_ignores_output():
+    a = AgentCheck.from_json(check_json("c1", "s1", "web", "passing", "x"))
+    b = AgentCheck.from_json(check_json("c1", "s1", "web", "passing", "y"))
+    c = AgentCheck.from_json(check_json("c1", "s1", "web", "critical", "y"))
+    assert hash_check(a) == hash_check(b)  # output not hashed by default
+    assert hash_check(a) != hash_check(c)  # status is
+
+
+def test_hash_check_notes_directive():
+    notes = json.dumps({"hash_include": ["output"]})
+    a = AgentCheck.from_json(
+        check_json("c1", "s1", "web", "passing", "x", notes)
+    )
+    b = AgentCheck.from_json(
+        check_json("c1", "s1", "web", "passing", "y", notes)
+    )
+    c = AgentCheck.from_json(
+        check_json("c1", "s1", "web", "critical", "x", notes)
+    )
+    assert hash_check(a) != hash_check(b)  # output IS hashed
+    assert hash_check(a) == hash_check(c)  # status is NOT
+
+
+def test_diff_services_upsert_delete_unchanged():
+    s1 = AgentService.from_json(svc_json("s1", "web"))
+    s2 = AgentService.from_json(svc_json("s2", "db"))
+    hashes = {"s1": hash_service(s1), "gone": 123}
+    ups, dels = diff_services({"s1": s1, "s2": s2}, hashes)
+    assert [u[0].id for u in ups] == ["s2"]  # s1 unchanged, s2 new
+    assert dels == ["gone"]
+
+
+async def boot(tmp_path):
+    cfg = Config()
+    cfg.db.path = ":memory:"
+    cfg.gossip.bind_addr = "a:1"
+    cfg.api.bind_addr = ["127.0.0.1:0"]
+    net = MemNetwork()
+    agent = await agent_setup(cfg, network=net)
+    agent.store.apply_schema_sql(CONSUL_SCHEMA)
+    await run(agent)
+    api_srv = ApiServer(agent)
+    await api_srv.start()
+    return agent, api_srv
+
+
+async def test_end_to_end_sync_flow(tmp_path):
+    agent, api_srv = await boot(tmp_path)
+    fake = FakeConsul()
+    await fake.start()
+    api = CorrosionApiClient(api_srv.addrs[0])
+    consul = ConsulClient(fake.addr)
+    try:
+        sync = ConsulSync(consul, api, node="testnode")
+        await consul_setup(api)
+        await sync.load_hashes()
+
+        # round 1: one service + one check appear
+        fake.services["s1"] = svc_json("s1", "web", tags=("prod",))
+        fake.checks["c1"] = check_json("c1", "s1", "web", "passing")
+        svc_stats, chk_stats = await sync.tick()
+        assert (svc_stats.upserted, svc_stats.deleted) == (1, 0)
+        assert (chk_stats.upserted, chk_stats.deleted) == (1, 0)
+
+        rows = await api.query_rows(
+            "SELECT node, id, name, tags FROM consul_services"
+        )
+        assert rows == [["testnode", "s1", "web", '["prod"]']]
+        rows = await api.query_rows(
+            "SELECT id, status FROM consul_checks"
+        )
+        assert rows == [["c1", "passing"]]
+
+        # round 2: nothing changed → no writes
+        svc_stats, chk_stats = await sync.tick()
+        assert svc_stats.is_zero and chk_stats.is_zero
+
+        # round 3: status flaps, service unchanged
+        fake.checks["c1"] = check_json("c1", "s1", "web", "critical")
+        svc_stats, chk_stats = await sync.tick()
+        assert svc_stats.is_zero
+        assert chk_stats.upserted == 1
+        rows = await api.query_rows("SELECT status FROM consul_checks")
+        assert rows == [["critical"]]
+
+        # round 4: service deregisters
+        del fake.services["s1"]
+        del fake.checks["c1"]
+        svc_stats, chk_stats = await sync.tick()
+        assert svc_stats.deleted == 1 and chk_stats.deleted == 1
+        assert await api.query_rows("SELECT id FROM consul_services") == []
+
+        # restart warm-up: fresh sync from the same db sees no changes
+        fake.services["s2"] = svc_json("s2", "cache")
+        await sync.tick()
+        sync2 = ConsulSync(ConsulClient(fake.addr), api, node="testnode")
+        await sync2.load_hashes()
+        assert sync2.service_hashes == sync.service_hashes
+        svc_stats, _ = await sync2.tick()
+        assert svc_stats.is_zero
+        await sync2.consul.close()
+    finally:
+        await consul.close()
+        await api.close()
+        await fake.stop()
+        await api_srv.stop()
+        await shutdown(agent)
+
+
+async def test_setup_rejects_missing_schema(tmp_path):
+    cfg = Config()
+    cfg.db.path = ":memory:"
+    cfg.gossip.bind_addr = "a:1"
+    cfg.api.bind_addr = ["127.0.0.1:0"]
+    net = MemNetwork()
+    agent = await agent_setup(cfg, network=net)  # no consul tables
+    await run(agent)
+    api_srv = ApiServer(agent)
+    await api_srv.start()
+    api = CorrosionApiClient(api_srv.addrs[0])
+    try:
+        with pytest.raises(ConsulSetupError):
+            await consul_setup(api)
+    finally:
+        await api.close()
+        await api_srv.stop()
+        await shutdown(agent)

@@ -1,0 +1,110 @@
+"""Devcluster tests: topology parsing, in-process convergence + broadcast
+latency measurement, subprocess cluster. Mirrors klukai-devcluster plus
+the BASELINE measurement harness."""
+
+import os
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from corrosion_tpu.agent.membership import SwimConfig
+from corrosion_tpu.devcluster import (
+    DevCluster,
+    ProcessCluster,
+    Topology,
+    TopologyError,
+)
+from corrosion_tpu.net.mem import MemNetwork
+
+TEST_SCHEMA = (
+    "CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, text TEXT);"
+)
+
+FAST_SWIM = SwimConfig(probe_period=0.05, probe_rtt=0.02, suspicion_mult=1.0)
+
+
+def test_topology_parse():
+    topo = Topology.parse(
+        """
+        # a comment
+        A -> B
+        B -> C
+        A -> C
+        """
+    )
+    assert topo.nodes() == ["A", "B", "C"]
+    assert topo.edges["A"] == ["B", "C"]
+    assert topo.edges["C"] == []
+    assert topo.responders() == ["C"]
+    assert topo.initiators() == ["A", "B"]
+
+
+def test_topology_parse_dedup_and_errors():
+    topo = Topology.parse("A -> B\nA -> B\n")
+    assert topo.edges["A"] == ["B"]
+    with pytest.raises(TopologyError):
+        Topology.parse("A => B")
+    with pytest.raises(TopologyError):
+        Topology.parse("A ->")
+
+
+async def test_in_process_cluster_converges_and_replicates():
+    topo = Topology.parse("A -> C\nB -> C\n")
+    cluster = DevCluster(
+        topo, TEST_SCHEMA, network=MemNetwork(), swim_config=FAST_SWIM
+    )
+    await cluster.start()
+    try:
+        t = await cluster.wait_converged(timeout=20.0)
+        assert t < 20.0
+        assert cluster.membership_counts() == {"A": 3, "B": 3, "C": 3}
+
+        lat = await cluster.measure_broadcast_latency(
+            "A", "tests", 1, "hello", timeout=20.0
+        )
+        assert set(lat) == {"A", "B", "C"}
+        assert all(v < 20.0 for v in lat.values())
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.slow
+def test_process_cluster_three_nodes(tmp_path):
+    topo = Topology.parse("A -> C\nB -> C\n")
+    cluster = ProcessCluster(topo, str(tmp_path), TEST_SCHEMA)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(Path(__file__).resolve().parent.parent)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cluster.start(env=env)
+    try:
+        cluster.wait_up(timeout=60.0)
+        # all three admin sockets respond; membership converges to 3
+        import asyncio
+
+        from corrosion_tpu.admin import AdminClient
+
+        async def counts():
+            out = {}
+            for name, path in cluster.admin_paths.items():
+                async with AdminClient(path) as c:
+                    r = await c.call(
+                        {"cmd": "cluster", "sub": "membership-states"}
+                    )
+                    alive = [
+                        s for s in r["json"][0] if s["state"] == "ALIVE"
+                    ]
+                    out[name] = len(alive)
+            return out
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            c = asyncio.run(counts())
+            if all(v == 3 for v in c.values()):
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError(f"no convergence: {c}")
+    finally:
+        cluster.stop()

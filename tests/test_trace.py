@@ -1,0 +1,95 @@
+"""Tracing: W3C traceparent round-trip, context propagation across the
+sync protocol wire, slow-query accounting. Mirrors SURVEY §5 tracing
+(sync.rs:33-67 SyncTraceContextV1 propagation)."""
+
+import asyncio
+
+from corrosion_tpu.runtime import trace as tr
+from corrosion_tpu.runtime.metrics import METRICS
+from corrosion_tpu.types.codec import (
+    SyncTraceContext,
+    decode_bi_payload,
+    encode_bi_payload_sync_start,
+)
+from corrosion_tpu.types.actor import ActorId, ClusterId
+
+
+def test_traceparent_roundtrip():
+    with tr.span("outer") as sp:
+        tp = sp.ctx.traceparent()
+        assert tp.startswith("00-")
+        parsed = tr.parse_traceparent(tp)
+        assert parsed.trace_id == sp.ctx.trace_id
+        assert parsed.span_id == sp.ctx.span_id
+        assert parsed.sampled
+
+
+def test_parse_rejects_garbage():
+    assert tr.parse_traceparent(None) is None
+    assert tr.parse_traceparent("") is None
+    assert tr.parse_traceparent("junk") is None
+    assert tr.parse_traceparent("00-" + "0" * 32 + "-" + "1" * 16 + "-01") is None
+
+
+def test_child_span_shares_trace_id():
+    with tr.span("parent") as p:
+        with tr.span("child") as c:
+            assert c.ctx.trace_id == p.ctx.trace_id
+            assert c.ctx.span_id != p.ctx.span_id
+            assert tr.current_traceparent() == c.ctx.traceparent()
+        assert tr.current_traceparent() == p.ctx.traceparent()
+    assert tr.current_traceparent() is None
+
+
+def test_continue_from_adopts_remote_trace():
+    remote = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    with tr.continue_from(remote, "sync.server") as sp:
+        assert sp.ctx.trace_id == "ab" * 16
+        assert sp.ctx.span_id != "cd" * 8  # new span, same trace
+    # bad incoming context → fresh trace, never an error
+    with tr.continue_from("garbage", "sync.server") as sp:
+        assert len(sp.ctx.trace_id) == 32
+
+
+def test_trace_context_rides_sync_start_wire():
+    aid = ActorId.new_random()
+    with tr.span("sync.client") as sp:
+        frame = encode_bi_payload_sync_start(
+            aid,
+            trace=SyncTraceContext(traceparent=sp.ctx.traceparent()),
+            cluster_id=ClusterId(3),
+        )
+    got_aid, got_trace, got_cid = decode_bi_payload(frame)
+    assert got_aid == aid
+    assert got_cid == ClusterId(3)
+    assert tr.parse_traceparent(got_trace.traceparent).trace_id == sp.ctx.trace_id
+
+
+def test_timed_query_counts_slow():
+    import time as _time
+
+    before = METRICS.counter("corro_slow_queries_total").value
+    old = tr.SLOW_QUERY_S
+    tr.SLOW_QUERY_S = 0.01
+    try:
+        with tr.timed_query("SELECT slow"):
+            _time.sleep(0.02)
+    finally:
+        tr.SLOW_QUERY_S = old
+    assert METRICS.counter("corro_slow_queries_total").value == before + 1
+
+
+def test_span_context_isolated_per_task():
+    async def main():
+        seen = {}
+
+        async def worker(name):
+            with tr.span(name) as sp:
+                await asyncio.sleep(0.01)
+                seen[name] = tr.current_context().trace_id
+                assert tr.current_context().span_id == sp.ctx.span_id
+
+        await asyncio.gather(worker("a"), worker("b"))
+        assert seen["a"] != seen["b"]
+
+    asyncio.run(main())
