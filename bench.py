@@ -1,24 +1,44 @@
 """Benchmark: time-to-stable-membership for a simulated SWIM devcluster.
 
 North star (BASELINE.md): converge a 100k-member devcluster to stable
-membership in <60 s on a v5e-8. This single-chip bench measures wall-clock
+membership in <60 s on a v5e-8.  This single-chip bench measures wall-clock
 to 99.9% live-member coverage for BENCH_N members (default 10_000 — the
 "10k on one core" rung of the BASELINE.json scale ladder) with zero false
 positives, and reports vs_baseline as (60 s budget / measured), >1 = faster
 than the north-star budget.
 
-Prints exactly one JSON line.
+Prints exactly one JSON line on stdout.
+
+Driver hardening (round 2): the TPU plugin in the driver image can hang or
+fail at backend init (see corrosion_tpu/runtime/jaxenv.py).  The parent
+process therefore does no jax work at all: it probes the inherited backend
+in a bounded subprocess, then runs the measured simulation in a child with
+a wall-clock budget, falling back to a known-good CPU env (plugin stripped
+from PYTHONPATH) if the TPU attempt probes bad, crashes, or times out.
+Every phase is bounded so the driver can never hit rc=124 here.
+
+Env knobs: BENCH_N, BENCH_COVERAGE, BENCH_BUDGET_S (total wall budget,
+default 1500), BENCH_PROBE_S (TPU probe bound, default 150),
+BENCH_FORCE_CPU=1 (skip the TPU attempt).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-def main() -> None:
+from corrosion_tpu.runtime import jaxenv  # noqa: E402
+
+_CHILD_FLAG = "CORRO_BENCH_CHILD"
+
+
+def child_main() -> None:
+    """The measured simulation; runs under an env chosen by the parent."""
     import jax
 
     from corrosion_tpu.models.cluster import ClusterSim
@@ -63,5 +83,91 @@ def main() -> None:
         sys.exit(1)
 
 
+def _run_child(env: dict, timeout: float) -> tuple[dict | None, int]:
+    """Run the bench child under ``env``; (parsed JSON line, returncode).
+
+    The JSON is parsed even when the child exits nonzero: a measured
+    convergence failure still carries its diagnostics (coverage,
+    false_positive, stable_tick) and must not be discarded.
+    """
+    env = dict(env)
+    env[_CHILD_FLAG] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-u", os.path.abspath(__file__)],
+            env=env,
+            timeout=timeout,
+            capture_output=True,
+            text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return None, -1
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except (ValueError, TypeError):
+            continue
+        if isinstance(parsed, dict) and "metric" in parsed:
+            return parsed, proc.returncode
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr[-2000:])
+    return None, proc.returncode
+
+
+def main() -> None:
+    t_start = time.monotonic()
+    total_budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+    probe_budget = float(os.environ.get("BENCH_PROBE_S", "150"))
+
+    def remaining() -> float:
+        return max(30.0, total_budget - (time.monotonic() - t_start))
+
+    attempts: list[str] = []
+    result: dict | None = None
+    rc = 0
+
+    # Attempt 1: the inherited backend (real TPU when the tunnel is up),
+    # but only if a bounded probe proves it can initialize.
+    if os.environ.get("BENCH_FORCE_CPU") != "1" and os.environ.get(
+        "JAX_PLATFORMS", ""
+    ) not in ("cpu",):
+        platform = jaxenv.probe(None, probe_budget)
+        if platform and platform != "cpu":
+            attempts.append(platform)
+            # leave headroom for the CPU fallback attempt
+            result, rc = _run_child(os.environ.copy(), remaining() * 0.6)
+
+    # Attempt 2 (fallback): known-good CPU env, plugin stripped. Only when
+    # attempt 1 produced no measurement at all — a measured
+    # convergence failure is a result, not a reason to re-run.
+    if result is None:
+        attempts.append("cpu-fallback")
+        result, rc = _run_child(jaxenv.stripped_env(), remaining())
+
+    if result is None:
+        print(
+            json.dumps(
+                {
+                    "metric": "time_to_stable_membership",
+                    "value": 0.0,
+                    "unit": "s",
+                    "vs_baseline": 0.0,
+                    "error": "all bench attempts failed or timed out",
+                    "attempts": attempts,
+                }
+            )
+        )
+        sys.exit(1)
+
+    result.setdefault("detail", {})["attempts"] = attempts
+    print(json.dumps(result))
+    if rc != 0:
+        sys.exit(1)
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get(_CHILD_FLAG) == "1":
+        child_main()
+    else:
+        main()

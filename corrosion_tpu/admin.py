@@ -106,9 +106,12 @@ class AdminServer:
         if name == "ping":
             return [{"kind": "json", "value": "pong"}, {"kind": "success"}]
         if name == "sync" and sub == "generate":
-            return self._sync_generate()
+            # Store scans take the store lock and can be slow on a large
+            # db; keep them off the agent's event loop so gossip timers
+            # and HTTP streams don't stall for the duration.
+            return await asyncio.to_thread(self._sync_generate)
         if name == "sync" and sub == "reconcile-gaps":
-            return self._reconcile_gaps()
+            return await asyncio.to_thread(self._reconcile_gaps)
         if name == "locks":
             return self._locks(cmd.get("top"))
         if name == "cluster" and sub == "members":

@@ -11,7 +11,10 @@ and identity `renew()` auto-rejoin when declared down
 This is the event-driven path for *real* agents (a handful of nodes per
 process over real sockets). The 10⁴–10⁶-member batched path — the same
 state machine vectorized over the member axis — is
-`corrosion_tpu.ops.swim`; parity between the two is asserted in tests.
+`corrosion_tpu.ops.swim`; parity between the two (convergence windows,
+failure-detection latency, no false positives under loss) is asserted in
+`tests/test_swim_parity.py`, which also pins the sharded↔unsharded
+equivalence of the kernel.
 
 Config scaling mirrors `foca::Config::new_wan` as applied at
 `broadcast/mod.rs:951-960`: probe cadence and suspicion windows grow with

@@ -94,11 +94,17 @@ async def setup(
     )
     transport.set_rtt_sink(members.observe_rtt)
 
-    bookie = Bookie()
+    # instrumented-lock registry: bookie guards register here so the admin
+    # `locks` command shows live holds (agent.rs:707-1066)
+    from corrosion_tpu.runtime.locks import LockRegistry
+
+    lock_registry = LockRegistry()
+    bookie = Bookie(registry=lock_registry)
     for aid in store.booked_actor_ids():
         bookie.insert(aid, store.load_booked_versions(aid))
 
     agent = Agent(
+        lock_registry=lock_registry,
         actor=actor,
         config=config,
         store=store,
@@ -169,6 +175,7 @@ async def run(agent: Agent) -> None:
     t.spawn(apply_fully_buffered_loop(agent))
     t.spawn(broadcast_loop(agent))
     t.spawn(sync_loop(agent))
+    t.spawn(_watchdog(agent))
     if agent.config.gossip.bootstrap:
         t.spawn(_announcer(agent))
     # schedule fully-buffered applies for partials already complete on disk
@@ -177,6 +184,15 @@ async def run(agent: Agent) -> None:
             done = [v for v, p in bv.partials.items() if p.is_complete()]
         for version in done:
             agent.tx_apply.try_send((actor_id, version))
+
+
+async def _watchdog(agent: Agent) -> None:
+    """Lock-registry watchdog (setup.rs:188-246); ends on tripwire."""
+    task = asyncio.ensure_future(agent.lock_registry.watchdog())
+    await agent.tripwire.wait()
+    task.cancel()
+    with contextlib.suppress(asyncio.CancelledError):
+        await task
 
 
 async def _announcer(agent: Agent) -> None:

@@ -435,6 +435,18 @@ class ConsulSync:
             except (asyncio.TimeoutError, OSError, RuntimeError) as e:
                 METRICS.counter("corro_consul.consul.response.errors").inc()
                 log.warning("non-fatal consul sync error: %s", e)
+            except Exception as e:
+                # aiohttp raises ClientResponseError/ContentTypeError (not
+                # OSError subclasses) on non-2xx or malformed responses —
+                # common during Consul agent restarts. The reference treats
+                # these as non-fatal too (consul sync.rs response.errors).
+                if type(e).__module__.split(".")[0] not in (
+                    "aiohttp",
+                    "json",
+                ):
+                    raise
+                METRICS.counter("corro_consul.consul.response.errors").inc()
+                log.warning("non-fatal consul sync error: %s", e)
             await asyncio.sleep(PULL_INTERVAL)
 
 

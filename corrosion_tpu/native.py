@@ -14,6 +14,7 @@ trigger path.
 
 from __future__ import annotations
 
+import hashlib
 import importlib.util
 import logging
 import os
@@ -67,9 +68,19 @@ def extension_path() -> Optional[str]:
     with _lock:
         if _built is not None:
             return _built or None
-        if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
-            _built = str(_SO)
-            return _built
+        # Reuse the cached .so only when a recorded content hash of the
+        # source matches — mtimes are arbitrary after a fresh clone, and a
+        # stale or tampered binary must never be silently loaded.
+        src_hash = (
+            hashlib.sha256(_SRC.read_bytes()).hexdigest()
+            if _SRC.exists()
+            else ""
+        )
+        hash_file = _SO.with_suffix(".so.srchash")
+        if _SO.exists() and hash_file.exists() and src_hash:
+            if hash_file.read_text().strip() == src_hash:
+                _built = str(_SO)
+                return _built
         include = _sqlite_include_dir()
         if include is None or not _SRC.exists():
             log.warning("native crdt extension unavailable: no sqlite headers")
@@ -95,6 +106,12 @@ def extension_path() -> Optional[str]:
             log.warning("native crdt extension build failed: %s", detail[:500])
             _built = ""
             return None
+        try:
+            # Best-effort: a failed hash write must not disable the freshly
+            # built extension — it only costs a rebuild next process.
+            hash_file.write_text(src_hash)
+        except OSError as e:
+            log.warning("could not record native ext source hash: %s", e)
         return _built
 
 

@@ -1,0 +1,180 @@
+"""Robust JAX backend selection when a TPU PJRT plugin may hang or fail.
+
+The driver environment ships a tunnel-backed TPU plugin on PYTHONPATH
+(`.axon_site`) whose backend init can hang indefinitely (tunnel down) or
+fail fast (UNAVAILABLE).  Empirical behavior matrix in this image:
+
+- default env (``JAX_PLATFORMS=axon``): interpreter startup is fine;
+  ``jax.devices()`` hangs or raises when the tunnel is down.
+- ``JAX_PLATFORMS=cpu`` with the plugin still on PYTHONPATH: fresh
+  ``import jax`` can hang inside plugin discovery.
+- plugin stripped from PYTHONPATH + ``JAX_PLATFORMS=cpu``: always works.
+- in a process where jax is already imported but backends are NOT yet
+  initialized: ``jax.config.update('jax_platforms', 'cpu')`` (plus
+  ``XLA_FLAGS`` for a virtual device count) reliably selects CPU.
+
+Rules implemented here:
+1. Probe candidate backends only in subprocesses, bounded by timeouts.
+2. CPU subprocesses always use :func:`stripped_env`.
+3. In-process fallback uses :func:`force_cpu_inprocess` and is only safe
+   before the first backend init (checked via :func:`backends_initialized`).
+
+Nothing in this module imports jax at module scope.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+# Substring identifying PYTHONPATH entries that carry the hazardous
+# TPU-plugin site dir (and its sitecustomize auto-registration).
+PLUGIN_PATH_MARKER = ".axon_site"
+
+_PROBE_CODE = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+
+
+def stripped_env(
+    n_devices: int | None = None, base: dict[str, str] | None = None
+) -> dict[str, str]:
+    """A subprocess env with the TPU plugin removed and CPU forced.
+
+    This is the only configuration that reliably initializes JAX in this
+    image regardless of tunnel state.
+    """
+    env = dict(os.environ if base is None else base)
+    parts = [
+        p
+        for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and PLUGIN_PATH_MARKER not in p
+    ]
+    if parts:
+        env["PYTHONPATH"] = os.pathsep.join(parts)
+    else:
+        env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices:
+        # A requested device count overrides any inherited flag value —
+        # a stale --xla_force_host_platform_device_count=1 from the outer
+        # env would otherwise break the dryrun's device-count assert.
+        flags = [
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+def probe(env: dict[str, str] | None, timeout: float) -> str | None:
+    """Platform name if ``jax.devices()`` succeeds under ``env``, else None.
+
+    Runs in a subprocess so a hung backend init can never block the caller.
+    """
+    try:
+        out = subprocess.run(
+            [sys.executable, "-u", "-c", _PROBE_CODE],
+            env=os.environ.copy() if env is None else env,
+            timeout=timeout,
+            capture_output=True,
+            text=True,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    if out.returncode != 0:
+        return None
+    for line in reversed(out.stdout.strip().splitlines()):
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1]
+    return None
+
+
+def backends_initialized() -> bool:
+    """True if this process's jax has already created backend clients."""
+    if "jax" not in sys.modules:
+        return False
+    try:
+        from jax._src import xla_bridge as xb
+
+        return bool(xb._backends)
+    except Exception:
+        return False
+
+
+def initialized_platform() -> str | None:
+    """Platform of the already-initialized default backend, if any."""
+    if not backends_initialized():
+        return None
+    try:
+        import jax
+
+        return jax.devices()[0].platform
+    except Exception:
+        return None
+
+
+def force_cpu_inprocess(n_devices: int | None = None) -> None:
+    """Flip this process's jax to CPU before its first backend init.
+
+    Safe whether or not jax is already imported, as long as no backend has
+    been initialized yet.  With ``n_devices`` also forces a virtual host
+    device count (must happen before CPU client creation).
+    """
+    if n_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if "jax" in sys.modules:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+
+def ensure_usable_backend(
+    probe_timeout: float = 120.0, n_devices: int | None = None
+) -> str:
+    """Make sure this process's first jax backend init will not hang.
+
+    Returns the platform that will be (or already is) in use.  If backends
+    are already initialized, reports what exists.  Otherwise probes the
+    inherited env in a subprocess; on failure flips this process to CPU.
+    """
+    existing = initialized_platform()
+    if existing is not None:
+        return existing
+    if os.environ.get("JAX_PLATFORMS", "") in ("cpu",):
+        force_cpu_inprocess(n_devices)
+        return "cpu"
+    platform = probe(None, probe_timeout)
+    if platform is None or platform == "cpu":
+        force_cpu_inprocess(n_devices)
+        return "cpu"
+    return platform
+
+
+def run_python(
+    code: str,
+    env: dict[str, str],
+    timeout: float,
+    cwd: str | None = None,
+) -> subprocess.CompletedProcess | None:
+    """Run ``python -c code`` under ``env``; None on timeout."""
+    try:
+        return subprocess.run(
+            [sys.executable, "-u", "-c", code],
+            env=env,
+            timeout=timeout,
+            capture_output=True,
+            text=True,
+            cwd=cwd,
+        )
+    except subprocess.TimeoutExpired:
+        return None

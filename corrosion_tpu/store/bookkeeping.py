@@ -185,45 +185,63 @@ class Booked:
     this runtime never block across awaits while holding it.
     """
 
-    def __init__(self, bv: BookedVersions):
+    def __init__(self, bv: BookedVersions, registry=None):
         self._bv = bv
         self._lock = threading.RLock()
+        self._registry = registry
+        self._label = f"booked:{bv.actor_id}"
 
     def read(self) -> "_BookedGuard":
-        return _BookedGuard(self._bv, self._lock)
+        return _BookedGuard(
+            self._bv, self._lock, self._registry, self._label, "read"
+        )
 
-    def write(self, _label: str = "") -> "_BookedGuard":
-        return _BookedGuard(self._bv, self._lock)
+    def write(self, label: str = "") -> "_BookedGuard":
+        full = f"{self._label}:{label}" if label else self._label
+        return _BookedGuard(self._bv, self._lock, self._registry, full, "write")
 
 
 class _BookedGuard:
-    __slots__ = ("bv", "_lock")
+    __slots__ = ("bv", "_lock", "_registry", "_label", "_kind", "_meta")
 
-    def __init__(self, bv: BookedVersions, lock):
+    def __init__(self, bv: BookedVersions, lock, registry, label, kind):
         self.bv = bv
         self._lock = lock
+        self._registry = registry
+        self._label = label
+        self._kind = kind
+        self._meta = None
 
     def __enter__(self) -> BookedVersions:
+        if self._registry is not None:
+            self._meta = self._registry.register(self._label, self._kind)
         self._lock.acquire()
+        if self._registry is not None:
+            self._registry.acquired(self._meta)
         return self.bv
 
     def __exit__(self, *exc) -> bool:
         self._lock.release()
+        if self._registry is not None and self._meta is not None:
+            self._registry.release(self._meta)
+            self._meta = None
         return False
 
 
 class Bookie:
     """actor_id → Booked map (agent.rs:1558-1609)."""
 
-    def __init__(self):
+    def __init__(self, registry=None):
         self._map: Dict[ActorId, Booked] = {}
         self._lock = threading.Lock()
+        # LockRegistry (runtime/locks.py) so admin `locks` sees holds
+        self._registry = registry
 
     def ensure(self, actor_id: ActorId) -> Booked:
         with self._lock:
             b = self._map.get(actor_id)
             if b is None:
-                b = Booked(BookedVersions(actor_id))
+                b = Booked(BookedVersions(actor_id), self._registry)
                 self._map[actor_id] = b
             return b
 
@@ -235,7 +253,7 @@ class Bookie:
         """Install pre-loaded bookkeeping (startup warm-up from durable
         state, run_root.rs:136-197)."""
         with self._lock:
-            b = Booked(bv)
+            b = Booked(bv, self._registry)
             self._map[actor_id] = b
             return b
 

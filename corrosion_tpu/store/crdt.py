@@ -755,7 +755,13 @@ class CrdtStore:
 
     def booked_actor_ids(self) -> List[ActorId]:
         """All sites we have any state for (bookie warm-up,
-        run_root.rs:136-197)."""
+        run_root.rs:136-197). Takes the store lock: callers may run off the
+        event loop (admin reconcile in a worker thread) and must not read
+        the shared connection inside another thread's open transaction."""
+        with self._lock:
+            return self._booked_actor_ids_locked()
+
+    def _booked_actor_ids_locked(self) -> List[ActorId]:
         ids = {
             bytes(r["site_id"])
             for r in self._conn.execute("SELECT site_id FROM __crdt_db_versions")

@@ -33,9 +33,18 @@ RESERVED = 0x40000001
 SHARED_FIRST = 0x40000002
 SHARED_SIZE = 510
 
-# SHM file lock bytes: WRITE..DMS = 120..128
+# SHM file lock bytes: WRITE..READ4 = 120..127, DMS = 128. Every live WAL
+# connection holds a SHARED lock on DMS for its lifetime, so DMS must be
+# taken shared, not exclusive, or locking against a live process always
+# times out (sqlite3_restore.rs:185 takes a read lock there for the same
+# reason).
 SHM_FIRST = 120
-SHM_COUNT = 9
+SHM_COUNT = 8
+SHM_DMS = 128
+# A zeroed shm header (first 136 bytes: 2×48-byte WalIndexHdr + 40-byte
+# WalCkptInfo) forces the next reader to re-run recovery
+# (sqlite3_restore.rs:113-114).
+SHM_HEADER_SIZE = 136
 
 
 class RestoreError(Exception):
@@ -49,6 +58,14 @@ class LockTimedOut(RestoreError):
 def _try_wrlock(fd: int, start: int, length: int) -> bool:
     try:
         fcntl.lockf(fd, fcntl.LOCK_EX | fcntl.LOCK_NB, length, start, os.SEEK_SET)
+        return True
+    except (BlockingIOError, PermissionError):
+        return False
+
+
+def _try_rdlock(fd: int, start: int, length: int) -> bool:
+    try:
+        fcntl.lockf(fd, fcntl.LOCK_SH | fcntl.LOCK_NB, length, start, os.SEEK_SET)
         return True
     except (BlockingIOError, PermissionError):
         return False
@@ -79,11 +96,13 @@ def lock_all(db_path: str, timeout: float = 30.0) -> _HeldLocks:
     held = _HeldLocks()
     deadline = time.monotonic() + timeout
 
-    def acquire(path: str, ranges) -> None:
+    def acquire(path: str, ranges, shared=()) -> None:
         fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
         held.fds.append(fd)
-        for start, length in ranges:
-            while not _try_wrlock(fd, start, length):
+        for start, length, trylock in [
+            (s, l, _try_wrlock) for s, l in ranges
+        ] + [(s, l, _try_rdlock) for s, l in shared]:
+            while not trylock(fd, start, length):
                 if time.monotonic() > deadline:
                     held.release()
                     raise LockTimedOut(
@@ -102,7 +121,11 @@ def lock_all(db_path: str, timeout: float = 30.0) -> _HeldLocks:
         )
         shm = db_path + "-shm"
         if os.path.exists(shm):
-            acquire(shm, [(SHM_FIRST, SHM_COUNT)])
+            acquire(
+                shm,
+                [(SHM_FIRST, SHM_COUNT)],
+                shared=[(SHM_DMS, 1)],
+            )
     except BaseException:
         held.release()
         raise
@@ -148,11 +171,20 @@ def restore(src: str, dst: str, timeout: float = 30.0) -> Restored:
                 f"inconsistent copy: expected {expected}, got {actual}"
             )
         os.replace(tmp, dst)
-        for suffix in ("-wal", "-shm"):
-            try:
-                os.unlink(dst + suffix)
-            except FileNotFoundError:
-                pass
+        # Live WAL connections keep fds/mappings to the old -wal/-shm
+        # inodes, so neither file may be unlinked (a survivor would rebuild
+        # the shared shm index from a wal inode new connections can't see).
+        # Instead truncate the wal in place and zero the shm header: every
+        # connection, old or new, then agrees on an empty wal and re-runs
+        # recovery on next use (sqlite3_restore.rs:113-114).
+        wal = dst + "-wal"
+        if os.path.exists(wal):
+            with open(wal, "r+b") as f:
+                f.truncate(0)
+        shm = dst + "-shm"
+        if os.path.exists(shm):
+            with open(shm, "r+b") as f:
+                f.write(b"\x00" * SHM_HEADER_SIZE)
         return Restored(old_len=old_len, new_len=actual, is_wal=is_wal)
     finally:
         locks.release()

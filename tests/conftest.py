@@ -2,16 +2,20 @@
 
 Multi-chip TPU hardware isn't available in CI; sharding tests run on a
 virtual CPU mesh exactly like the driver's dryrun (see __graft_entry__.py).
+
+The image's TPU plugin can hang at backend init (see
+corrosion_tpu/runtime/jaxenv.py), so tests unconditionally flip this
+process to CPU — env JAX_PLATFORMS=axon must not leak into test runs.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from corrosion_tpu.runtime import jaxenv  # noqa: E402
+
+jaxenv.force_cpu_inprocess(n_devices=8)
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402

@@ -1,0 +1,273 @@
+"""Parity between the three SWIM execution paths.
+
+The framework runs the same protocol three ways:
+  1. event-driven per-node state machines over sockets
+     (`corrosion_tpu.agent.membership`, the foca-equivalent used by real
+     agents — `klukai-agent/src/broadcast/mod.rs:121-386`),
+  2. the batched array kernel (`corrosion_tpu.ops.swim`, one jitted tick
+     for all members), and
+  3. the member-sharded kernel over a device mesh
+     (`corrosion_tpu.parallel`, the multi-chip path).
+
+These tests pin the equivalences the design claims (BASELINE.md north
+star #2): 3↔2 must be *bit-identical* (same deterministic integer
+computation, different layout), and 1↔2 must agree behaviorally —
+convergence within the same number of protocol periods (to a tolerance),
+failure detection inside the same suspicion window, and no false
+positives in a healthy cluster.
+"""
+
+import asyncio
+import math
+import random
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from corrosion_tpu.agent.membership import Membership, SwimConfig
+from corrosion_tpu.net.mem import LinkFaults, MemNetwork
+from corrosion_tpu.ops import swim
+from corrosion_tpu.parallel import member_mesh, shard_swim_state, sharded_tick
+from corrosion_tpu.runtime.tripwire import Tripwire
+from corrosion_tpu.types.actor import Actor, ActorId
+from corrosion_tpu.types.base import Timestamp
+
+# ---------------------------------------------------------------------------
+# sharded ↔ unsharded: exact equivalence
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("ticks", [1, 4])
+def test_sharded_tick_matches_unsharded(ticks):
+    """The sharded kernel is the SAME integer computation with layout
+    constraints, so its output must be bit-identical to the single-device
+    kernel under the same rng sequence."""
+    n_dev = 8
+    devices = jax.devices()
+    assert len(devices) >= n_dev, "conftest forces an 8-device CPU mesh"
+    params = swim.SwimParams(n=8 * n_dev)
+
+    state_a = swim.init_state(params, jax.random.PRNGKey(3))
+    mesh = member_mesh(devices[:n_dev])
+    state_b = shard_swim_state(
+        swim.init_state(params, jax.random.PRNGKey(3)), mesh
+    )
+    stick = sharded_tick(params, mesh)
+
+    rng = jax.random.PRNGKey(9)
+    for _ in range(ticks):
+        rng, key = jax.random.split(rng)
+        state_a = swim.tick(state_a, key, params)
+        state_b = stick(state_b, key)
+
+    for name, arr_a in state_a._asdict().items():
+        arr_b = getattr(state_b, name)
+        assert jnp.array_equal(arr_a, arr_b), f"field {name} diverged"
+
+
+def test_sharded_stats_match_unsharded():
+    """membership_stats must not depend on the layout either."""
+    n_dev = 8
+    params = swim.SwimParams(n=8 * n_dev)
+    state = swim.init_state(params, jax.random.PRNGKey(0))
+    rng = jax.random.PRNGKey(1)
+    for _ in range(3):
+        rng, key = jax.random.split(rng)
+        state = swim.tick(state, key, params)
+
+    mesh = member_mesh(jax.devices()[:n_dev])
+    sharded = shard_swim_state(state, mesh)
+    a = swim.membership_stats(state)
+    b = swim.membership_stats(sharded)
+    for k in a:
+        assert a[k] == pytest.approx(b[k], abs=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# batched ↔ event-driven: behavioral parity
+# ---------------------------------------------------------------------------
+
+N_PARITY = 8
+# Shared protocol geometry: a suspicion window of ~4 protocol periods in
+# both paths, so detection-latency comparisons are apples-to-apples.
+SUSPICION_PERIODS = 4
+EV_PERIOD = 0.05
+EV_CFG = SwimConfig(
+    probe_period=EV_PERIOD,
+    probe_rtt=0.02,
+    # suspect_timeout(n) = mult * log2(n+2) * period  ==  4 periods
+    suspicion_mult=SUSPICION_PERIODS / math.log2(N_PARITY + 2),
+)
+SIM_PARAMS = dict(suspicion_ticks=SUSPICION_PERIODS, seeds_per_member=1)
+# generous shared budget: both paths must converge an 8-member boot
+# within this many protocol periods
+CONVERGE_PERIODS = 30
+DETECT_PERIODS = SUSPICION_PERIODS + 8  # probe + suspicion + gossip slack
+
+
+def _sim_cluster(n=N_PARITY, seed=0):
+    from corrosion_tpu.models.cluster import ClusterSim
+
+    return ClusterSim(
+        n,
+        seed=seed,
+        seeds_per_member=SIM_PARAMS["seeds_per_member"],
+        seed_mode="hub",
+        suspicion_ticks=SIM_PARAMS["suspicion_ticks"],
+    )
+
+
+def _mk_node(net: MemNetwork, i: int):
+    addr = f"node{i}"
+    actor = Actor(
+        id=ActorId(bytes([i]) * 16), addr=addr, ts=Timestamp.from_unix(i)
+    )
+    ms = Membership(actor, net.transport(addr), EV_CFG, rng=random.Random(i))
+
+    async def on_uni(src, data):
+        pass
+
+    async def on_bi(stream):
+        stream.close()
+
+    net.listener(addr).serve(ms.handle_datagram, on_uni, on_bi)
+    return ms
+
+
+async def _ev_boot(net):
+    tw = Tripwire()
+    nodes = [_mk_node(net, i + 1) for i in range(N_PARITY)]
+    for ms in nodes:
+        ms.start(tw)
+    # hub join: everyone announces to node1 (sim analog: seed_mode="hub")
+    for ms in nodes[1:]:
+        await ms.announce("node1")
+    return tw, nodes
+
+
+async def _ev_periods_until(pred, max_periods, step=EV_PERIOD / 2):
+    loop = asyncio.get_event_loop()
+    start = loop.time()
+    deadline = start + max_periods * EV_PERIOD
+    while loop.time() < deadline:
+        if pred():
+            return (loop.time() - start) / EV_PERIOD
+        await asyncio.sleep(step)
+    return None
+
+
+def _sim_periods_until(sim, pred, max_periods):
+    for tick in range(1, max_periods + 1):
+        sim.step()
+        if pred(sim.stats()):
+            return tick
+    return None
+
+
+def test_parity_bootstrap_convergence():
+    """Both paths bring an N-member hub-boot to full mutual knowledge
+    within the shared period budget, with zero false positives."""
+    sim = _sim_cluster()
+    sim_t = _sim_periods_until(
+        sim, lambda s: s["coverage"] >= 1.0, CONVERGE_PERIODS
+    )
+    assert sim_t is not None, "batched kernel failed to converge"
+    assert sim.stats()["false_positive"] == 0.0
+
+    async def main():
+        net = MemNetwork(seed=11)
+        tw, nodes = await _ev_boot(net)
+        ev_t = await _ev_periods_until(
+            lambda: all(ms.cluster_size == N_PARITY for ms in nodes),
+            CONVERGE_PERIODS,
+        )
+        assert ev_t is not None, "event-driven path failed to converge"
+        for ms in nodes:
+            await ms.stop()
+        return ev_t
+
+    ev_t = asyncio.run(main())
+    # same order of magnitude: neither path takes 5× the other's periods
+    # (both must anyway land inside the same CONVERGE_PERIODS budget)
+    assert sim_t <= CONVERGE_PERIODS and ev_t <= CONVERGE_PERIODS
+    assert max(sim_t, ev_t) / max(1.0, min(sim_t, ev_t)) <= 5.0, (
+        sim_t,
+        ev_t,
+    )
+
+
+def test_parity_failure_detection_window():
+    """A crashed member is declared down by every live peer within the
+    suspicion window (+ slack) in both paths."""
+    sim = _sim_cluster()
+    assert (
+        _sim_periods_until(
+            sim, lambda s: s["coverage"] >= 1.0, CONVERGE_PERIODS
+        )
+        is not None
+    )
+    sim.crash(N_PARITY - 1)
+    sim_det = _sim_periods_until(
+        sim, lambda s: s["detected"] >= 1.0, DETECT_PERIODS * 3
+    )
+    assert sim_det is not None, "batched kernel never detected the crash"
+
+    async def main():
+        net = MemNetwork(seed=13)
+        tw, nodes = await _ev_boot(net)
+        assert await _ev_periods_until(
+            lambda: all(ms.cluster_size == N_PARITY for ms in nodes),
+            CONVERGE_PERIODS,
+        )
+        await nodes[-1].stop()
+        net.take_down(f"node{N_PARITY}")
+        ev_det = await _ev_periods_until(
+            lambda: all(
+                ms.cluster_size == N_PARITY - 1 for ms in nodes[:-1]
+            ),
+            DETECT_PERIODS * 3,
+        )
+        assert ev_det is not None, "event-driven path never detected"
+        for ms in nodes[:-1]:
+            await ms.stop()
+        return ev_det
+
+    ev_det = asyncio.run(main())
+    # both detect after the suspicion window opens and inside the slack
+    assert sim_det <= DETECT_PERIODS * 3
+    assert ev_det <= DETECT_PERIODS * 3
+
+
+def test_parity_no_false_positives_under_loss():
+    """With mild iid datagram loss, neither path falsely downs a live
+    member over an extended healthy window (refutation works)."""
+    from corrosion_tpu.models.cluster import ClusterSim
+
+    sim = ClusterSim(
+        N_PARITY,
+        seed=5,
+        seeds_per_member=1,
+        seed_mode="hub",
+        suspicion_ticks=SIM_PARAMS["suspicion_ticks"],
+        loss=0.05,
+    )
+    for _ in range(CONVERGE_PERIODS * 2):
+        sim.step()
+    assert sim.stats()["false_positive"] == 0.0
+
+    async def main():
+        net = MemNetwork(seed=17, faults=LinkFaults(datagram_loss=0.05))
+        tw, nodes = await _ev_boot(net)
+        assert await _ev_periods_until(
+            lambda: all(ms.cluster_size == N_PARITY for ms in nodes),
+            CONVERGE_PERIODS * 2,
+        )
+        # healthy window: nobody may get kicked
+        await asyncio.sleep(CONVERGE_PERIODS * EV_PERIOD)
+        sizes = [ms.cluster_size for ms in nodes]
+        for ms in nodes:
+            await ms.stop()
+        assert all(s == N_PARITY for s in sizes), sizes
+
+    asyncio.run(main())
