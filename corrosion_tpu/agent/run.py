@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import logging
 import random
 from dataclasses import dataclass
 from pathlib import Path
@@ -42,6 +43,8 @@ from corrosion_tpu.runtime.channels import bounded
 from corrosion_tpu.runtime.config import Config
 from corrosion_tpu.runtime.metrics import METRICS
 from corrosion_tpu.runtime.tripwire import TaskTracker, Tripwire
+
+log = logging.getLogger(__name__)
 from corrosion_tpu.store.bookkeeping import Bookie
 from corrosion_tpu.store.crdt import CrdtStore
 from corrosion_tpu.types.actor import Actor, ClusterId
@@ -176,8 +179,16 @@ async def run(agent: Agent) -> None:
     t.spawn(broadcast_loop(agent))
     t.spawn(sync_loop(agent))
     t.spawn(_watchdog(agent))
-    if agent.config.gossip.bootstrap:
-        t.spawn(_announcer(agent))
+    # member-state persistence + restart resurrection
+    # (broadcast/mod.rs:814-949, util.rs:74-179)
+    from corrosion_tpu.agent.member_store import (
+        member_states_loop,
+        resurrect_and_schedule_rejoin,
+    )
+
+    t.spawn(member_states_loop(agent))
+    t.spawn(resurrect_and_schedule_rejoin(agent))
+    t.spawn(_announcer(agent))
     # schedule fully-buffered applies for partials already complete on disk
     for actor_id, booked in agent.bookie.items().items():
         with booked.read() as bv:
@@ -196,12 +207,30 @@ async def _watchdog(agent: Agent) -> None:
 
 
 async def _announcer(agent: Agent) -> None:
-    """Announce to bootstrap addresses with backoff 5 s → 120 s, then a
-    steady 300 s re-announce (handlers.rs:197-248)."""
+    """Announce to resolved bootstrap addresses with backoff 5 s → 120 s,
+    then a steady 300 s re-announce (handlers.rs:197-248). Bootstrap
+    entries support `host:port[@dns_server]` (bootstrap.rs:60-156); an
+    empty bootstrap list falls back to up to 5 random persisted members
+    (bootstrap.rs:29-50)."""
+    from corrosion_tpu.agent.member_store import stored_bootstrap_addrs
+    from corrosion_tpu.net.dns import resolve_bootstrap
+
     cfg = agent.membership.config
     delay = cfg.announce_backoff_start
     while not agent.tripwire.tripped:
-        for addr in agent.config.gossip.bootstrap:
+        if agent.config.gossip.bootstrap:
+            addrs = await resolve_bootstrap(agent.config.gossip.bootstrap)
+            if not addrs:
+                log.warning(
+                    "bootstrap list %r resolved to no addresses",
+                    agent.config.gossip.bootstrap,
+                )
+        else:
+            # no list configured: fall back to persisted members
+            addrs = await asyncio.to_thread(
+                stored_bootstrap_addrs, agent.store
+            )
+        for addr in addrs:
             if addr != agent.actor.addr:
                 await agent.membership.announce(addr)
         if len(agent.members) > 0:

@@ -461,18 +461,28 @@ class CrdtStore:
     def apply_changes(self, changes: Sequence[Change]) -> AppliedChanges:
         """Apply remote CRDT changes inside one transaction; returns the
         impactful subset (counterpart of `process_complete_version`,
-        util.rs:1206-1310, with crsql's merge rules)."""
+        util.rs:1206-1310, with crsql's merge rules).
+
+        Batched (round-2 redesign of the ingestion hot path): local clock/
+        row state for every pk in the batch is bulk-read up front, the
+        merge decisions run as pure in-memory passes over that snapshot
+        (no SQL per change), and the *final* state is flushed with a
+        handful of executemany statements. Semantics are pinned to the
+        per-row reference implementation `_apply_one` by
+        `tests/test_crdt_batch.py` (randomized equivalence)."""
         impactful: List[Change] = []
         changed_tables: Dict[str, int] = {}
         with self._lock:
             self._conn.execute("BEGIN IMMEDIATE")
             self._conn.execute("UPDATE __crdt_ctx SET capture = 0 WHERE id = 1")
             try:
+                impactful = self._apply_batch(changes, changed_tables)
+                site_max: Dict[bytes, int] = {}
                 for ch in changes:
-                    if self._apply_one(ch):
-                        impactful.append(ch)
-                        changed_tables[ch.table] = changed_tables.get(ch.table, 0) + 1
-                    self._bump_db_version(ActorId(ch.site_id), ch.db_version)
+                    if ch.db_version > site_max.get(ch.site_id, 0):
+                        site_max[ch.site_id] = ch.db_version
+                for site, version in site_max.items():
+                    self._bump_db_version(ActorId(site), version)
                 self._conn.execute("UPDATE __crdt_ctx SET capture = 1 WHERE id = 1")
                 self._conn.execute("COMMIT")
             except BaseException:
@@ -480,6 +490,226 @@ class CrdtStore:
                 self._conn.execute("UPDATE __crdt_ctx SET capture = 1 WHERE id = 1")
                 raise
         return AppliedChanges(impactful, changed_tables)
+
+    def _apply_batch(
+        self, changes: Sequence[Change], changed_tables: Dict[str, int]
+    ) -> List[Change]:
+        """In-memory merge of a whole batch + bulk flush. Caller holds the
+        lock and an open transaction."""
+        conn = self._conn
+
+        # -- phase A: bulk-read local state for every (table, pk) ----------
+        by_table: Dict[str, List[Change]] = {}
+        for ch in changes:
+            t = self.schema.tables.get(ch.table)
+            if t is None:
+                continue  # unknown table: drop silently (schema lag)
+            if ch.cid != SENTINEL and ch.cid not in t.columns:
+                continue
+            by_table.setdefault(ch.table, []).append(ch)
+
+        # per table: pk -> {"cl": int, "clock": {cid: col_version}}
+        local: Dict[str, Dict[bytes, dict]] = {}
+        for tbl, chs in by_table.items():
+            rt, ct = _rows_table(tbl), _clock_table(tbl)
+            pks = list({ch.pk for ch in chs})
+            st: Dict[bytes, dict] = {
+                pk: {"cl": 0, "clock": {}, "vals": {}} for pk in pks
+            }
+            for i in range(0, len(pks), 500):
+                chunk = pks[i : i + 500]
+                marks = ",".join("?" * len(chunk))
+                for r in conn.execute(
+                    f'SELECT pk, cl FROM "{rt}" WHERE pk IN ({marks})', chunk
+                ):
+                    st[bytes(r["pk"])]["cl"] = r["cl"]
+                for r in conn.execute(
+                    f'SELECT pk, cid, col_version FROM "{ct}"'
+                    f" WHERE pk IN ({marks})",
+                    chunk,
+                ):
+                    st[bytes(r["pk"])]["clock"][r["cid"]] = r["col_version"]
+            local[tbl] = st
+
+        # -- phase B: sequential in-memory merge decisions -----------------
+        # mutation plans per table (final-state, flushed once at the end)
+        row_cl: Dict[str, Dict[bytes, int]] = {}  # rows-table upserts
+        cleared: Dict[str, set] = {}  # pks whose non-sentinel clocks drop
+        clock_final: Dict[str, Dict[Tuple[bytes, str], tuple]] = {}
+        cell_final: Dict[str, Dict[Tuple[bytes, str], SqliteValue]] = {}
+        row_delete: Dict[str, set] = {}
+        row_ensure: Dict[str, set] = {}
+        impactful: List[Change] = []
+
+        def clock_entry(ch: Change, col_version: int) -> tuple:
+            return (
+                col_version,
+                ch.db_version,
+                ch.seq,
+                ch.site_id,
+                ch.ts.ntp64,
+            )
+
+        for tbl in by_table:
+            row_cl[tbl] = {}
+            cleared[tbl] = set()
+            clock_final[tbl] = {}
+            cell_final[tbl] = {}
+            row_delete[tbl] = set()
+            row_ensure[tbl] = set()
+
+        # ordered over the whole batch so `impactful` keeps arrival order
+        # and same-cell conflicts resolve exactly like the per-row path
+        for ch in changes:
+            tbl = ch.table
+            if tbl not in by_table:
+                continue
+            t = self.schema.tables[tbl]
+            if ch.cid != SENTINEL and ch.cid not in t.columns:
+                continue
+            s = local[tbl][ch.pk]
+            rcl = row_cl[tbl]
+            clr = cleared[tbl]
+            ckf = clock_final[tbl]
+            clf = cell_final[tbl]
+            rdel = row_delete[tbl]
+            rens = row_ensure[tbl]
+
+            local_cl = s["cl"]
+            if ch.cl < local_cl:
+                continue
+            win = False
+            if ch.cl > local_cl:
+                s["cl"] = ch.cl
+                rcl[ch.pk] = ch.cl
+                # clock rows reset on every causal transition; data cells
+                # only reset when the transition is a delete (even cl) —
+                # an odd re-create keeps surviving cell values
+                s["clock"] = {}
+                clr.add(ch.pk)
+                for key in [k for k in ckf if k[0] == ch.pk]:
+                    del ckf[key]
+                ckf[(ch.pk, SENTINEL)] = clock_entry(ch, ch.cl)
+                s["clock"][SENTINEL] = ch.cl
+                if ch.cl % 2 == 0:
+                    # delete wins: the data row must go (flush deletes run
+                    # before ensures, so a later re-create in this same
+                    # batch still starts from a fresh row)
+                    s["vals"] = {}
+                    for key in [k for k in clf if k[0] == ch.pk]:
+                        del clf[key]
+                    rdel.add(ch.pk)
+                    rens.discard(ch.pk)
+                    win = True
+                else:
+                    rens.add(ch.pk)
+                    if ch.cid != SENTINEL:
+                        clf[(ch.pk, ch.cid)] = ch.val
+                        s["vals"][ch.cid] = ch.val
+                        ckf[(ch.pk, ch.cid)] = clock_entry(
+                            ch, ch.col_version
+                        )
+                        s["clock"][ch.cid] = ch.col_version
+                    win = True
+            else:
+                # equal causal length
+                if local_cl % 2 == 0 or ch.cid == SENTINEL:
+                    continue
+                local_cv = s["clock"].get(ch.cid, 0)
+                if ch.col_version < local_cv:
+                    continue
+                if ch.col_version == local_cv and ch.cid in s["clock"]:
+                    # a clock entry for this cid can only exist here if no
+                    # causal transition happened in-batch (transitions
+                    # reset s["clock"]), so the on-disk value is current
+                    # unless an earlier equal-cl win cached it in s["vals"]
+                    if ch.cid in s["vals"]:
+                        cur = s["vals"][ch.cid]
+                    else:
+                        cur = self._current_value(conn, t, ch.pk, ch.cid)
+                    if cmp_values(ch.val, cur) <= 0:
+                        continue
+                rens.add(ch.pk)
+                clf[(ch.pk, ch.cid)] = ch.val
+                s["vals"][ch.cid] = ch.val
+                ckf[(ch.pk, ch.cid)] = clock_entry(ch, ch.col_version)
+                s["clock"][ch.cid] = ch.col_version
+                win = True
+            if win:
+                impactful.append(ch)
+                changed_tables[tbl] = changed_tables.get(tbl, 0) + 1
+
+        # -- phase C: bulk flush of final state ----------------------------
+        unpack_cache: Dict[bytes, tuple] = {}
+
+        def unpacked(pk: bytes) -> tuple:
+            got = unpack_cache.get(pk)
+            if got is None:
+                got = unpack_cache[pk] = tuple(unpack_columns(pk))
+            return got
+
+        for tbl in by_table:
+            t = self.schema.tables[tbl]
+            rt, ct = _rows_table(tbl), _clock_table(tbl)
+            if row_cl[tbl]:
+                conn.executemany(
+                    f'INSERT INTO "{rt}" (pk, cl) VALUES (?, ?)'
+                    " ON CONFLICT (pk) DO UPDATE SET cl = excluded.cl",
+                    list(row_cl[tbl].items()),
+                )
+            if cleared[tbl]:
+                conn.executemany(
+                    f'DELETE FROM "{ct}" WHERE pk = ? AND cid != ?',
+                    [(pk, SENTINEL) for pk in cleared[tbl]],
+                )
+            if row_delete[tbl]:
+                where = " AND ".join(f'"{c}" IS ?' for c in t.pk_cols)
+                conn.executemany(
+                    f'DELETE FROM "{t.name}" WHERE {where}',
+                    [unpacked(pk) for pk in row_delete[tbl]],
+                )
+            if row_ensure[tbl]:
+                cols = ", ".join(f'"{c}"' for c in t.pk_cols)
+                marks = ", ".join("?" for _ in t.pk_cols)
+                conn.executemany(
+                    f'INSERT OR IGNORE INTO "{t.name}" ({cols})'
+                    f" VALUES ({marks})",
+                    [unpacked(pk) for pk in row_ensure[tbl]],
+                )
+            if cell_final[tbl]:
+                # group cell writes by column: one executemany per cid
+                where = " AND ".join(f'"{c}" IS ?' for c in t.pk_cols)
+                by_cid: Dict[str, List[tuple]] = {}
+                for (pk, cid), val in cell_final[tbl].items():
+                    by_cid.setdefault(cid, []).append(
+                        (val, *unpacked(pk))
+                    )
+                for cid, rows in by_cid.items():
+                    conn.executemany(
+                        f'UPDATE "{t.name}" SET "{cid}" = ? WHERE {where}',
+                        rows,
+                    )
+            if clock_final[tbl]:
+                conn.executemany(
+                    f'INSERT INTO "{ct}" (pk, cid, col_version, db_version,'
+                    " seq, site_id, ts) VALUES (?,?,?,?,?,?,?)"
+                    " ON CONFLICT (pk, cid) DO UPDATE SET"
+                    " col_version = excluded.col_version,"
+                    " db_version = excluded.db_version,"
+                    " seq = excluded.seq, site_id = excluded.site_id,"
+                    " ts = excluded.ts",
+                    [
+                        (pk, cid, cv, dbv, seq, site, ts)
+                        for (pk, cid), (
+                            cv,
+                            dbv,
+                            seq,
+                            site,
+                            ts,
+                        ) in clock_final[tbl].items()
+                    ],
+                )
+        return impactful
 
     def _apply_one(self, ch: Change) -> bool:
         t = self.schema.tables.get(ch.table)
@@ -752,6 +982,59 @@ class CrdtStore:
                 [(actor_id.bytes16, s, e) for s, e in needed],
             )
             self._conn.commit()
+
+    # -- member-state persistence (__corro_members) ------------------------
+
+    def update_member_rows(
+        self,
+        upserts: Sequence[Tuple[bytes, str, str, Optional[float], int]],
+        deletes: Sequence[bytes],
+    ) -> None:
+        """Apply one member-state diff: rows are (actor_id, address,
+        foca_state_json, rtt_min, updated_at) (broadcast/mod.rs:814-949)."""
+        with self._lock:
+            self._conn.execute("BEGIN IMMEDIATE")
+            try:
+                if upserts:
+                    self._conn.executemany(
+                        "INSERT INTO __corro_members (actor_id, address,"
+                        " foca_state, rtt_min, updated_at)"
+                        " VALUES (?,?,?,?,?)"
+                        " ON CONFLICT (actor_id) DO UPDATE SET"
+                        " address = excluded.address,"
+                        " foca_state = excluded.foca_state,"
+                        " rtt_min = coalesce(excluded.rtt_min, rtt_min),"
+                        " updated_at = excluded.updated_at",
+                        list(upserts),
+                    )
+                if deletes:
+                    self._conn.executemany(
+                        "DELETE FROM __corro_members WHERE actor_id = ?",
+                        [(d,) for d in deletes],
+                    )
+                self._conn.execute("COMMIT")
+            except BaseException:
+                self._conn.execute("ROLLBACK")
+                raise
+
+    def member_state_rows(self) -> List[str]:
+        """Persisted foca_state JSON blobs (util.rs:74-111 load)."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT foca_state FROM __corro_members"
+                " WHERE foca_state IS NOT NULL"
+            ).fetchall()
+        return [r["foca_state"] for r in rows]
+
+    def random_member_addresses(self, count: int) -> List[str]:
+        """Random persisted member addresses (bootstrap.rs:29-50)."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT address FROM __corro_members"
+                " ORDER BY RANDOM() LIMIT ?",
+                (count,),
+            ).fetchall()
+        return [r["address"] for r in rows]
 
     def booked_actor_ids(self) -> List[ActorId]:
         """All sites we have any state for (bookie warm-up,

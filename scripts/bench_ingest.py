@@ -1,0 +1,91 @@
+"""Measure CRDT ingestion throughput (changes/s): batched vs per-row.
+
+The reference logs changes/s per sync round (`agent/handlers.rs:884-895`);
+this bench produces the comparable number for our store's remote-apply
+path, before/after the round-2 batching of `apply_changes`.
+
+Usage: python scripts/bench_ingest.py [n_changes] [batch_size]
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from corrosion_tpu.store.crdt import CrdtStore  # noqa: E402
+from corrosion_tpu.types.actor import ActorId  # noqa: E402
+from corrosion_tpu.types.base import Timestamp  # noqa: E402
+from corrosion_tpu.types.change import Change  # noqa: E402
+from corrosion_tpu.types.pack import pack_columns  # noqa: E402
+
+SCHEMA = (
+    "CREATE TABLE kv (id INTEGER NOT NULL PRIMARY KEY,"
+    " a TEXT NOT NULL DEFAULT '', b INTEGER NOT NULL DEFAULT 0,"
+    " c TEXT NOT NULL DEFAULT '');"
+)
+
+
+def gen(n: int, n_pks: int, seed=0) -> list:
+    rng = random.Random(seed)
+    site = ActorId(bytes([1]) * 16).bytes16
+    ts = Timestamp.from_unix(1)
+    out = []
+    for i in range(n):
+        pk = pack_columns([rng.randint(1, n_pks)])
+        cid = rng.choice(["a", "b", "c"])
+        val = rng.randint(0, 10**6) if cid == "b" else f"v{i}"
+        out.append(
+            Change(
+                table="kv", pk=pk, cid=cid, val=val,
+                col_version=i // n_pks + 1, db_version=i + 1, seq=0,
+                site_id=site, cl=1, ts=ts,
+            )
+        )
+    return out
+
+
+def run(mode: str, changes, batch: int, tmp: str) -> float:
+    path = os.path.join(tmp, f"bench-{mode}.db")
+    if os.path.exists(path):
+        os.unlink(path)
+    st = CrdtStore(path)
+    st.apply_schema_sql(SCHEMA)
+    t0 = time.monotonic()
+    if mode == "batched":
+        for i in range(0, len(changes), batch):
+            st.apply_changes(changes[i : i + batch])
+    else:
+        from tests.test_crdt_batch import apply_reference
+
+        for i in range(0, len(changes), batch):
+            apply_reference(st, changes[i : i + batch])
+    dt = time.monotonic() - t0
+    st.close()
+    return len(changes) / dt
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 500
+    sys.path.insert(0, REPO)
+    import tempfile
+
+    changes = gen(n, n_pks=max(100, n // 50))
+    with tempfile.TemporaryDirectory() as tmp:
+        per_row = run("per_row", changes, batch, tmp)
+        batched = run("batched", changes, batch, tmp)
+    print(
+        f"ingest throughput n={n} batch={batch}: "
+        f"per_row={per_row:,.0f} changes/s  "
+        f"batched={batched:,.0f} changes/s  "
+        f"speedup={batched / per_row:.2f}x"
+    )
+
+
+if __name__ == "__main__":
+    main()

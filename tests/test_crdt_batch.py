@@ -1,0 +1,216 @@
+"""Randomized equivalence: batched apply_changes vs per-row _apply_one.
+
+`CrdtStore.apply_changes` (round-2 batched ingestion path) must produce a
+database state and impactful-set identical to the per-row reference
+implementation `_apply_one` (the direct transliteration of cr-sqlite's
+merge rules, `klukai-agent/src/agent/util.rs:1206-1310`) for ANY change
+sequence — including stale causal lengths, delete/re-create chains within
+one batch, equal-(cl, col_version) value races, and unknown tables/columns.
+"""
+
+import random
+
+from corrosion_tpu.store.crdt import CrdtStore
+from corrosion_tpu.types.actor import ActorId
+from corrosion_tpu.types.base import Timestamp
+from corrosion_tpu.types.change import SENTINEL, Change
+from corrosion_tpu.types.pack import pack_columns
+
+SCHEMA = (
+    "CREATE TABLE kv (id INTEGER NOT NULL PRIMARY KEY,"
+    " a TEXT NOT NULL DEFAULT '', b INTEGER NOT NULL DEFAULT 0);"
+    "CREATE TABLE other (k TEXT NOT NULL PRIMARY KEY,"
+    " v TEXT NOT NULL DEFAULT '');"
+)
+
+SITES = [ActorId(bytes([i]) * 16) for i in (1, 2, 3)]
+
+
+def mk_store() -> CrdtStore:
+    st = CrdtStore(":memory:", site_id=ActorId(bytes([9]) * 16))
+    st.apply_schema_sql(SCHEMA)
+    return st
+
+
+def random_changes(rng: random.Random, count: int) -> list:
+    changes = []
+    versions = {s.bytes16: 0 for s in SITES}
+    for _ in range(count):
+        site = rng.choice(SITES)
+        tbl, cid_pool, pk = rng.choices(
+            [
+                ("kv", ["a", "b"], pack_columns([rng.randint(1, 6)])),
+                ("other", ["v"], pack_columns([f"k{rng.randint(1, 4)}"])),
+                # unknown table / unknown column: must be dropped by both
+                ("nope", ["x"], pack_columns([1])),
+                ("kv", ["zz"], pack_columns([1])),
+            ],
+            weights=[10, 6, 1, 1],
+        )[0]
+        cl = rng.choice([1, 1, 1, 2, 3, 3, 4, 5])
+        if cl % 2 == 0 or rng.random() < 0.1:
+            cid, val = SENTINEL, None
+        else:
+            cid = rng.choice(cid_pool)
+            val = (
+                rng.randint(0, 5)
+                if cid == "b"
+                else rng.choice(["x", "y", "zz", ""])
+            )
+        versions[site.bytes16] += rng.choice([0, 1, 1])
+        changes.append(
+            Change(
+                table=tbl,
+                pk=pk,
+                cid=cid,
+                val=val,
+                col_version=rng.randint(1, 4),
+                db_version=max(1, versions[site.bytes16]),
+                seq=rng.randint(0, 3),
+                site_id=site.bytes16,
+                cl=cl,
+                ts=Timestamp.from_unix(rng.randint(1, 100)),
+            )
+        )
+    return changes
+
+
+def apply_reference(store: CrdtStore, changes) -> list:
+    """The pre-batching per-row application loop (old apply_changes)."""
+    impactful = []
+    with store._lock:
+        store._conn.execute("BEGIN IMMEDIATE")
+        store._conn.execute("UPDATE __crdt_ctx SET capture = 0 WHERE id = 1")
+        try:
+            for ch in changes:
+                if store._apply_one(ch):
+                    impactful.append(ch)
+                store._bump_db_version(ActorId(ch.site_id), ch.db_version)
+            store._conn.execute(
+                "UPDATE __crdt_ctx SET capture = 1 WHERE id = 1"
+            )
+            store._conn.execute("COMMIT")
+        except BaseException:
+            store._conn.execute("ROLLBACK")
+            raise
+    return impactful
+
+
+def dump_state(store: CrdtStore) -> dict:
+    out = {}
+    for tbl in ("kv", "other"):
+        out[tbl] = store._conn.execute(
+            f'SELECT * FROM "{tbl}" ORDER BY 1'
+        ).fetchall()
+        out[tbl] = [tuple(r) for r in out[tbl]]
+        for suffix in ("__crdt_rows", "__crdt_clock"):
+            rows = store._conn.execute(
+                f'SELECT * FROM "{tbl}{suffix}" ORDER BY pk'
+                + (", cid" if suffix == "__crdt_clock" else "")
+            ).fetchall()
+            out[tbl + suffix] = [tuple(r) for r in rows]
+    out["versions"] = [
+        tuple(r)
+        for r in store._conn.execute(
+            "SELECT site_id, db_version FROM __crdt_db_versions"
+            " ORDER BY site_id"
+        )
+    ]
+    return out
+
+
+def test_batched_matches_reference_randomized():
+    for seed in range(12):
+        rng = random.Random(seed)
+        changes = random_changes(rng, 120)
+        a, b = mk_store(), mk_store()
+        got = a.apply_changes(changes).impactful
+        want = apply_reference(b, changes)
+        assert [c for c in got] == [c for c in want], f"seed {seed}"
+        assert dump_state(a) == dump_state(b), f"seed {seed}"
+        a.close()
+        b.close()
+
+
+def test_batched_split_batches_equal_one_batch():
+    """Applying the same sequence as many small batches or one big batch
+    must land in the same state (the ingestion queue batches arbitrarily)."""
+    rng = random.Random(99)
+    changes = random_changes(rng, 150)
+    a, b = mk_store(), mk_store()
+    a.apply_changes(changes)
+    for i in range(0, len(changes), 7):
+        b.apply_changes(changes[i : i + 7])
+    assert dump_state(a) == dump_state(b)
+    a.close()
+    b.close()
+
+
+def test_equal_cv_race_after_recreate_compares_against_default():
+    """delete + recreate + equal-(cl,col_version) value write in ONE
+    batch: the value comparison must see the recreated row's column
+    DEFAULT (what the per-row path reads), not the pre-delete value."""
+    site_a, site_b = SITES[0].bytes16, SITES[1].bytes16
+    pk = pack_columns([2])
+    ts = Timestamp.from_unix(1)
+
+    def seq(store, fn):
+        seed = [
+            Change(table="kv", pk=pk, cid="b", val=9, col_version=1,
+                   db_version=1, seq=0, site_id=site_a, cl=1, ts=ts),
+        ]
+        store.apply_changes(seed) if fn is None else fn(store, seed)
+        batch = [
+            Change(table="kv", pk=pk, cid=SENTINEL, val=None, col_version=1,
+                   db_version=2, seq=0, site_id=site_a, cl=2, ts=ts),
+            Change(table="kv", pk=pk, cid="b", val=0, col_version=1,
+                   db_version=3, seq=0, site_id=site_b, cl=3, ts=ts),
+            # equal cl + equal col_version as the recreate's write: value
+            # race against the recreated cell (b == 0, the default)
+            Change(table="kv", pk=pk, cid="b", val=0, col_version=1,
+                   db_version=2, seq=1, site_id=site_a, cl=3, ts=ts),
+        ]
+        return batch
+
+    a, b = mk_store(), mk_store()
+    batch = seq(a, None)
+    a.apply_changes(batch)
+    batch = seq(b, None)
+    apply_reference(b, batch)
+    assert dump_state(a) == dump_state(b)
+    a.close()
+    b.close()
+
+
+def test_delete_then_recreate_in_one_batch_resets_cells():
+    """A delete (even cl) followed by a re-create (odd cl) in the SAME
+    batch must not leak pre-delete cell values into the recreated row."""
+    site = SITES[0].bytes16
+    pk = pack_columns([1])
+    ts = Timestamp.from_unix(1)
+    seed_val = Change(
+        table="kv", pk=pk, cid="a", val="old", col_version=1,
+        db_version=1, seq=0, site_id=site, cl=1, ts=ts,
+    )
+    st = mk_store()
+    st.apply_changes([seed_val])
+    row = st._conn.execute("SELECT a FROM kv WHERE id = 1").fetchone()
+    assert row["a"] == "old"
+
+    batch = [
+        Change(table="kv", pk=pk, cid=SENTINEL, val=None, col_version=1,
+               db_version=2, seq=0, site_id=site, cl=2, ts=ts),
+        Change(table="kv", pk=pk, cid=SENTINEL, val=None, col_version=1,
+               db_version=3, seq=0, site_id=site, cl=3, ts=ts),
+    ]
+    # reference store for the same two changes
+    ref = mk_store()
+    ref.apply_changes([seed_val])
+    apply_reference(ref, batch)
+    st.apply_changes(batch)
+    assert dump_state(st) == dump_state(ref)
+    # and the recreated row has default cells, not 'old'
+    row = st._conn.execute("SELECT a FROM kv WHERE id = 1").fetchone()
+    assert row["a"] == ""
+    st.close()
+    ref.close()

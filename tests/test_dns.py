@@ -1,0 +1,113 @@
+"""Bootstrap resolution incl. the `host:port@dns_server` syntax
+(bootstrap.rs:60-156), with a local canned-response DNS server."""
+
+import asyncio
+import socket
+import struct
+
+from corrosion_tpu.net.dns import (
+    QTYPE_A,
+    QTYPE_AAAA,
+    decode_answers,
+    encode_query,
+    query_server,
+    resolve_bootstrap,
+    resolve_entry,
+    split_bootstrap,
+)
+
+
+def canned_response(query: bytes, ips) -> bytes:
+    """Answer the single question in `query` with A/AAAA records."""
+    qid = struct.unpack(">H", query[:2])[0]
+    # copy the question section verbatim
+    off = 12
+    while query[off] != 0:
+        off += 1 + query[off]
+    question = query[12 : off + 5]
+    qtype = struct.unpack(">H", query[off + 1 : off + 3])[0]
+    answers = b""
+    count = 0
+    for ip in ips:
+        if ":" in ip and qtype == QTYPE_AAAA:
+            rdata = socket.inet_pton(socket.AF_INET6, ip)
+        elif ":" not in ip and qtype == QTYPE_A:
+            rdata = socket.inet_pton(socket.AF_INET, ip)
+        else:
+            continue
+        answers += (
+            b"\xc0\x0c"  # pointer to qname
+            + struct.pack(">HHIH", qtype, 1, 60, len(rdata))
+            + rdata
+        )
+        count += 1
+    return (
+        struct.pack(">HHHHHH", qid, 0x8180, 1, count, 0, 0)
+        + question
+        + answers
+    )
+
+
+class CannedDns(asyncio.DatagramProtocol):
+    def __init__(self, ips):
+        self.ips = ips
+
+    def connection_made(self, transport):
+        self.transport = transport
+
+    def datagram_received(self, data, addr):
+        self.transport.sendto(canned_response(data, self.ips), addr)
+
+
+async def start_dns(ips):
+    loop = asyncio.get_event_loop()
+    transport, _ = await loop.create_datagram_endpoint(
+        lambda: CannedDns(ips), local_addr=("127.0.0.1", 0)
+    )
+    return transport, transport.get_extra_info("sockname")[1]
+
+
+def test_split_bootstrap():
+    assert split_bootstrap("h:1@9.9.9.9:53") == ("h:1", "9.9.9.9:53")
+    assert split_bootstrap("h:1") == ("h:1", None)
+
+
+def test_codec_roundtrip_via_canned_server():
+    q = encode_query(7, "example.test", QTYPE_A)
+    resp = canned_response(q, ["10.1.2.3", "10.4.5.6"])
+    assert decode_answers(resp, 7, QTYPE_A) == ["10.1.2.3", "10.4.5.6"]
+
+
+def test_query_server_and_custom_resolver_syntax():
+    async def main():
+        transport, port = await start_dns(["10.9.9.1", "fd00::1"])
+        try:
+            ips = await query_server("127.0.0.1", port, "db.test", QTYPE_A)
+            assert ips == ["10.9.9.1"]
+            ips6 = await query_server(
+                "127.0.0.1", port, "db.test", QTYPE_AAAA
+            )
+            assert ips6 == ["fd00::1"]
+            # full entry resolution through the custom server
+            got = await resolve_entry(f"db.test:7000@127.0.0.1:{port}")
+            assert got == ["10.9.9.1:7000", "[fd00::1]:7000"]
+        finally:
+            transport.close()
+
+    asyncio.run(main())
+
+
+def test_resolve_passthrough_forms():
+    async def main():
+        # plain ip:port untouched
+        assert await resolve_entry("10.0.0.1:7000") == ["10.0.0.1:7000"]
+        # opaque labels (in-memory transport) untouched
+        assert await resolve_entry("node1") == ["node1"]
+        # system-resolver path on a guaranteed name
+        got = await resolve_entry("localhost:7000")
+        assert "127.0.0.1:7000" in got or "[::1]:7000" in got
+        # aggregate keeps order + skips failures
+        got = await resolve_bootstrap(["10.0.0.1:7000", "node2"])
+        assert got == ["10.0.0.1:7000", "node2"]
+
+    asyncio.run(main())

@@ -1,0 +1,130 @@
+"""Member-state persistence, resurrection and bootstrap fallback
+(broadcast/mod.rs:814-949, util.rs:74-179, bootstrap.rs:29-50)."""
+
+import asyncio
+import random
+from collections import deque
+from types import SimpleNamespace
+
+from corrosion_tpu.agent.member_store import (
+    _state_from_json,
+    _state_json,
+    diff_member_states,
+    load_member_states,
+    snapshot_membership,
+    stored_bootstrap_addrs,
+)
+from corrosion_tpu.agent.members import Members
+from corrosion_tpu.agent.membership import Membership, SwimConfig
+from corrosion_tpu.net.gossip_codec import MemberState
+from corrosion_tpu.net.mem import MemNetwork
+from corrosion_tpu.store.crdt import CrdtStore
+from corrosion_tpu.types.actor import Actor, ActorId
+from corrosion_tpu.types.base import Timestamp
+
+
+def mk_actor(i: int) -> Actor:
+    return Actor(
+        id=ActorId(bytes([i]) * 16),
+        addr=f"10.0.0.{i}:7000",
+        ts=Timestamp.from_unix(i),
+    )
+
+
+def mk_agent():
+    net = MemNetwork()
+    me = mk_actor(1)
+    ms = Membership(me, net.transport(me.addr), SwimConfig(), random.Random(1))
+    store = CrdtStore(":memory:")
+    return SimpleNamespace(
+        membership=ms,
+        members=Members(),
+        store=store,
+        actor_id=me.id,
+        cluster_id=me.cluster_id,
+    )
+
+
+def test_state_json_roundtrip():
+    actor = mk_actor(3)
+    text = _state_json(actor, 7, MemberState.SUSPECT)
+    got = _state_from_json(text)
+    assert got == (actor, 7, MemberState.SUSPECT)
+    assert _state_from_json("{bad json") is None
+    assert _state_from_json('{"id": "nope"}') is None
+
+
+def test_diff_persists_upserts_and_deletes():
+    agent = mk_agent()
+    a2, a3 = mk_actor(2), mk_actor(3)
+    agent.membership.apply_many(
+        [(a2, 0, MemberState.ALIVE), (a3, 2, MemberState.SUSPECT)]
+    )
+    agent.members.rtts["10.0.0.2:7000"] = deque([4.2, 9.9])
+
+    snap = diff_member_states(agent, {})
+    rows = agent.store._conn.execute(
+        "SELECT actor_id, address, foca_state, rtt_min FROM __corro_members"
+        " ORDER BY address"
+    ).fetchall()
+    assert len(rows) == 2
+    assert rows[0]["address"] == "10.0.0.2:7000"
+    assert rows[0]["rtt_min"] == 4.2
+    assert _state_from_json(rows[1]["foca_state"])[1] == 2  # incarnation
+
+    # unchanged second pass: no-op, same snapshot
+    snap2 = diff_member_states(agent, snap)
+    assert snap2 == snap
+
+    # member 3 goes down -> excluded from snapshot -> row deleted
+    agent.membership.apply_many([(a3, 3, MemberState.DOWN)])
+    diff_member_states(agent, snap2)
+    rows = agent.store._conn.execute(
+        "SELECT address FROM __corro_members"
+    ).fetchall()
+    assert [r["address"] for r in rows] == ["10.0.0.2:7000"]
+
+
+def test_load_and_bootstrap_fallback():
+    agent = mk_agent()
+    actors = [mk_actor(i) for i in (2, 3, 4)]
+    agent.membership.apply_many([(a, 1, MemberState.ALIVE) for a in actors])
+    diff_member_states(agent, {})
+
+    loaded = load_member_states(agent.store)
+    assert sorted(a.addr for a, _, _ in loaded) == [
+        "10.0.0.2:7000",
+        "10.0.0.3:7000",
+        "10.0.0.4:7000",
+    ]
+    assert all(inc == 1 and st == MemberState.ALIVE for _, inc, st in loaded)
+
+    addrs = stored_bootstrap_addrs(agent.store, count=2)
+    assert len(addrs) == 2
+    assert set(addrs) <= {a.addr for a in actors}
+
+
+def test_restart_resurrects_membership():
+    """A restarted node (same db) re-applies persisted members before any
+    gossip arrives — it remembers the cluster (util.rs:74-111)."""
+    agent = mk_agent()
+    actors = [mk_actor(i) for i in (2, 3)]
+    agent.membership.apply_many([(a, 0, MemberState.ALIVE) for a in actors])
+    diff_member_states(agent, {})
+    assert agent.membership.cluster_size == 3
+
+    # "restart": fresh membership, same store
+    agent2 = mk_agent()
+    agent2.store = agent.store
+    assert agent2.membership.cluster_size == 1
+    states = load_member_states(agent2.store)
+    agent2.membership.apply_many(
+        [
+            s
+            for s in states
+            if s[0].id != agent2.actor_id
+            and s[0].cluster_id == agent2.cluster_id
+        ]
+    )
+    assert agent2.membership.cluster_size == 3
+    assert snapshot_membership(agent2) == snapshot_membership(agent)
