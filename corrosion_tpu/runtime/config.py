@@ -30,14 +30,39 @@ class ApiConfig:
 
 
 @dataclass
+class GossipTlsConfig:
+    """[gossip.tls] — mirrors the reference's rustls config
+    (`api/peer/mod.rs:152-373`): server cert/key, CA pinning for peer
+    verification, optional mTLS client-cert requirement, and an insecure
+    mode that skips server verification (SkipServerVerification)."""
+
+    cert_file: Optional[str] = None
+    key_file: Optional[str] = None
+    ca_file: Optional[str] = None  # verify peers against this CA
+    insecure: bool = False  # client side: skip server verification
+    # mTLS: server requires + verifies client certs against ca_file
+    mtls: bool = False
+    client_cert_file: Optional[str] = None
+    client_key_file: Optional[str] = None
+
+
+@dataclass
 class GossipConfig:
     bind_addr: str = "0.0.0.0:8787"
     external_addr: Optional[str] = None
     bootstrap: List[str] = field(default_factory=list)
     cluster_id: int = 0
-    plaintext: bool = True  # no TLS yet; mirrors quinn_plaintext mode
+    # explicit plaintext mode, like the reference's quinn_plaintext crypto
+    # session for trusted networks; set false + a [gossip.tls] section for
+    # a TLS-secured gossip plane
+    plaintext: bool = True
+    tls: GossipTlsConfig = field(default_factory=GossipTlsConfig)
     max_mtu: Optional[int] = None
     idle_timeout_secs: int = 30
+
+    @property
+    def tls_enabled(self) -> bool:
+        return not self.plaintext and self.tls.cert_file is not None
 
 
 @dataclass
