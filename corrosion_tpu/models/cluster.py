@@ -57,7 +57,9 @@ class ClusterSim:
         if ticks == 1:
             self.state = swim.tick(self.state, key, self.params)
         else:
-            self.state = swim.tick_n(self.state, key, self.params, ticks)
+            # donated: the [N, N] view updates in place, halving peak HBM
+            # (ClusterSim owns its state and always replaces the reference)
+            self.state = swim.tick_n_donated(self.state, key, self.params, ticks)
         self.ticks += ticks
 
     def crash(self, member: int) -> None:

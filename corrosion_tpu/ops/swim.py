@@ -61,7 +61,7 @@ class SwimParams(NamedTuple):
     fanout: int = 2  # gossip targets per tick
     piggyback: int = 8  # updates per gossip message
     buffer_slots: int = 16  # per-member update buffer (B)
-    incoming_slots: int = 8  # max buffer inserts per member per tick (R)
+    incoming_slots: int = 16  # per-member gossip inbox capacity per tick (R)
     susp_slots: int = 4  # concurrent suspicion timers per member (S)
     max_transmissions: int = 10  # foca-style re-send decay
     direct_timeout: int = 1  # ticks to wait for a direct ack
@@ -351,7 +351,17 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
         )
         m = m + ae
 
-    # message triples [N, f, m] → flat [M]
+    # message triples [N, f, m] → flat [M], then a bounded per-member
+    # inbox. The r2 profile showed the old path — scatter-maxing all M
+    # messages into the [N, N] view at random (dst, subj) indices, plus an
+    # argsort+searchsorted relay ranking — dominating the tick. Instead,
+    # messages are sorted by destination ONCE (co-sorted lax.sort), ranked
+    # within their destination group by an associative scan, and compacted
+    # into a [N, incoming_slots] inbox; every later step (refutation, view
+    # update, relay) is then row-aligned. Messages beyond the inbox cap
+    # are dropped — bounded mailboxes, matching the reference's drop-oldest
+    # processing queue (broadcast/mod.rs:793-812); anti-entropy tails and
+    # the feed exchange repair any loss.
     msg_ok = (
         sendable[:, None, :]
         & valid_tgt[:, :, None]
@@ -365,37 +375,33 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
     dst = jnp.broadcast_to(jnp.clip(tg, 0, n - 1)[:, :, None], msg_ok.shape)
     subj = jnp.broadcast_to(send_subj[:, None, :], msg_ok.shape)
     key = jnp.broadcast_to(send_key[:, None, :], msg_ok.shape)
-    # masked → deliver key 0 about self: guaranteed no-op
-    dst = jnp.where(msg_ok, dst, idx[:, None, None]).reshape(-1)
-    subj = jnp.where(msg_ok, subj, idx[:, None, None]).reshape(-1)
+    # masked → dst n: sorts past every real destination, never delivered
+    dst = jnp.where(msg_ok, dst, n).reshape(-1)
+    subj = jnp.where(msg_ok, subj, n).reshape(-1)
     key = jnp.where(msg_ok, key, 0).reshape(-1)
 
-    # include own announcements (suspicions/downs) as self-delivered msgs
-    dst = jnp.concatenate([dst, jnp.repeat(idx, own_upd_subj.shape[1])])
-    subj = jnp.concatenate(
-        [subj, jnp.where(own_upd_subj < n, own_upd_subj, idx[:, None]).reshape(-1)]
+    # ---- 4. inbox: sort by destination, rank in group, compact ----------
+    slots = params.incoming_slots
+    dst_s, subj_s, key_s = jax.lax.sort(
+        (dst, subj, key), dimension=0, num_keys=1, is_stable=True
     )
-    key = jnp.concatenate(
-        [key, jnp.where(own_upd_subj < n, own_upd_key, 0).reshape(-1)]
+    mlen = dst_s.shape[0]
+    pos = jnp.arange(mlen, dtype=jnp.int32)
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), dst_s[1:] != dst_s[:-1]]
     )
-
-    # ---- 4. delivery: refutation, scatter-max, buffer relay --------------
-    # refutation: a live member hearing itself suspect/down at ≥ its inc
-    about_self = (subj == dst) & (key_prec(key) >= PREC_SUSPECT)
-    off_inc = jnp.where(about_self, key_inc(key), -1)
-    worst = jnp.full(n, -1, jnp.int32).at[dst].max(off_inc)
-    refute = alive & (worst >= 0) & (worst >= inc)
-    inc = jnp.where(refute, worst + 1, inc)
-    own_upd_subj = own_upd_subj.at[:, 2].set(jnp.where(refute, idx, n))
-    own_upd_key = own_upd_key.at[:, 2].set(
-        jnp.where(refute, make_key(inc, PREC_ALIVE), 0)
-    )
-
-    improved = key > view[dst, subj]
-    view = view.at[dst, subj].max(key)
-    # self-entries stay fresh (and reflect refutations immediately)
-    self_key = make_key(inc, PREC_ALIVE)
-    view = view.at[idx, idx].max(jnp.where(alive, self_key, 0))
+    first = jax.lax.associative_scan(jnp.maximum, jnp.where(is_start, pos, 0))
+    rank = pos - first
+    ok = (dst_s < n) & (rank < slots)
+    # scatter with min/max so masked duplicate (0, 0) writes are no-ops:
+    # each real (row, rank) cell receives at most one message (ranks are
+    # unique per destination), so min(subj)/max(key) both pick that message
+    rows = jnp.where(ok, dst_s, 0)
+    cols = jnp.where(ok, rank, 0)
+    in_subj = jnp.full((n, slots), n, dtype=jnp.int32)
+    in_key = jnp.zeros((n, slots), dtype=jnp.int32)
+    in_subj = in_subj.at[rows, cols].min(jnp.where(ok, subj_s, n))
+    in_key = in_key.at[rows, cols].max(jnp.where(ok, key_s, 0))
 
     # ---- 4b. announce/feed exchange --------------------------------------
     # Each member pulls one packet's worth of member records from a random
@@ -404,8 +410,18 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
     # batched form of foca's Announce→Feed bulk member-list transfer, and
     # it is what bootstraps large clusters (per-update infection alone
     # cannot push 10^4+ simultaneous joins through bounded buffers).
-    fe = params.feed_entries
-    if fe > 0 and params.feeds_per_tick > 0:
+    # The window start is GLOBAL (shared by all members this feed): that
+    # turns the exchange into dynamic_slice + row-take + dynamic_update
+    # _slice — contiguous, layout-friendly ops — instead of the r2
+    # kernel's fully general two-axis gather, which the profile showed at
+    # ~70% of the tick. Members still draw independent random partners, so
+    # per-pair coverage decorrelates across sweeps.
+    fe = min(params.feed_entries, n)
+    nfeeds = params.feeds_per_tick
+    if fe > 0 and nfeeds > 0:
+        steps_per_sweep = -(-n // fe)  # ceil: windows per full subject sweep
+
+        spacing = max(1, steps_per_sweep // nfeeds)
 
         def one_feed(k, v):
             r_feed = jax.random.fold_in(r_gossip, 104729 + k)
@@ -413,44 +429,64 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
             psafe = jnp.clip(partner, 0, n - 1)
             # both ends of the exchange must actually be up
             has_partner = (partner < n) & alive & alive[psafe]
-            # per-member rotating window offset, decorrelated by member
-            # index; gather only the [N, feed_entries] window (not whole
-            # partner rows) so each feed stays O(N·F) at 10^5+ members
-            w = ((t * params.feeds_per_tick + k) * fe + idx * 40503) % n
-            cols = (w[:, None] + jnp.arange(fe, dtype=jnp.int32)[None, :]) % n
-            pkeys = v[psafe[:, None], cols]  # [N, F] partner window
-            pkeys = jnp.where(has_partner[:, None], pkeys, 0)
-            return v.at[idx[:, None], cols].max(pkeys)
+            # the tick's windows are staggered EVENLY across the sweep
+            # (not adjacent): each subject is then fed nfeeds times per
+            # sweep at spaced intervals, letting infection spread between
+            # visits — spaced visits converge much faster than one
+            # consecutive burst per sweep
+            j = (t + k * spacing) % steps_per_sweep
+            w = jnp.minimum(j * fe, n - fe)  # clamp final window to tail
+            vw = jax.lax.dynamic_slice(v, (jnp.int32(0), w), (n, fe))
+            pulled = jnp.take(vw, psafe, axis=0)  # [N, fe] partner rows
+            pulled = jnp.where(has_partner[:, None], pulled, 0)
+            return jax.lax.dynamic_update_slice(
+                v, jnp.maximum(vw, pulled), (jnp.int32(0), w)
+            )
 
-        view = jax.lax.fori_loop(0, params.feeds_per_tick, one_feed, view)
+        view = jax.lax.fori_loop(0, nfeeds, one_feed, view)
 
-    # relay: improved updates about third parties enter receiver buffers
-    relay_ok = improved & (subj != dst)
-    # rank messages within destination: sort by (dst, arrival)
-    order = jnp.argsort(jnp.where(relay_ok, dst, n), stable=True)
-    dst_s = jnp.where(relay_ok, dst, n)[order]
-    subj_s = subj[order]
-    key_s = key[order]
-    pos = jnp.arange(dst_s.shape[0])
-    first = jnp.searchsorted(dst_s, dst_s, side="left")
-    rank = pos - first
-    ok = (dst_s < n) & (rank < params.incoming_slots)
-    # scatter with min/max so masked duplicate (0, 0) writes are no-ops:
-    # each real (row, rank) cell receives at most one message (ranks are
-    # unique per destination), so min(subj)/max(key) both pick that message
-    in_subj = jnp.full((n, params.incoming_slots), n, dtype=jnp.int32)
-    in_key = jnp.zeros((n, params.incoming_slots), dtype=jnp.int32)
-    rows = jnp.where(ok, dst_s, 0)
-    cols = jnp.where(ok, rank, 0)
-    in_subj = in_subj.at[rows, cols].min(jnp.where(ok, subj_s, n))
-    in_key = in_key.at[rows, cols].max(jnp.where(ok, key_s, 0))
+    # ---- 5. refutation (row-local over the inbox + own diag) -------------
+    # a live member hearing itself suspect/down at ≥ its inc refutes by
+    # bumping its incarnation; the diag check also catches suspicions that
+    # arrived via a feed window rather than a gossip message
+    about_self = (in_subj == idx[:, None]) & (key_prec(in_key) >= PREC_SUSPECT)
+    worst_msg = jnp.max(jnp.where(about_self, key_inc(in_key), -1), axis=1)
+    selfk = view[idx, idx]
+    worst_diag = jnp.where(
+        key_prec(selfk) >= PREC_SUSPECT, key_inc(selfk), -1
+    )
+    worst = jnp.maximum(worst_msg, worst_diag)
+    refute = alive & (worst >= 0) & (worst >= inc)
+    inc = jnp.where(refute, worst + 1, inc)
+    own_upd_subj = own_upd_subj.at[:, 2].set(jnp.where(refute, idx, n))
+    own_upd_key = own_upd_key.at[:, 2].set(
+        jnp.where(refute, make_key(inc, PREC_ALIVE), 0)
+    )
 
-    # own announcements also enter own buffer (send_count 0)
-    in_subj = jnp.concatenate([in_subj, own_upd_subj], axis=1)
-    in_key = jnp.concatenate([in_key, own_upd_key], axis=1)
+    # ---- 6. row-aligned view update + relay ------------------------------
+    all_subj = jnp.concatenate([in_subj, own_upd_subj], axis=1)  # [N, R+3]
+    all_key = jnp.concatenate([in_key, own_upd_key], axis=1)
+    safe = jnp.clip(all_subj, 0, n - 1)
+    eff_key = jnp.where(all_subj < n, all_key, 0)
+    prev = view[idx[:, None], safe]
+    improved = eff_key > prev
+    view = view.at[idx[:, None], safe].max(eff_key)
+    # self-entries stay fresh (and reflect refutations immediately)
+    self_key = make_key(inc, PREC_ALIVE)
+    view = view.at[idx, idx].max(jnp.where(alive, self_key, 0))
+
+    # relay: improved updates about third parties enter the receiver's own
+    # gossip buffer (epidemic relay); own announcements enter unconditionally
+    relay_ok = improved & (all_subj != idx[:, None]) & (all_subj < n)
+    bin_subj = jnp.concatenate(
+        [jnp.where(relay_ok, all_subj, n), own_upd_subj], axis=1
+    )
+    bin_key = jnp.concatenate(
+        [jnp.where(relay_ok, all_key, 0), own_upd_key], axis=1
+    )
 
     buf_subj, buf_key, buf_sent = _buffer_merge(
-        params, buf_subj, buf_key, buf_sent, in_subj, in_key
+        params, buf_subj, buf_key, buf_sent, bin_subj, bin_key
     )
 
     return SwimState(
@@ -474,21 +510,33 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
 tick = functools.partial(jax.jit, static_argnames=("params",))(tick_impl)
 
 
-@functools.partial(jax.jit, static_argnames=("params", "k"))
-def tick_n(
+def _tick_n_impl(
     state: SwimState, rng: jax.Array, params: SwimParams, k: int
 ) -> SwimState:
-    """Advance `k` protocol periods in ONE dispatch (lax.scan over tick).
-    Amortizes host→device round-trips — essential when the chip sits
-    behind a high-latency tunnel, and the pattern the sharded multi-chip
-    path uses to keep ICI busy between host syncs."""
-
     def body(s, key):
         return tick_impl(s, key, params), None
 
     keys = jax.random.split(rng, k)
     out, _ = jax.lax.scan(body, state, keys)
     return out
+
+
+tick_n = functools.partial(jax.jit, static_argnames=("params", "k"))(
+    _tick_n_impl
+)
+"""Advance `k` protocol periods in ONE dispatch (lax.scan over tick).
+Amortizes host→device round-trips — essential when the chip sits behind a
+high-latency tunnel, and the pattern the sharded multi-chip path uses to
+keep ICI busy between host syncs."""
+
+tick_n_donated = functools.partial(
+    jax.jit, static_argnames=("params", "k"), donate_argnums=(0,)
+)(_tick_n_impl)
+"""`tick_n` with the input state's buffers donated: the [N, N] view is
+updated in place, halving peak HBM for the dominant array and raising the
+largest single-chip member count (~40–60k on a 16 GB v5e chip). Callers
+must not touch the input state afterwards — the simulation drivers
+(ClusterSim, bench) always replace their reference."""
 
 
 def set_alive(state: SwimState, member: int, value: bool) -> SwimState:
@@ -502,21 +550,33 @@ def set_alive(state: SwimState, member: int, value: bool) -> SwimState:
 
 @jax.jit
 def _stats_impl(view, alive):
+    """Row-reduction formulation: three fused masked row-sums over the
+    [N, N] view (one streaming pass each — no [N, N] boolean temporaries,
+    which made the r2 version cost ~2 s at n=10k on CPU), then O(N)
+    combination. Diagonal (self) terms are subtracted in closed form:
+    a live member's self entry is always an alive-precedence key."""
     n = view.shape[0]
-    eye = jnp.eye(n, dtype=bool)
+    af = alive.astype(jnp.float32)  # [N]
+    n_alive = jnp.sum(af)
     prec = key_prec(view)
     known = key_known(view)
-    pair_mask = alive[:, None] & ~eye
-    alive_subj = pair_mask & alive[None, :]
-    dead_subj = pair_mask & ~alive[None, :]
-    knows_alive = known & (prec == PREC_ALIVE)
-    thinks_down = known & (prec == PREC_DOWN)
-    n_alive_pairs = jnp.maximum(jnp.sum(alive_subj), 1)
-    n_dead_pairs = jnp.maximum(jnp.sum(dead_subj), 1)
-    coverage = jnp.sum(knows_alive & alive_subj) / n_alive_pairs
-    detected = jnp.sum(thinks_down & dead_subj) / n_dead_pairs
-    false_pos = jnp.sum((prec >= PREC_SUSPECT) & known & alive_subj) / n_alive_pairs
-    return jnp.stack([coverage, detected, false_pos])
+    row_ka = jnp.sum(  # alive-known subjects that ARE alive, per observer
+        jnp.where(known & (prec == PREC_ALIVE), af[None, :], 0.0), axis=1
+    )
+    row_td = jnp.sum(  # down-marked subjects that ARE dead, per observer
+        jnp.where(known & (prec == PREC_DOWN), 1.0 - af[None, :], 0.0), axis=1
+    )
+    row_fp = jnp.sum(  # suspected/downed subjects that ARE alive
+        jnp.where(known & (prec >= PREC_SUSPECT), af[None, :], 0.0), axis=1
+    )
+    cov_num = jnp.sum(row_ka * af) - n_alive  # minus the alive diagonal
+    det_num = jnp.sum(row_td * af)  # diag: live self never dead-subject
+    fp_num = jnp.sum(row_fp * af)  # diag: live self never suspect
+    n_alive_pairs = jnp.maximum(n_alive * (n_alive - 1.0), 1.0)
+    n_dead_pairs = jnp.maximum(n_alive * (n - n_alive), 1.0)
+    return jnp.stack(
+        [cov_num / n_alive_pairs, det_num / n_dead_pairs, fp_num / n_alive_pairs]
+    )
 
 
 def membership_stats(state: SwimState) -> dict:

@@ -48,10 +48,12 @@ def shard_swim_state(state: swim.SwimState, mesh: Mesh) -> swim.SwimState:
     return swim.SwimState(**out)
 
 
-def sharded_tick(params: swim.SwimParams, mesh: Mesh):
-    """A jitted tick whose outputs are constrained to the member sharding
-    (inputs carry their shardings; XLA inserts the ICI collectives for the
-    cross-shard gather/scatter in delivery and feed)."""
+def sharded_tick(params: swim.SwimParams, mesh: Mesh, k: int = 1):
+    """A jitted k-tick step whose outputs are constrained to the member
+    sharding (inputs carry their shardings; XLA inserts the ICI
+    collectives for the cross-shard gather/scatter in delivery and feed).
+    With k>1 the ticks run as one lax.scan dispatch — the multi-chip
+    convergence driver's shape (host syncs only between scans)."""
 
     out_shardings = swim.SwimState(
         t=NamedSharding(mesh, P()),
@@ -71,6 +73,8 @@ def sharded_tick(params: swim.SwimParams, mesh: Mesh):
     )
 
     def _tick(state: swim.SwimState, rng: jax.Array) -> swim.SwimState:
-        return swim.tick_impl(state, rng, params)
+        if k == 1:
+            return swim.tick_impl(state, rng, params)
+        return swim._tick_n_impl(state, rng, params, k)
 
     return jax.jit(_tick, out_shardings=out_shardings)

@@ -1,0 +1,52 @@
+"""Measure ticks-to-coverage and s/tick for the SWIM kernel on this host.
+
+Usage: python scripts/convergence_probe.py [n] [feeds] [chunk]
+Prints one line per chunk: tick, coverage, fp, cumulative wall seconds.
+Used to compare kernel variants (ticks-to-converge must not regress when
+the tick gets cheaper).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from corrosion_tpu.models.cluster import ClusterSim
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    feeds = int(sys.argv[2]) if len(sys.argv) > 2 else max(4, n // (25 * 50))
+    chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 10
+    target = float(os.environ.get("PROBE_COVERAGE", "0.999"))
+    max_ticks = int(os.environ.get("PROBE_MAX_TICKS", "2000"))
+
+    sim = ClusterSim(n, seed=0, feeds_per_tick=feeds)
+    sim.step()  # compile warm-up
+    import jax
+
+    jax.block_until_ready(sim.state.view)
+    print(f"platform={jax.devices()[0].platform} n={n} feeds={feeds}")
+    t0 = time.monotonic()
+    done = 0
+    while done < max_ticks:
+        sim.step(chunk)
+        done += chunk
+        s = sim.stats()
+        el = time.monotonic() - t0
+        print(
+            f"tick={sim.ticks:5d} cov={s['coverage']:.5f} "
+            f"fp={s['false_positive']:.6f} wall={el:8.2f}s "
+            f"({el / done * 1000:7.1f} ms/tick)"
+        )
+        if s["coverage"] >= target:
+            print(f"CONVERGED tick={sim.ticks} wall={el:.2f}s")
+            return
+    print("DID NOT CONVERGE")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,150 @@
+"""Per-phase wall-clock profile of the batched SWIM tick on the live chip.
+
+VERDICT r2 "what's weak" #1: no profile of the flagship kernel was ever
+recorded.  Times (a) the full tick / tick_n dispatch, and (b) phase-sliced
+jits matching the r3 kernel structure (ops/swim.py):
+
+  - pick:    _pick_known_alive target selection
+  - inbox:   lax.sort by destination + rank scan + [N, R] compaction
+  - viewupd: row-aligned gather + scatter-max of inbox into [N, N]
+  - feed:    dynamic_slice window + row-take + update (one exchange)
+  - bufmrg:  _buffer_merge lex sorts
+  - stats:   fused row-reduction stats + device→host readback
+
+Usage: python scripts/profile_swim.py [n] [feeds_per_tick]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from corrosion_tpu.ops import swim
+
+
+def timeit(fn, *args, iters=20, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.monotonic()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.monotonic() - t0) / iters
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
+    feeds = int(sys.argv[2]) if len(sys.argv) > 2 else max(4, n // (25 * 50))
+    params = swim.SwimParams(n=n, feeds_per_tick=feeds)
+    rng = jax.random.PRNGKey(0)
+    state = swim.init_state(params, rng)
+    state = swim.tick(state, jax.random.PRNGKey(1), params)  # populate
+    jax.block_until_ready(state.view)
+    print(f"platform={jax.devices()[0].platform} n={n} feeds={feeds}")
+
+    rows = []
+    rows.append(("tick(1)", timeit(
+        lambda s, k: swim.tick(s, k, params), state, rng, iters=10)))
+    t50 = timeit(lambda s, k: swim.tick_n(s, k, params, 50), state, rng,
+                 iters=3, warmup=1)
+    rows.append(("tick_n(50)/50", t50 / 50))
+
+    idx = jnp.arange(n, dtype=jnp.int32)
+    f, m = params.fanout, params.piggyback + params.antientropy
+    mlen = n * f * m
+    slots = params.incoming_slots
+
+    @jax.jit
+    def ph_pick(view, key):
+        return swim._pick_known_alive(view, idx, key, params, 4)
+
+    rows.append(("pick x1", timeit(ph_pick, state.view, rng)))
+
+    r = jax.random.PRNGKey(2)
+    dst = jax.random.randint(r, (mlen,), 0, n, dtype=jnp.int32)
+    subj = jax.random.randint(jax.random.fold_in(r, 1), (mlen,), 0, n,
+                              dtype=jnp.int32)
+    key = jax.random.randint(jax.random.fold_in(r, 2), (mlen,), 0, 40,
+                             dtype=jnp.int32)
+
+    @jax.jit
+    def ph_inbox(dst, subj, key):
+        dst_s, subj_s, key_s = jax.lax.sort(
+            (dst, subj, key), dimension=0, num_keys=1, is_stable=True
+        )
+        pos = jnp.arange(dst_s.shape[0], dtype=jnp.int32)
+        is_start = jnp.concatenate(
+            [jnp.ones((1,), bool), dst_s[1:] != dst_s[:-1]]
+        )
+        first = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(is_start, pos, 0)
+        )
+        rank = pos - first
+        ok = (dst_s < n) & (rank < slots)
+        rows_ = jnp.where(ok, dst_s, 0)
+        cols_ = jnp.where(ok, rank, 0)
+        in_subj = jnp.full((n, slots), n, dtype=jnp.int32)
+        in_key = jnp.zeros((n, slots), dtype=jnp.int32)
+        in_subj = in_subj.at[rows_, cols_].min(jnp.where(ok, subj_s, n))
+        in_key = in_key.at[rows_, cols_].max(jnp.where(ok, key_s, 0))
+        return in_subj, in_key
+
+    rows.append((f"inbox M={mlen}", timeit(ph_inbox, dst, subj, key)))
+    in_subj, in_key = ph_inbox(dst, subj, key)
+
+    @jax.jit
+    def ph_viewupd(view, in_subj, in_key):
+        safe = jnp.clip(in_subj, 0, n - 1)
+        eff = jnp.where(in_subj < n, in_key, 0)
+        prev = view[idx[:, None], safe]
+        improved = eff > prev
+        return view.at[idx[:, None], safe].max(eff), improved
+
+    rows.append(("viewupd [N,R]", timeit(ph_viewupd, state.view, in_subj, in_key)))
+
+    fe = min(params.feed_entries, n)
+
+    @jax.jit
+    def ph_feed(view, key):
+        partner = swim._pick_known_alive(view, idx, key, params, 2)
+        psafe = jnp.clip(partner, 0, n - 1)
+        w = jnp.int32(0)
+        vw = jax.lax.dynamic_slice(view, (jnp.int32(0), w), (n, fe))
+        pulled = jnp.take(vw, psafe, axis=0)
+        return jax.lax.dynamic_update_slice(
+            view, jnp.maximum(vw, pulled), (jnp.int32(0), w)
+        )
+
+    t1 = timeit(ph_feed, state.view, rng)
+    rows.append(("feed x1", t1))
+    rows.append((f"feed x{feeds} (extrap)", t1 * feeds))
+
+    bw = slots + 6
+    bin_subj = jax.random.randint(r, (n, bw), 0, n + 1, dtype=jnp.int32)
+    bin_key = jax.random.randint(r, (n, bw), 0, 40, dtype=jnp.int32)
+
+    @jax.jit
+    def ph_bufmrg(bs, bk, bt, isub, ikey):
+        return swim._buffer_merge(params, bs, bk, bt, isub, ikey)
+
+    rows.append(("bufmrg", timeit(
+        ph_bufmrg, state.buf_subj, state.buf_key, state.buf_sent, bin_subj,
+        bin_key)))
+
+    rows.append(("stats", timeit(
+        lambda s: swim.membership_stats(s), state, iters=5)))
+
+    print(f"{'phase':<24} {'ms':>10}")
+    for name, secs in rows:
+        print(f"{name:<24} {secs * 1e3:>10.3f}")
+
+
+if __name__ == "__main__":
+    main()
