@@ -72,8 +72,19 @@ async def setup(
         transport = network.transport(addr)
     else:
         host, _, port = config.gossip.bind_addr.rpartition(":")
-        listener = await TcpListener.bind(host or "127.0.0.1", int(port))
-        transport = TcpTransport(listener)
+        server_ctx = client_ctx = None
+        if not config.gossip.plaintext:
+            # secured gossip plane (peer/mod.rs:152-373): plaintext stays
+            # the explicit opt-in via gossip.plaintext = true. An operator
+            # who turned plaintext OFF with a broken/missing [gossip.tls]
+            # gets an error here — NEVER a silent plaintext fallback
+            from corrosion_tpu.tls import build_ssl_contexts
+
+            server_ctx, client_ctx = build_ssl_contexts(config.gossip.tls)
+        listener = await TcpListener.bind(
+            host or "127.0.0.1", int(port), ssl_context=server_ctx
+        )
+        transport = TcpTransport(listener, ssl_context=client_ctx)
 
     gossip_addr = config.gossip.external_addr or listener.addr
     actor = Actor(
@@ -189,6 +200,12 @@ async def run(agent: Agent) -> None:
     t.spawn(member_states_loop(agent))
     t.spawn(resurrect_and_schedule_rejoin(agent))
     t.spawn(_announcer(agent))
+    # db maintenance: WAL truncate ladder + incremental vacuum
+    # (handlers.rs:379-547) — this is what makes perf.wal_threshold_gb live
+    from corrosion_tpu.store.maintenance import vacuum_loop, wal_maintenance_loop
+
+    t.spawn(wal_maintenance_loop(agent))
+    t.spawn(vacuum_loop(agent))
     # schedule fully-buffered applies for partials already complete on disk
     for actor_id, booked in agent.bookie.items().items():
         with booked.read() as bv:

@@ -15,6 +15,15 @@ and RTT observations from connection reuse feed the members rings
 (`transport.rs:220`). QUIC itself isn't reproduced — no aioquic in the
 image and the kernel TCP path is the idiomatic substitute; the seam means
 a QUIC implementation can slot in without touching the runtime.
+
+TLS (`api/peer/mod.rs:152-373`): pass ssl contexts (built by
+`corrosion_tpu.tls.build_ssl_contexts`) to `TcpListener.bind` and
+`TcpTransport`. With TLS on, NO plaintext UDP socket is bound — SWIM
+datagrams ride a third lane byte (`D`) on a cached TLS connection as
+length-delimited frames, so the whole gossip plane (datagrams, uni,
+bi) is encrypted and, with mtls, client-authenticated. Plaintext is the
+explicit opt-in (`gossip.plaintext = true`), matching the reference's
+quinn_plaintext session (`quinn_plaintext.rs:23-35`).
 """
 
 from __future__ import annotations
@@ -35,6 +44,7 @@ from corrosion_tpu.types.codec import MAX_FRAME
 
 LANE_UNI = b"U"
 LANE_BI = b"B"
+LANE_DGRAM = b"D"  # TLS mode only: datagrams as frames on a TLS conn
 CONNECT_TIMEOUT = 5.0  # transport.rs: 5s connect timeout
 
 
@@ -114,20 +124,33 @@ class TcpListener(Listener):
         self._udp_transport = None
         self._tcp_server: Optional[asyncio.AbstractServer] = None
         self._addr = ""
+        self._ssl = None
 
     @classmethod
-    async def bind(cls, host: str = "127.0.0.1", port: int = 0) -> "TcpListener":
+    async def bind(
+        cls, host: str = "127.0.0.1", port: int = 0, ssl_context=None
+    ) -> "TcpListener":
         self = cls()
+        self._ssl = ssl_context
         loop = asyncio.get_running_loop()
-        self._udp_transport, _ = await loop.create_datagram_endpoint(
-            lambda: _UdpProtocol(self), local_addr=(host, port)
-        )
-        bound = self._udp_transport.get_extra_info("sockname")
-        # share the port number between UDP (datagrams) and TCP (streams)
-        self._tcp_server = await asyncio.start_server(
-            self._on_tcp_conn, host, bound[1]
-        )
-        self._addr = f"{bound[0]}:{bound[1]}"
+        if ssl_context is None:
+            self._udp_transport, _ = await loop.create_datagram_endpoint(
+                lambda: _UdpProtocol(self), local_addr=(host, port)
+            )
+            bound = self._udp_transport.get_extra_info("sockname")
+            # share the port number between UDP (datagrams) and TCP (streams)
+            self._tcp_server = await asyncio.start_server(
+                self._on_tcp_conn, host, bound[1]
+            )
+            self._addr = f"{bound[0]}:{bound[1]}"
+        else:
+            # TLS: the gossip plane accepts NOTHING in plaintext — no UDP
+            # socket at all; datagrams arrive as D-lane frames
+            self._tcp_server = await asyncio.start_server(
+                self._on_tcp_conn, host, port, ssl=ssl_context
+            )
+            bound = self._tcp_server.sockets[0].getsockname()
+            self._addr = f"{bound[0]}:{bound[1]}"
         return self
 
     def serve(self, on_datagram, on_uni, on_bi) -> None:
@@ -151,6 +174,18 @@ class TcpListener(Listener):
                     break
                 if self._on_uni is not None:
                     await self._on_uni(peer_addr, frame)
+            writer.close()
+        elif lane == LANE_DGRAM:
+            # TLS-mode datagram lane: each frame is one SWIM packet.
+            # Handlers run isolated (like the UDP path's ensure_future):
+            # a handler exception or slow reply-send must neither kill
+            # this read loop nor head-of-line-block the peer's packets
+            while True:
+                frame = await _read_frame(reader)
+                if frame is None:
+                    break
+                if self._on_datagram is not None:
+                    asyncio.ensure_future(self._on_datagram(peer_addr, frame))
             writer.close()
         elif lane == LANE_BI:
             if self._on_bi is not None:
@@ -179,12 +214,35 @@ class TcpTransport(Transport):
     """Client side: shares the listener's UDP socket so replies carry the
     right source address; caches one uni-lane TCP connection per peer."""
 
-    def __init__(self, listener: TcpListener):
+    def __init__(self, listener: TcpListener, ssl_context=None):
         self._listener = listener
-        self._uni_conns: Dict[str, asyncio.StreamWriter] = {}
-        self._uni_locks: Dict[str, asyncio.Lock] = {}
+        self._ssl = ssl_context
+        self._conns: Dict[Tuple[str, bytes], asyncio.StreamWriter] = {}
+        self._locks: Dict[Tuple[str, bytes], asyncio.Lock] = {}
 
     async def send_datagram(self, addr: str, data: bytes) -> None:
+        if self._ssl is not None:
+            # TLS mode: datagrams ride an encrypted D-lane connection, but
+            # keep UDP's fire-and-forget contract — the SWIM probe loop
+            # must never stall 5 s on a dead peer's TLS connect. Sends run
+            # as background tasks; if the lane is already busy (previous
+            # send still connecting), the packet is DROPPED — datagrams
+            # are unreliable by contract and SWIM resends
+            conn_key = (addr, LANE_DGRAM)
+            lock = self._locks.setdefault(conn_key, asyncio.Lock())
+            if lock.locked():
+                METRICS.counter("corro.transport.datagram.dropped").inc()
+                return
+
+            async def _bg():
+                try:
+                    await self._send_cached(addr, LANE_DGRAM, data)
+                    METRICS.counter("corro.transport.datagram.sent").inc()
+                except (TransportError, ConnectionError, OSError):
+                    METRICS.counter("corro.transport.datagram.failed").inc()
+
+            asyncio.ensure_future(_bg())
+            return
         udp = self._listener._udp_transport
         if udp is None:
             raise TransportError("transport closed")
@@ -196,9 +254,17 @@ class TcpTransport(Transport):
         host, port = split_addr(addr)
         start = time.monotonic()
         try:
-            reader, writer = await asyncio.wait_for(
-                asyncio.open_connection(host, port), CONNECT_TIMEOUT
-            )
+            if self._ssl is not None:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(
+                        host, port, ssl=self._ssl, server_hostname=host
+                    ),
+                    CONNECT_TIMEOUT,
+                )
+            else:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(host, port), CONNECT_TIMEOUT
+                )
         except (OSError, asyncio.TimeoutError) as e:
             raise TransportError(f"connect {addr}: {e}") from e
         self.observe_rtt(addr, time.monotonic() - start)
@@ -206,29 +272,34 @@ class TcpTransport(Transport):
         await writer.drain()
         return reader, writer
 
-    async def send_uni(self, addr: str, payload: bytes) -> None:
-        lock = self._uni_locks.setdefault(addr, asyncio.Lock())
+    async def _send_cached(self, addr: str, lane: bytes, payload: bytes) -> None:
+        """Send one frame on the cached per-(peer, lane) connection with
+        one reconnect retry, like transport.rs:108-139."""
+        conn_key = (addr, lane)
+        lock = self._locks.setdefault(conn_key, asyncio.Lock())
         async with lock:
-            # one retry with a fresh connection, like transport.rs:108-139
             for attempt in (0, 1):
-                writer = self._uni_conns.get(addr)
+                writer = self._conns.get(conn_key)
                 if writer is None or writer.is_closing():
-                    _, writer = await self._connect(addr, LANE_UNI)
-                    self._uni_conns[addr] = writer
+                    _, writer = await self._connect(addr, lane)
+                    self._conns[conn_key] = writer
                 try:
                     await _write_frame(writer, payload)
                     return
                 except (TransportError, ConnectionError, RuntimeError):
-                    self._uni_conns.pop(addr, None)
+                    self._conns.pop(conn_key, None)
                     writer.close()
                     if attempt:
                         raise
+
+    async def send_uni(self, addr: str, payload: bytes) -> None:
+        await self._send_cached(addr, LANE_UNI, payload)
 
     async def open_bi(self, addr: str) -> BiStream:
         reader, writer = await self._connect(addr, LANE_BI)
         return TcpBiStream(reader, writer, addr)
 
     async def close(self) -> None:
-        for writer in self._uni_conns.values():
+        for writer in self._conns.values():
             writer.close()
-        self._uni_conns.clear()
+        self._conns.clear()

@@ -76,6 +76,11 @@ class SwimParams(NamedTuple):
     feeds_per_tick: int = 4  # feed packets exchanged per protocol period;
     # a protocol period is ~1 s, so k feeds/tick ≈ k packets/s of
     # member-list transfer per member — bump for large clusters
+    announce_period: int = 8  # every A ticks each member re-injects its
+    # own record into the gossip stream (foca's periodic announce).
+    # Guarantees every subject a re-offer rate independent of how
+    # widely it is currently held — without it, bounded partial views
+    # drift rich-get-richer until rare members go extinct
     loss: float = 0.0  # iid per-leg message loss probability
 
 
@@ -213,6 +218,37 @@ def _buffer_merge(params: SwimParams, buf_subj, buf_key, buf_sent,
     return subj_f[:, :b], key_f[:, :b], sent_f[:, :b]
 
 
+def build_inbox(
+    n: int, slots: int, dst: jax.Array, subj: jax.Array, key: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Compact flat (dst, subj, key) messages into bounded per-member
+    inboxes [n, slots]: one stable sort by destination, in-group ranks by
+    associative scan, then a unique-cell scatter. Masked messages carry
+    dst = n and sort past every real destination. Shared by the dense
+    and partial-view SWIM kernels."""
+    dst_s, subj_s, key_s = jax.lax.sort(
+        (dst, subj, key), dimension=0, num_keys=1, is_stable=True
+    )
+    mlen = dst_s.shape[0]
+    pos = jnp.arange(mlen, dtype=jnp.int32)
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), dst_s[1:] != dst_s[:-1]]
+    )
+    first = jax.lax.associative_scan(jnp.maximum, jnp.where(is_start, pos, 0))
+    rank = pos - first
+    ok = (dst_s < n) & (rank < slots)
+    # scatter with min/max so masked duplicate (0, 0) writes are no-ops:
+    # each real (row, rank) cell receives at most one message (ranks are
+    # unique per destination), so min(subj)/max(key) both pick that message
+    rows = jnp.where(ok, dst_s, 0)
+    cols = jnp.where(ok, rank, 0)
+    in_subj = jnp.full((n, slots), n, dtype=jnp.int32)
+    in_key = jnp.zeros((n, slots), dtype=jnp.int32)
+    in_subj = in_subj.at[rows, cols].min(jnp.where(ok, subj_s, n))
+    in_key = in_key.at[rows, cols].max(jnp.where(ok, key_s, 0))
+    return in_subj, in_key
+
+
 def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState:
     """Advance every member one SWIM protocol period (trace-level impl;
     use `tick` for the jitted form, `tick_n` for k periods per dispatch)."""
@@ -229,9 +265,10 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
     susp_inc = state.susp_inc
     susp_deadline = state.susp_deadline
 
-    # announcements generated this tick, merged into own view + buffer later
-    own_upd_subj = jnp.full((n, 3), n, dtype=jnp.int32)  # suspect/down/refute
-    own_upd_key = jnp.zeros((n, 3), dtype=jnp.int32)
+    # announcements generated this tick, merged into own view + buffer
+    # later: suspect / down / refute / periodic self-announce
+    own_upd_subj = jnp.full((n, 4), n, dtype=jnp.int32)
+    own_upd_key = jnp.zeros((n, 4), dtype=jnp.int32)
 
     # ---- 1. probe FSM ----------------------------------------------------
     phase, psubj, pdl, pok = (
@@ -381,27 +418,9 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
     key = jnp.where(msg_ok, key, 0).reshape(-1)
 
     # ---- 4. inbox: sort by destination, rank in group, compact ----------
-    slots = params.incoming_slots
-    dst_s, subj_s, key_s = jax.lax.sort(
-        (dst, subj, key), dimension=0, num_keys=1, is_stable=True
+    in_subj, in_key = build_inbox(
+        n, params.incoming_slots, dst, subj, key
     )
-    mlen = dst_s.shape[0]
-    pos = jnp.arange(mlen, dtype=jnp.int32)
-    is_start = jnp.concatenate(
-        [jnp.ones((1,), bool), dst_s[1:] != dst_s[:-1]]
-    )
-    first = jax.lax.associative_scan(jnp.maximum, jnp.where(is_start, pos, 0))
-    rank = pos - first
-    ok = (dst_s < n) & (rank < slots)
-    # scatter with min/max so masked duplicate (0, 0) writes are no-ops:
-    # each real (row, rank) cell receives at most one message (ranks are
-    # unique per destination), so min(subj)/max(key) both pick that message
-    rows = jnp.where(ok, dst_s, 0)
-    cols = jnp.where(ok, rank, 0)
-    in_subj = jnp.full((n, slots), n, dtype=jnp.int32)
-    in_key = jnp.zeros((n, slots), dtype=jnp.int32)
-    in_subj = in_subj.at[rows, cols].min(jnp.where(ok, subj_s, n))
-    in_key = in_key.at[rows, cols].max(jnp.where(ok, key_s, 0))
 
     # ---- 4b. announce/feed exchange --------------------------------------
     # Each member pulls one packet's worth of member records from a random
@@ -462,6 +481,14 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
     own_upd_key = own_upd_key.at[:, 2].set(
         jnp.where(refute, make_key(inc, PREC_ALIVE), 0)
     )
+
+    # ---- 5b. periodic self-announce (staggered by member id) -------------
+    if params.announce_period > 0:
+        due = ((t + idx) % params.announce_period == 0) & alive
+        own_upd_subj = own_upd_subj.at[:, 3].set(jnp.where(due, idx, n))
+        own_upd_key = own_upd_key.at[:, 3].set(
+            jnp.where(due, make_key(inc, PREC_ALIVE), 0)
+        )
 
     # ---- 6. row-aligned view update + relay ------------------------------
     all_subj = jnp.concatenate([in_subj, own_upd_subj], axis=1)  # [N, R+3]

@@ -2,8 +2,16 @@
 
 from corrosion_tpu.parallel.mesh import (
     member_mesh,
+    shard_member_state,
     shard_swim_state,
+    sharded_pview_tick,
     sharded_tick,
 )
 
-__all__ = ["member_mesh", "shard_swim_state", "sharded_tick"]
+__all__ = [
+    "member_mesh",
+    "shard_member_state",
+    "shard_swim_state",
+    "sharded_pview_tick",
+    "sharded_tick",
+]

@@ -18,7 +18,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from corrosion_tpu.ops import swim
+from corrosion_tpu.ops import swim, swim_pview
 
 MEMBER_AXIS = "members"
 
@@ -34,18 +34,35 @@ def _sharding_for(mesh: Mesh, ndim: int) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
 
 
-def shard_swim_state(state: swim.SwimState, mesh: Mesh) -> swim.SwimState:
-    """Lay every per-member array out row-sharded over the mesh.
+def shard_member_state(state, mesh: Mesh):
+    """Lay every per-member array of a state NamedTuple out row-sharded
+    over the mesh (works for both `swim.SwimState` and
+    `swim_pview.PViewState` — every array's leading axis is the member
+    dimension). Scalars (the tick counter) stay replicated.
 
-    Scalars (the tick counter) stay replicated.
-    """
+    The placement rule lives ONLY in `_state_shardings`; this just
+    device_puts against it."""
+    shardings = _state_shardings(state, mesh)
+    return type(state)(
+        **{
+            name: jax.device_put(arr, getattr(shardings, name))
+            for name, arr in state._asdict().items()
+        }
+    )
+
+
+# back-compat alias (r1/r2 name)
+shard_swim_state = shard_member_state
+
+
+def _state_shardings(state, mesh: Mesh):
     out = {}
     for name, arr in state._asdict().items():
         if getattr(arr, "ndim", 0) == 0:
-            out[name] = jax.device_put(arr, NamedSharding(mesh, P()))
+            out[name] = NamedSharding(mesh, P())
         else:
-            out[name] = jax.device_put(arr, _sharding_for(mesh, arr.ndim))
-    return swim.SwimState(**out)
+            out[name] = _sharding_for(mesh, arr.ndim)
+    return type(state)(**out)
 
 
 def sharded_tick(params: swim.SwimParams, mesh: Mesh, k: int = 1):
@@ -55,26 +72,33 @@ def sharded_tick(params: swim.SwimParams, mesh: Mesh, k: int = 1):
     With k>1 the ticks run as one lax.scan dispatch — the multi-chip
     convergence driver's shape (host syncs only between scans)."""
 
-    out_shardings = swim.SwimState(
-        t=NamedSharding(mesh, P()),
-        alive=_sharding_for(mesh, 1),
-        inc=_sharding_for(mesh, 1),
-        view=_sharding_for(mesh, 2),
-        buf_subj=_sharding_for(mesh, 2),
-        buf_key=_sharding_for(mesh, 2),
-        buf_sent=_sharding_for(mesh, 2),
-        probe_phase=_sharding_for(mesh, 1),
-        probe_subj=_sharding_for(mesh, 1),
-        probe_deadline=_sharding_for(mesh, 1),
-        probe_ok=_sharding_for(mesh, 1),
-        susp_subj=_sharding_for(mesh, 2),
-        susp_inc=_sharding_for(mesh, 2),
-        susp_deadline=_sharding_for(mesh, 2),
+    example = jax.eval_shape(
+        lambda: swim.init_state(params, jax.random.PRNGKey(0))
     )
+    out_shardings = _state_shardings(example, mesh)
 
     def _tick(state: swim.SwimState, rng: jax.Array) -> swim.SwimState:
         if k == 1:
             return swim.tick_impl(state, rng, params)
         return swim._tick_n_impl(state, rng, params, k)
+
+    return jax.jit(_tick, out_shardings=out_shardings)
+
+
+def sharded_pview_tick(params: swim_pview.PViewParams, mesh: Mesh, k: int = 1):
+    """Sharded k-tick step for the bounded partial-view kernel
+    (`ops/swim_pview.py`): every state array is row-sharded over the
+    member axis; the O(N·K) slot table is what carries the member count
+    past the dense kernel's [N, N] memory wall (262k+ on a v5e-8)."""
+
+    example = jax.eval_shape(
+        lambda: swim_pview.init_state(params, jax.random.PRNGKey(0))
+    )
+    out_shardings = _state_shardings(example, mesh)
+
+    def _tick(state, rng):
+        if k == 1:
+            return swim_pview.tick_impl(state, rng, params)
+        return swim_pview._tick_n_impl(state, rng, params, k)
 
     return jax.jit(_tick, out_shardings=out_shardings)

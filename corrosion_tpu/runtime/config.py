@@ -88,8 +88,11 @@ class PerfConfig:
     broadcast_cutoff_bytes: int = 64 * 1024
     broadcast_rate_limit_bytes: int = 10 * 1024 * 1024
     max_inflight_broadcasts: int = 500
-    # maintenance
+    # maintenance (handlers.rs:379-547)
     wal_threshold_gb: float = 5.0
+    wal_check_interval_secs: float = 60.0
+    vacuum_interval_secs: float = 300.0
+    vacuum_min_freelist_pages: int = 10_000
 
 
 @dataclass

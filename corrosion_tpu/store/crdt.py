@@ -174,6 +174,11 @@ class CrdtStore:
 
     def _setup_conn(self, conn: sqlite3.Connection) -> None:
         if not self._is_memory:
+            # INCREMENTAL before any table exists so the maintenance
+            # loops can reclaim freelist pages (setup.rs:80, the
+            # reference opens with auto_vacuum=INCREMENTAL); no-op with a
+            # warning on pre-existing dbs created without it
+            conn.execute("PRAGMA auto_vacuum = INCREMENTAL")
             conn.execute("PRAGMA journal_mode = WAL")
         conn.execute("PRAGMA synchronous = NORMAL")
         conn.execute("PRAGMA foreign_keys = OFF")

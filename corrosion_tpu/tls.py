@@ -162,3 +162,40 @@ def generate_client_cert(
     )
     _write_pem(Path(cert_path), cert.public_bytes(serialization.Encoding.PEM))
     _write_pem(Path(key_path), _key_pems(key))
+
+
+def build_ssl_contexts(tls_cfg):
+    """(server_ctx, client_ctx) for the gossip TCP lanes from a
+    `runtime.config.GossipTlsConfig`.
+
+    Mirrors the reference's rustls endpoint setup
+    (`klukai-agent/src/api/peer/mod.rs:152-373`): the server presents
+    cert_file/key_file and, with `mtls`, requires + verifies client
+    certificates against ca_file; the client verifies the server against
+    ca_file unless `insecure` (SkipServerVerification,
+    `peer/mod.rs:386-442`), and presents client_cert_file when configured.
+    """
+    import ssl
+
+    if not tls_cfg.cert_file or not tls_cfg.key_file:
+        raise ValueError("gossip TLS requires cert_file and key_file")
+
+    server = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    server.load_cert_chain(tls_cfg.cert_file, tls_cfg.key_file)
+    if tls_cfg.mtls:
+        if not tls_cfg.ca_file:
+            raise ValueError("mtls requires ca_file")
+        server.verify_mode = ssl.CERT_REQUIRED
+        server.load_verify_locations(tls_cfg.ca_file)
+
+    client = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    if tls_cfg.insecure:
+        client.check_hostname = False
+        client.verify_mode = ssl.CERT_NONE
+    else:
+        if not tls_cfg.ca_file:
+            raise ValueError("non-insecure TLS requires ca_file to verify peers")
+        client.load_verify_locations(tls_cfg.ca_file)
+    if tls_cfg.client_cert_file and tls_cfg.client_key_file:
+        client.load_cert_chain(tls_cfg.client_cert_file, tls_cfg.client_key_file)
+    return server, client

@@ -1,0 +1,124 @@
+"""DB maintenance: WAL truncate ladder + incremental vacuum
+(VERDICT r2 missing #3 — `perf.wal_threshold_gb` must be live).
+Reference: `klukai-agent/src/agent/handlers.rs:379-547`.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from corrosion_tpu.store.crdt import CrdtStore
+from corrosion_tpu.types.base import Timestamp
+from corrosion_tpu.store import maintenance
+
+SCHEMA = "CREATE TABLE t (id INTEGER PRIMARY KEY, blob TEXT);"
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = CrdtStore(str(tmp_path / "m.db"))
+    s.apply_schema_sql(SCHEMA)
+    yield s
+    s.close()
+
+
+def _grow_wal(store, rows=200):
+    with store.write_tx(Timestamp.now()) as tx:
+        for i in range(rows):
+            tx.execute(
+                "INSERT OR REPLACE INTO t (id, blob) VALUES (?, ?)",
+                (i, "x" * 2048),
+            )
+
+
+def test_busy_timeout_ladder():
+    assert maintenance.calc_busy_timeout_s(0) == 30.0
+    assert maintenance.calc_busy_timeout_s(1) == 60.0
+    assert maintenance.calc_busy_timeout_s(2) == 120.0
+    # 16-minute cap (handlers.rs:529)
+    assert maintenance.calc_busy_timeout_s(10) == 960.0
+
+
+def test_wal_truncates_past_threshold(store):
+    _grow_wal(store)
+    size = maintenance.wal_size_bytes(store)
+    assert size > 4096, "writes should have grown the WAL"
+    # tiny threshold: the knob is live and truncation observable
+    result = maintenance.truncate_wal_if_needed(store, threshold_bytes=4096)
+    assert result is True
+    assert maintenance.wal_size_bytes(store) == 0
+
+
+def test_wal_below_threshold_untouched(store):
+    _grow_wal(store, rows=5)
+    size = maintenance.wal_size_bytes(store)
+    assert maintenance.truncate_wal_if_needed(store, 2**30) is None
+    assert maintenance.wal_size_bytes(store) == size
+
+
+def test_wal_truncate_busy_with_open_reader(store):
+    """A read transaction pins the WAL: TRUNCATE cannot complete and the
+    caller escalates the ladder instead of spinning."""
+    _grow_wal(store)
+    reader = store.read_conn()
+    reader.execute("BEGIN")
+    reader.execute("SELECT COUNT(*) FROM t").fetchone()
+    try:
+        # zero patience for the test (ladder base is 30s in production)
+        old = maintenance.BUSY_TIMEOUT_BASE_S
+        maintenance.BUSY_TIMEOUT_BASE_S = 0.05
+        try:
+            result = maintenance.truncate_wal_if_needed(store, 4096)
+        finally:
+            maintenance.BUSY_TIMEOUT_BASE_S = old
+        assert result is False  # busy → escalate, not crash
+        assert maintenance.wal_size_bytes(store) > 0
+    finally:
+        reader.close()
+    # reader gone → next attempt succeeds
+    assert maintenance.truncate_wal_if_needed(store, 4096) is True
+
+
+def test_incremental_vacuum_reclaims_freelist(store):
+    _grow_wal(store, rows=500)
+    with store.write_tx(Timestamp.now()) as tx:
+        tx.execute("DELETE FROM t")
+    maintenance.truncate_wal_if_needed(store, 0)
+    free = maintenance.freelist_pages(store)
+    assert free > 10, "bulk delete should leave freelist pages"
+    reclaimed = maintenance.incremental_vacuum_if_needed(
+        store, min_freelist_pages=5
+    )
+    assert reclaimed > 0
+    assert maintenance.freelist_pages(store) < 5
+
+
+def test_maintenance_loops_run_in_agent(tmp_path):
+    """The loops actually spawn with the agent and consume the config knobs:
+    a tiny threshold + fast cadence truncates a grown WAL within a second."""
+    from corrosion_tpu.agent.run import run, setup, shutdown
+    from corrosion_tpu.runtime.config import Config
+
+    async def main():
+        cfg = Config()
+        cfg.db.path = str(tmp_path / "agent.db")
+        cfg.gossip.bind_addr = "127.0.0.1:0"
+        cfg.perf.wal_threshold_gb = 4096 / 2**30  # 4 KiB
+        cfg.perf.wal_check_interval_secs = 0.1
+        cfg.perf.vacuum_interval_secs = 0.1
+        cfg.perf.vacuum_min_freelist_pages = 5
+        agent = await setup(cfg)
+        agent.store.apply_schema_sql(SCHEMA)
+        await run(agent)
+        _grow_wal(agent.store)
+        assert maintenance.wal_size_bytes(agent.store) > 4096
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            if maintenance.wal_size_bytes(agent.store) == 0:
+                break
+        size = maintenance.wal_size_bytes(agent.store)
+        await shutdown(agent)
+        assert size == 0, f"maintenance loop never truncated (size={size})"
+
+    asyncio.run(main())

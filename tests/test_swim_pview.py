@@ -1,0 +1,159 @@
+"""Partial-view SWIM kernel: dense-equivalence, convergence, eviction.
+
+The bounded hash-slot kernel (`ops/swim_pview.py`) must (a) be
+bit-equivalent to the dense kernel when run in identity-hash mode with
+slots == n — the dense kernel is its K = n special case — and (b)
+converge to stable in-degree coverage with a genuinely bounded table
+(slots << n), which is what carries the design past the dense [N, N]
+memory wall (VERDICT r2 missing #5).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from corrosion_tpu.ops import swim, swim_pview
+
+
+def _dense_from_pview(params, packed, t):
+    """Reconstruct the dense [N, N] view from an identity-hash slot table."""
+    rows = jnp.arange(params.n, dtype=jnp.int32)[:, None]
+    subj, key = swim_pview._unpack(params, packed, rows, t)
+    n = params.n
+    view = jnp.zeros((n, n), dtype=jnp.int32)
+    rows = jnp.broadcast_to(jnp.arange(n)[:, None], subj.shape)
+    occupied = key > 0
+    return view.at[
+        jnp.where(occupied, rows, 0), jnp.where(occupied, subj, 0)
+    ].max(jnp.where(occupied, key, 0))
+
+
+def test_identity_hash_bit_parity_with_dense():
+    """slots == n + identity hash ⇒ the pview tick IS the dense tick:
+    same rng stream, same merges, same FSM trajectory, bit for bit."""
+    n = 64
+    dp = swim.SwimParams(n=n, feeds_per_tick=2, feed_entries=16)
+    pp = swim_pview.PViewParams(
+        n=n, slots=n, identity_hash=True, feeds_per_tick=2, feed_entries=16
+    )
+    rng = jax.random.PRNGKey(0)
+    ds = swim.init_state(dp, rng)
+    ps = swim_pview.init_state(pp, rng)
+
+    # crash one member part-way to exercise suspect/down/refute paths too
+    for i in range(30):
+        step_rng = jax.random.fold_in(jax.random.PRNGKey(7), i)
+        if i == 10:
+            ds = swim.set_alive(ds, 5, False)
+            ps = swim_pview.set_alive(ps, 5, False)
+        if i == 20:
+            ds = swim.set_alive(ds, 5, True)
+            ps = swim_pview.set_alive(ps, 5, True)
+        ds = swim.tick(ds, step_rng, dp)
+        ps = swim_pview.tick(ps, step_rng, pp)
+
+    recon = _dense_from_pview(pp, ps.slot_packed, ps.t)
+    assert jnp.array_equal(recon, ds.view), "view trajectories diverged"
+    assert jnp.array_equal(ps.inc, ds.inc)
+    assert jnp.array_equal(ps.buf_subj, ds.buf_subj)
+    assert jnp.array_equal(ps.buf_key, ds.buf_key)
+    assert jnp.array_equal(ps.probe_phase, ds.probe_phase)
+    assert jnp.array_equal(ps.probe_subj, ds.probe_subj)
+    assert jnp.array_equal(ps.susp_subj, ds.susp_subj)
+
+
+def test_bounded_view_converges():
+    """slots = n/8: every live member ends up known by ≈ the expected
+    number of observers, with zero false positives."""
+    n, k = 512, 64
+    pp = swim_pview.PViewParams(
+        n=n, slots=k, feeds_per_tick=4, feed_entries=16
+    )
+    state = swim_pview.init_state(pp, jax.random.PRNGKey(0))
+    rng = jax.random.PRNGKey(1)
+    stats = None
+    for chunk in range(20):
+        rng, key = jax.random.split(rng)
+        state = swim_pview.tick_n(state, key, pp, 25)
+        stats = swim_pview.membership_stats(state, pp)
+        if stats["pv_coverage"] >= 0.999 and stats["min_in_degree"] > 0:
+            break
+    assert stats["pv_coverage"] >= 0.999, stats
+    assert stats["min_in_degree"] > 0, stats
+    assert stats["false_positive"] == 0.0, stats
+    # the table really is bounded: occupancy can never exceed 1, and the
+    # mean in-degree is capped by the slot budget, not by n
+    assert stats["occupancy"] <= 1.0
+    assert stats["mean_in_degree"] <= k
+
+
+def test_detects_crash_with_bounded_view():
+    n, k = 256, 64
+    pp = swim_pview.PViewParams(n=n, slots=k, feeds_per_tick=4, feed_entries=16)
+    state = swim_pview.init_state(pp, jax.random.PRNGKey(0))
+    rng = jax.random.PRNGKey(1)
+    for _ in range(8):
+        rng, key = jax.random.split(rng)
+        state = swim_pview.tick_n(state, key, pp, 25)
+    state = swim_pview.set_alive(state, 3, False)
+    # dead member must eventually be marked down by holders of its entry
+    for _ in range(8):
+        rng, key = jax.random.split(rng)
+        state = swim_pview.tick_n(state, key, pp, 10)
+    rows = jnp.arange(pp.n, dtype=jnp.int32)[:, None]
+    subj, key_ = swim_pview._unpack(pp, state.slot_packed, rows, state.t)
+    holds_3 = (subj == 3) & (key_ > 0) & state.alive[:, None]
+    down_3 = holds_3 & (swim.key_prec(key_) == swim.PREC_DOWN)
+    n_holds = int(jnp.sum(jnp.any(holds_3, axis=1)))
+    n_down = int(jnp.sum(jnp.any(down_3, axis=1)))
+    assert n_holds > 0
+    # every live holder of member 3's entry has it marked down
+    assert n_down == n_holds, (n_down, n_holds)
+
+
+def test_refutation_with_bounded_view():
+    """A suspected-but-alive member refutes: no live member may end up
+    holding a suspect/down entry about it at its current incarnation."""
+    n, k = 256, 64
+    pp = swim_pview.PViewParams(n=n, slots=k, feeds_per_tick=4, feed_entries=16)
+    state = swim_pview.init_state(pp, jax.random.PRNGKey(0))
+    rng = jax.random.PRNGKey(1)
+    for _ in range(6):
+        rng, key = jax.random.split(rng)
+        state = swim_pview.tick_n(state, key, pp, 25)
+    # crash + quick restart: stale down-entries must be refuted away
+    state = swim_pview.set_alive(state, 7, False)
+    for _ in range(3):
+        rng, key = jax.random.split(rng)
+        state = swim_pview.tick_n(state, key, pp, 10)
+    state = swim_pview.set_alive(state, 7, True)
+    for _ in range(10):
+        rng, key = jax.random.split(rng)
+        state = swim_pview.tick_n(state, key, pp, 10)
+    stats = swim_pview.membership_stats(state, pp)
+    assert stats["false_positive"] == 0.0, stats
+
+
+def test_own_entry_pinned():
+    """A member's own record survives any collision pressure."""
+    n, k = 512, 16  # heavy pressure: 512 subjects → 16 slots
+    pp = swim_pview.PViewParams(n=n, slots=k, feeds_per_tick=2, feed_entries=8)
+    state = swim_pview.init_state(pp, jax.random.PRNGKey(0))
+    rng = jax.random.PRNGKey(1)
+    for _ in range(10):
+        rng, key = jax.random.split(rng)
+        state = swim_pview.tick_n(state, key, pp, 10)
+    self_idx = jnp.arange(n, dtype=jnp.int32)
+    selfk = swim_pview._lookup(pp, state.slot_packed, self_idx, state.t)
+    assert bool(jnp.all(selfk > 0)), "own entry evicted somewhere"
+    assert bool(jnp.all(swim.key_prec(selfk) == swim.PREC_ALIVE))
+
+
+def test_inc_cap_math():
+    assert swim_pview.inc_cap(1_000_000) >= 500
+    assert swim_pview.inc_cap(262_144) >= 2000
+    # packed word stays in int32 at the cap
+    for n in (1_000_000, 262_144, 1000):
+        cap = swim_pview.inc_cap(n)
+        worst_key = swim.make_key(cap, swim.PREC_DOWN)
+        assert worst_key * n + (n - 1) < 2**31

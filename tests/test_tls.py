@@ -1,0 +1,246 @@
+"""Gossip-plane TLS/mTLS (VERDICT r2 missing #2).
+
+The reference requires rustls on the gossip plane with optional mTLS
+client verification (`klukai-agent/src/api/peer/mod.rs:152-373`) and
+plaintext only as an explicit opt-in (`quinn_plaintext.rs:23-35`). These
+tests pin: all three lanes work over TLS (datagrams ride the encrypted
+D-lane — no plaintext UDP socket exists in TLS mode), an mTLS server
+rejects clients without a CA-signed cert, plaintext remains the explicit
+default, and two full agents gossip + replicate over a TLS transport.
+"""
+
+import asyncio
+import socket
+import ssl
+
+import pytest
+
+from corrosion_tpu import tls
+from corrosion_tpu.net.tcp import TcpListener, TcpTransport
+from corrosion_tpu.runtime.config import Config, GossipTlsConfig
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("certs")
+    ca_cert, ca_key = str(d / "ca.pem"), str(d / "ca.key")
+    tls.generate_ca(ca_cert, ca_key)
+    tls.generate_server_cert(
+        ca_cert, ca_key, "127.0.0.1", str(d / "srv.pem"), str(d / "srv.key")
+    )
+    tls.generate_client_cert(
+        ca_cert, ca_key, str(d / "cli.pem"), str(d / "cli.key")
+    )
+    # a second, UNRELATED CA + client cert for the rejection test
+    ca2_cert, ca2_key = str(d / "ca2.pem"), str(d / "ca2.key")
+    tls.generate_ca(ca2_cert, ca2_key)
+    tls.generate_client_cert(
+        ca2_cert, ca2_key, str(d / "rogue.pem"), str(d / "rogue.key")
+    )
+    return d
+
+
+def tls_cfg(certs, mtls=False, client_cert=True, rogue=False):
+    return GossipTlsConfig(
+        cert_file=str(certs / "srv.pem"),
+        key_file=str(certs / "srv.key"),
+        ca_file=str(certs / "ca.pem"),
+        mtls=mtls,
+        client_cert_file=(
+            str(certs / ("rogue.pem" if rogue else "cli.pem"))
+            if client_cert
+            else None
+        ),
+        client_key_file=(
+            str(certs / ("rogue.key" if rogue else "cli.key"))
+            if client_cert
+            else None
+        ),
+    )
+
+
+def test_tls_three_lanes(certs):
+    async def main():
+        server_ctx, client_ctx = tls.build_ssl_contexts(tls_cfg(certs))
+        got = {"dgram": asyncio.Event(), "uni": asyncio.Event(), "data": {}}
+
+        async def on_datagram(src, data):
+            got["data"]["dgram"] = data
+            got["dgram"].set()
+
+        async def on_uni(src, data):
+            got["data"].setdefault("uni", []).append(data)
+            got["uni"].set()
+
+        async def on_bi(stream):
+            frame = await stream.recv()
+            await stream.send(b"pong:" + frame)
+            await stream.finish()
+
+        server = await TcpListener.bind(ssl_context=server_ctx)
+        server.serve(on_datagram, on_uni, on_bi)
+        # TLS mode: NO plaintext UDP socket exists
+        assert server._udp_transport is None
+
+        client_listener = await TcpListener.bind(ssl_context=server_ctx)
+        client_listener.serve(on_datagram, on_uni, on_bi)
+        t = TcpTransport(client_listener, ssl_context=client_ctx)
+
+        await t.send_datagram(server.addr, b"dg")
+        await asyncio.wait_for(got["dgram"].wait(), 5)
+        assert got["data"]["dgram"] == b"dg"
+
+        await t.send_uni(server.addr, b"frame1")
+        await t.send_uni(server.addr, b"frame2")
+        await asyncio.wait_for(got["uni"].wait(), 5)
+        for _ in range(50):
+            if len(got["data"].get("uni", [])) == 2:
+                break
+            await asyncio.sleep(0.01)
+        assert got["data"]["uni"] == [b"frame1", b"frame2"]
+
+        bi = await t.open_bi(server.addr)
+        await bi.send(b"syn")
+        assert await bi.recv() == b"pong:syn"
+        bi.close()
+
+        await t.close()
+        await server.close()
+        await client_listener.close()
+
+    asyncio.run(main())
+
+
+def test_mtls_rejects_unknown_client(certs):
+    async def main():
+        server_ctx, _ = tls.build_ssl_contexts(tls_cfg(certs, mtls=True))
+        seen = asyncio.Event()
+
+        async def handler(*a):
+            seen.set()
+
+        server = await TcpListener.bind(ssl_context=server_ctx)
+        server.serve(handler, handler, handler)
+        host, port = server.addr.rsplit(":", 1)
+
+        async def attempt(client_ctx) -> bool:
+            """True if the server accepted and processed our frame.
+
+            With TLS 1.3 the server's client-cert rejection arrives only
+            AFTER the client's handshake returns, so the proof of
+            rejection is behavioral: the connection dies without any
+            handler ever running."""
+            seen.clear()
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(
+                        host, int(port), ssl=client_ctx, server_hostname=host
+                    ),
+                    5,
+                )
+            except (ssl.SSLError, ConnectionError, OSError):
+                return False
+            try:
+                writer.write(b"U" + b"\x00\x00\x00\x05sneak")
+                await writer.drain()
+                # a rejecting server alert terminates the stream promptly;
+                # an accepting server keeps the uni lane open (read blocks)
+                await asyncio.wait_for(reader.read(), 1.5)
+            except asyncio.TimeoutError:
+                pass  # connection stayed open — acceptance path
+            except (ssl.SSLError, ConnectionError, OSError):
+                pass
+            finally:
+                writer.close()
+            await asyncio.sleep(0.2)
+            return seen.is_set()
+
+        # cert from an unrelated CA → rejected
+        _, rogue_ctx = tls.build_ssl_contexts(
+            tls_cfg(certs, mtls=True, rogue=True)
+        )
+        assert not await attempt(rogue_ctx), "rogue client was accepted"
+
+        # no client cert at all → rejected
+        _, nocert_ctx = tls.build_ssl_contexts(
+            tls_cfg(certs, mtls=True, client_cert=False)
+        )
+        assert not await attempt(nocert_ctx), "certless client was accepted"
+
+        # the legit client still gets through
+        _, good_ctx = tls.build_ssl_contexts(tls_cfg(certs, mtls=True))
+        assert await attempt(good_ctx), "legit mTLS client was rejected"
+
+        await server.close()
+
+    asyncio.run(main())
+
+
+def test_plaintext_is_explicit_default():
+    cfg = Config()
+    assert cfg.gossip.plaintext is True
+    assert cfg.gossip.tls_enabled is False
+
+
+def test_plaintext_off_without_certs_fails_loudly(tmp_path):
+    """plaintext=false with a broken/missing [gossip.tls] must raise at
+    setup — never silently fall back to an unencrypted gossip plane."""
+    from corrosion_tpu.agent.run import setup
+
+    async def main():
+        cfg = Config()
+        cfg.db.path = ":memory:"
+        cfg.gossip.bind_addr = "127.0.0.1:0"
+        cfg.gossip.plaintext = False  # no tls section configured
+        with pytest.raises(ValueError, match="cert_file"):
+            await setup(cfg)
+
+    asyncio.run(main())
+
+
+def test_two_agents_replicate_over_tls(certs):
+    """Full-stack: two agents on loopback TLS transports gossip membership
+    and replicate a row (the two-node DevCluster-over-TLS proof)."""
+    from tests.test_agent import (
+        TEST_SCHEMA,
+        FAST_SWIM,
+        count_rows,
+        fast_config,
+        insert,
+        wait_until,
+    )
+    from corrosion_tpu.agent.run import run, setup, shutdown
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    async def main():
+        cfg_tls = tls_cfg(certs)
+        agents = []
+        addrs = [f"127.0.0.1:{free_port()}" for _ in range(2)]
+        for i, addr in enumerate(addrs):
+            cfg = fast_config(addr, bootstrap=[a for a in addrs if a != addr])
+            cfg.gossip.plaintext = False
+            cfg.gossip.tls = cfg_tls
+            agent = await setup(cfg, network=None)
+            agent.membership.config = FAST_SWIM
+            agent.store.apply_schema_sql(TEST_SCHEMA)
+            await run(agent)
+            agents.append(agent)
+
+        a, b = agents
+        assert await wait_until(
+            lambda: len(a.members.states) >= 1 and len(b.members.states) >= 1
+        ), "TLS agents never saw each other"
+        await insert(a, 1, "tls-row")
+        assert await wait_until(lambda: count_rows(b) == 1), (
+            "row did not replicate over TLS"
+        )
+        for agent in agents:
+            await shutdown(agent)
+
+    asyncio.run(main())
