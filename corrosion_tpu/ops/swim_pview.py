@@ -6,7 +6,8 @@ v5e-8 (VERDICT r2 missing #5). This kernel replaces the view with a
 **bounded per-member hash-slot table** of `slots` entries, making state
 O(N·K):
 
-    slot_packed [N, K] int32     packed = key * n + subj   (0 = empty)
+    slot_packed [N, K] int32   packed = key * P + (subj ^ mask),
+                               P = next_pow2(n), 0 = empty (see _mask)
 
 A subject `s` lives in slot `h(s) = (s * 2654435761 mod 2^32) mod K`
 of each member's row. Because the packed word orders by (key, subj) and
@@ -21,10 +22,10 @@ Own-entry pinning: a member's own record is force-written (`set`, not
 `max`) into `h(self)` at the end of every tick, so a member can never be
 evicted from its own table by a colliding squatter.
 
-Packing bound: key*n + subj < 2^31 requires key < 2^31/n, so refutation
-incarnations are clipped to `inc_cap(n)` (= 536 869 at n=1000, 2045 at
-n=262144, 535 at n=1M) — far beyond any realistic churn (SWIM
-incarnations in practice stay < 100).
+Packing bound: key*P + field < 2^31 requires key < 2^31/P (P =
+next_pow2(n)), so refutation incarnations are clipped to `inc_cap(n)`
+(= 524 286 at n=1000, 2046 at n=262144, 510 at n=1M) — far beyond any
+realistic churn (SWIM incarnations in practice stay < 100).
 
 With `identity_hash=True` and `slots == n`, h is the identity, slot `s`
 holds subject `s`, and this kernel is **bit-equivalent to the dense
@@ -94,18 +95,22 @@ class PViewParams(NamedTuple):
     indirect_probes: int = 3
     suspicion_ticks: int = 6
     probe_candidates: int = 4
-    antientropy: int = 2
+    # bounded-mode defaults tuned on the load-16 fairness sweep (see
+    # tests/test_swim_pview.py::test_retention_fairness_under_load):
+    # more anti-entropy + faster announce + longer tie epochs give the
+    # designated winners time to install, lifting the in-degree floor
+    antientropy: int = 4
     feed_entries: int = 25
     feeds_per_tick: int = 4
-    announce_period: int = 8
-    tie_epoch: int = 16  # ticks between tie-break rotations (see _rot)
+    announce_period: int = 4
+    tie_epoch: int = 48  # ticks between tie-break re-maskings (see _mask)
     loss: float = 0.0
     identity_hash: bool = False
 
 
 def inc_cap(n: int) -> int:
     """Largest incarnation representable in the packed word for n."""
-    return (2**31 // n - 1) // 4 - 1
+    return (2**31 // _pow2(n) - 1) // 4 - 1
 
 
 def _hash(params: PViewParams, subj: jax.Array) -> jax.Array:
@@ -117,57 +122,76 @@ def _hash(params: PViewParams, subj: jax.Array) -> jax.Array:
     return (mixed % jnp.uint32(params.slots)).astype(jnp.int32)
 
 
-def _rot(params: PViewParams, rows, t) -> jax.Array:
-    """Per-(observer, tick) subject rotation for the packed tie-break.
+def _pow2(n: int) -> int:
+    """Smallest power of two >= n: the packed tie-field domain."""
+    return 1 << (n - 1).bit_length()
+
+
+def _fmix32(x: jax.Array) -> jax.Array:
+    """murmur3 finalizer: avalanche a uint32 (bijective)."""
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> jnp.uint32(13))
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> jnp.uint32(16))
+    return x
+
+
+def _mask(params: PViewParams, rows, t) -> jax.Array:
+    """Per-(observer, epoch) XOR mask for the packed word's tie-break.
 
     Within one key level, `max` on the packed word breaks ties by the
-    STORED subject field. If that field were the raw subject id, slot
-    eviction under collision pressure would be deterministic by id — the
-    highest-id subjects would permanently squat every saturated slot and
-    low-id members would become globally unknown (in-degree 0). A purely
-    time-varying rotation is not enough either: a GLOBAL tie-break makes
-    every observer retain the same winner subset, so only ~K subjects are
-    well-known at any instant. The rotation therefore mixes the observer
-    row AND the tick — r(i, t) = (i*48271 + t*40503) mod n — so a
-    contested slot's winner differs across observers (in-degree spreads
-    over all subjects) and revolves over time (retention approximates
-    random replacement).
+    STORED subject field; that field is `subj ^ mask(row, epoch)` over
+    the power-of-two domain `_pow2(n)`. Why this exact construction:
 
-    The rotation advances once per `tie_epoch` ticks, not per tick: a
-    per-tick tie-break makes every contested cell a noisy urn (offer-rate
-    feedback widens the stationary in-degree spread ~10x), while a held
-    epoch gives each (row, bucket) ONE designated winner that the
-    feed/announce traffic has time to install — in-degree concentrates
-    near n/bucket-load. Epoch steps shift the wrap point by 40503 mod n,
-    so only ~load·(40503 mod n)/n buckets change winner per epoch: churn
-    is gradual, and slots held by downed members recover.
+    - raw subject ids: eviction deterministic by id — high ids squat
+      every saturated slot, low ids go globally extinct.
+    - a global time-varying shift: every observer retains the SAME
+      winner subset — only ~K subjects well-known at any instant.
+    - a per-row ADDITIVE rotation `(subj + r(i,e)) % n`: decorrelates
+      observers, but addition only moves the wrap point — the circular
+      ORDER of `{subj}` never changes, so a subject's win share stays
+      pinned to its fixed gap in the bucket ordering and in-degree
+      plateaus unevenly (measured: pv_coverage stuck ~0.97).
+    - XOR by an avalanched per-(row, epoch) mask is a self-inverse
+      bijection on [0, 2^k) that genuinely RE-ORDERS the domain every
+      epoch: win shares re-roll per epoch, so time-averaged retention
+      is uniform across subjects, while within an epoch every (row,
+      bucket) still has one stable designated winner that feed/announce
+      traffic has time to install (in-degree concentrates near
+      n/bucket-load).
 
-    Rows' tables at rest are encoded at rotation r(i, state.t);
-    `tick_impl` re-encodes to t+1 in one elementwise pass, and feed
-    pulls re-encode partner rows into the receiver's rotation."""
+    The mask advances once per `tie_epoch` ticks. Rows' tables at rest
+    are encoded at mask(row, state.t); `tick_impl` re-encodes to t+1 in
+    one elementwise pass, and feed pulls re-encode partner rows into the
+    receiver's mask."""
     rows = jnp.asarray(rows, dtype=jnp.int32)
-    epoch = jnp.int32(t) // jnp.int32(max(1, params.tie_epoch))
-    return (rows * jnp.int32(48271) + epoch * jnp.int32(40503)) % jnp.int32(
-        params.n
+    epoch = (jnp.int32(t) // jnp.int32(max(1, params.tie_epoch))).astype(
+        jnp.uint32
     )
+    mixed = _fmix32(
+        rows.astype(jnp.uint32) * jnp.uint32(2246822519)
+        ^ epoch * jnp.uint32(2654435761)
+    )
+    return (mixed & jnp.uint32(_pow2(params.n) - 1)).astype(jnp.int32)
 
 
 def _pack(params: PViewParams, subj: jax.Array, key: jax.Array, rows, t) -> jax.Array:
-    rot = _rot(params, rows, t)
-    return key * params.n + (subj + rot) % params.n
+    n2 = _pow2(params.n)
+    return key * n2 + (subj ^ _mask(params, rows, t))
 
 
 def _unpack(params: PViewParams, packed: jax.Array, rows, t):
-    rot = _rot(params, rows, t)
-    subj = (packed % params.n - rot) % params.n
-    return subj, packed // params.n  # (subj, key)
+    n2 = _pow2(params.n)
+    subj = (packed % n2) ^ _mask(params, rows, t)
+    return subj, packed // n2  # (subj, key)
 
 
 class PViewState(NamedTuple):
     t: jax.Array  # () int32
     alive: jax.Array  # [N] bool — ground truth process liveness
     inc: jax.Array  # [N] int32 — own incarnation
-    slot_packed: jax.Array  # [N, K] int32 — key*n+subj, 0 = empty
+    slot_packed: jax.Array  # [N, K] int32 — key*P + (subj^mask), 0 = empty
     buf_subj: jax.Array  # [N, B] int32 — gossip buffer (N = empty)
     buf_key: jax.Array  # [N, B] int32
     buf_sent: jax.Array  # [N, B] int32 (INT32_MAX = empty)
