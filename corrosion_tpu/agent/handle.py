@@ -17,6 +17,7 @@ from corrosion_tpu.agent.membership import Membership
 from corrosion_tpu.net.transport import Listener, Transport
 from corrosion_tpu.runtime.channels import Receiver, Sender
 from corrosion_tpu.runtime.config import Config
+from corrosion_tpu.runtime.writegate import PriorityWriteGate
 from corrosion_tpu.runtime.locks import LockRegistry
 from corrosion_tpu.runtime.tripwire import TaskTracker, Tripwire
 from corrosion_tpu.store.bookkeeping import Bookie
@@ -65,7 +66,9 @@ class Agent:
     rx_apply: Receiver
 
     # SplitPool write-permit analog: one writer at a time, waiters queued
-    write_sem: asyncio.Semaphore = field(default_factory=lambda: asyncio.Semaphore(1))
+    # 3-lane priority gate in front of the single write path
+    # (SplitPool's priority/normal/low write queues, agent.rs:478-519)
+    write_gate: PriorityWriteGate = field(default_factory=PriorityWriteGate)
     # ≤3 concurrent inbound sync serves (agent.rs:144-146)
     sync_serve_sem: asyncio.Semaphore = field(default_factory=lambda: asyncio.Semaphore(3))
     change_hooks: List[ChangeHook] = field(default_factory=list)

@@ -90,11 +90,15 @@ async def handle_changes(agent: Agent) -> None:
 
         async def job():
             try:
-                await asyncio.to_thread(
-                    process_multiple_changes,
-                    agent,
-                    [(cv, src) for cv, src, _ in batch],
-                )
+                # remote applies queue on the NORMAL write lane so local
+                # client writes (PRIORITY) overtake a sync burst
+                # (agent.rs:503-519)
+                async with agent.write_gate.normal():
+                    await asyncio.to_thread(
+                        process_multiple_changes,
+                        agent,
+                        [(cv, src) for cv, src, _ in batch],
+                    )
             except Exception:
                 METRICS.counter("corro.agent.changes.processing.failed").inc()
                 for _, _, keys in batch:
@@ -196,7 +200,13 @@ def process_multiple_changes(
     all_impactful = []
     for actor_id in sorted(by_actor, key=lambda a: a.bytes16):
         booked = agent.bookie.ensure(actor_id)
-        with booked.write("process_multiple_changes") as bv:
+        # interruptible: a wedged apply is interrupted at 60 s (the
+        # reference's InterruptibleTransaction timeout on write txs,
+        # sqlite_pool/mod.rs) — the OperationalError propagates, the
+        # caller repairs the seen cache, and the changes re-deliver
+        with agent.store.interrupt_after(60.0), booked.write(
+            "process_multiple_changes"
+        ) as bv:
             snap = bv.snapshot()
             observed = RangeSet()
             to_apply_later: List[int] = []
@@ -291,8 +301,9 @@ async def apply_fully_buffered_loop(agent: Agent) -> None:
         except ChannelClosed:
             break
         actor_id, version = item
-        changes = await asyncio.to_thread(
-            process_fully_buffered, agent, actor_id, version
-        )
+        async with agent.write_gate.normal():
+            changes = await asyncio.to_thread(
+                process_fully_buffered, agent, actor_id, version
+            )
         if changes:
             agent.notify_change_hooks(changes)

@@ -296,7 +296,8 @@ async def make_broadcastable_changes(
     `fn(tx)` executes statements against the WriteTx and returns
     per-statement results.
     """
-    async with agent.write_sem:
+    # local client writes take the PRIORITY lane (agent.rs:586)
+    async with agent.write_gate.priority():
         ts = agent.clock.new_timestamp()
         booked = agent.bookie.ensure(agent.actor_id)
 

@@ -64,6 +64,41 @@ MAX_NEEDS_PER_TURN = 10  # peer/mod.rs: round-robin ≤10 needs/peer/turn
 VERSIONS_PER_CHUNK = 10  # chunk Full ranges to ≤10 versions
 RECV_TIMEOUT = 10.0
 
+# adaptive chunk sizing (peer/mod.rs:444-447, 808-869)
+CHUNK_TARGET_MAX = 8 * 1024  # grow back up to the 8 KiB target
+CHUNK_TARGET_FLOOR = 1024  # never below 1 KiB
+ADAPT_SLOW_SEND_S = 0.5  # halve when one send takes > 500 ms
+ADAPT_GROW = 1.5
+SEND_TIMEOUT = 30.0  # stalled-peer cutoff: frees snapshot conn + permit
+
+
+class AdaptiveChunkSize:
+    """Per-session chunk-size controller: a send that takes longer than
+    500 ms halves the byte target (slow peer / congested path), a fast
+    send grows it ×1.5 back toward 8 KiB, floored at 1 KiB — the
+    reference's policy at `peer/mod.rs:808-869`."""
+
+    def __init__(self):
+        self.target = CHUNK_TARGET_MAX
+
+    def observe(self, send_seconds: float) -> None:
+        if send_seconds > ADAPT_SLOW_SEND_S:
+            self.target = max(CHUNK_TARGET_FLOOR, self.target // 2)
+        else:
+            self.target = min(
+                CHUNK_TARGET_MAX, int(self.target * ADAPT_GROW)
+            )
+        METRICS.gauge("corro.sync.server.chunk_target_bytes").set(self.target)
+
+    async def timed_send(self, stream, frame: bytes) -> None:
+        """Send with the session's send timeout: a peer that stops
+        reading must not pin the server's snapshot read connection (an
+        open reader blocks WAL truncation) nor hold a serve permit
+        forever — the timeout tears the session down instead."""
+        t0 = time.monotonic()
+        await asyncio.wait_for(stream.send(frame), SEND_TIMEOUT)
+        self.observe(time.monotonic() - t0)
+
 
 # -- server ----------------------------------------------------------------
 
@@ -105,6 +140,7 @@ async def _serve_sync_inner(
     await stream.send(encode_sync_msg(state))
 
     sent = 0
+    chunker = AdaptiveChunkSize()  # per-session adaptation state
     while True:
         frame = await asyncio.wait_for(stream.recv(), RECV_TIMEOUT)
         if frame is None:
@@ -117,63 +153,76 @@ async def _serve_sync_inner(
             continue  # unexpected; ignore like unknown requests
         for actor_id, needs in msg:
             for need in needs:
-                sent += await _handle_need(agent, stream, actor_id, need)
+                sent += await _handle_need(
+                    agent, stream, actor_id, need, chunker
+                )
     await stream.finish()
     METRICS.counter("corro.sync.server.changes.sent").inc(sent)
 
 
 async def _handle_need(
-    agent: Agent, stream: BiStream, actor_id: ActorId, need
+    agent: Agent, stream: BiStream, actor_id: ActorId, need,
+    chunker: "AdaptiveChunkSize" = None,
 ) -> int:
     """Serve one need from the store; returns changes sent
     (peer/mod.rs:450-806)."""
     store = agent.store
     sent = 0
+    chunker = chunker or AdaptiveChunkSize()
     if isinstance(need, NeedFull):
         start, end = need.versions
         served = RangeSet()
         loop = asyncio.get_running_loop()
 
-        def read_versions():
+        # Stream ONE version at a time off the executor instead of
+        # materializing the whole range: a large sync holds a single
+        # version's changes in memory (changes_for_versions itself reads
+        # per-version, db_version DESC — peer/mod.rs:620-700)
+        def open_conn():
             # snapshot-isolated read conn: never observe a writer thread's
             # in-flight BEGIN IMMEDIATE on the shared write connection
-            conn = store.read_conn()
-            try:
-                out = []
-                for version, changes in store.changes_for_versions(
-                    actor_id, start, end, conn=conn
-                ):
-                    out.append(
-                        (
-                            version,
-                            changes,
-                            store.last_seq_for_version(
-                                actor_id, version, conn=conn
-                            ),
-                        )
-                    )
-                return out
-            finally:
-                conn.close()
+            return store.read_conn()
 
-        version_iter = await loop.run_in_executor(None, read_versions)
-        for version, changes, last_seq in version_iter:
-            served.insert(version, version)
-            if last_seq is None:
-                last_seq = changes[-1].seq if changes else 0
-            for chunk, seqs in chunk_changes(changes, last_seq):
-                cv = ChangeV1(
-                    actor_id=actor_id,
-                    changeset=ChangesetFull(
-                        version=version,
-                        changes=tuple(chunk),
-                        seqs=seqs,
-                        last_seq=last_seq,
-                        ts=chunk[-1].ts if chunk else Timestamp(0),
-                    ),
+        conn = await loop.run_in_executor(None, open_conn)
+        try:
+            gen = store.changes_for_versions(actor_id, start, end, conn=conn)
+
+            def next_version():
+                try:
+                    version, changes = next(gen)
+                except StopIteration:
+                    return None
+                return (
+                    version,
+                    changes,
+                    store.last_seq_for_version(actor_id, version, conn=conn),
                 )
-                await stream.send(encode_sync_msg(cv))
-                sent += len(chunk)
+
+            while True:
+                item = await loop.run_in_executor(None, next_version)
+                if item is None:
+                    break
+                version, changes, last_seq = item
+                served.insert(version, version)
+                if last_seq is None:
+                    last_seq = changes[-1].seq if changes else 0
+                for chunk, seqs in chunk_changes(
+                    changes, last_seq, max_bytes_fn=lambda: chunker.target
+                ):
+                    cv = ChangeV1(
+                        actor_id=actor_id,
+                        changeset=ChangesetFull(
+                            version=version,
+                            changes=tuple(chunk),
+                            seqs=seqs,
+                            last_seq=last_seq,
+                            ts=chunk[-1].ts if chunk else Timestamp(0),
+                        ),
+                    )
+                    await chunker.timed_send(stream, encode_sync_msg(cv))
+                    sent += len(chunk)
+        finally:
+            await loop.run_in_executor(None, conn.close)
         # versions we know (≤ our head for this actor) but have no live
         # rows for were overwritten/cleared → EmptySet (peer/mod.rs:532-566)
         empties = _empty_versions(agent, actor_id, start, end, served)
@@ -241,7 +290,9 @@ async def _handle_need(
                 if true_last is not None
                 else max(c.seq for c in buffered)
             )
-            for chunk, chunk_seqs in _partial_chunks(chosen, wanted):
+            for chunk, chunk_seqs in _partial_chunks(
+                chosen, wanted, max_bytes_fn=lambda: chunker.target
+            ):
                 cv = ChangeV1(
                     actor_id=actor_id,
                     changeset=ChangesetFull(
@@ -252,13 +303,15 @@ async def _handle_need(
                         ts=chunk[-1].ts if chunk else Timestamp(0),
                     ),
                 )
-                await stream.send(encode_sync_msg(cv))
+                await chunker.timed_send(stream, encode_sync_msg(cv))
                 sent += len(chunk)
         else:
             for version2, changes, last_seq in live:
                 if last_seq is None:
                     last_seq = changes[-1].seq if changes else 0
-                for chunk, seqs in chunk_changes(changes, last_seq):
+                for chunk, seqs in chunk_changes(
+                    changes, last_seq, max_bytes_fn=lambda: chunker.target
+                ):
                     cv = ChangeV1(
                         actor_id=actor_id,
                         changeset=ChangesetFull(
@@ -269,18 +322,21 @@ async def _handle_need(
                             ts=chunk[-1].ts if chunk else Timestamp(0),
                         ),
                     )
-                    await stream.send(encode_sync_msg(cv))
+                    await chunker.timed_send(stream, encode_sync_msg(cv))
                     sent += len(chunk)
     elif isinstance(need, NeedEmpty):
         pass  # informational
     return sent
 
 
-def _partial_chunks(changes, wanted: RangeSet):
-    """Chunk partial-need serves per requested seq range (≤8 KiB each) so
-    each emitted `seqs` range covers exactly a served sub-range
-    (peer/mod.rs:568-614)."""
+def _partial_chunks(changes, wanted: RangeSet, max_bytes_fn=None):
+    """Chunk partial-need serves per requested seq range (≤8 KiB each,
+    adaptive when `max_bytes_fn` is given) so each emitted `seqs` range
+    covers exactly a served sub-range (peer/mod.rs:568-614)."""
     from corrosion_tpu.types.change import MAX_CHANGES_BYTE_SIZE
+
+    if max_bytes_fn is None:
+        max_bytes_fn = lambda: MAX_CHANGES_BYTE_SIZE  # noqa: E731
 
     for rs, re_ in wanted:
         in_range = [c for c in changes if rs <= c.seq <= re_]
@@ -290,7 +346,7 @@ def _partial_chunks(changes, wanted: RangeSet):
         for c in in_range:
             buf.append(c)
             size += c.estimated_byte_size()
-            if size >= MAX_CHANGES_BYTE_SIZE:
+            if size >= max_bytes_fn():
                 yield buf, (start, c.seq)
                 start = c.seq + 1
                 buf, size = [], 0

@@ -216,7 +216,7 @@ class ApiServer:
                 self.agent.store.apply_schema_sql(sql)
 
             try:
-                async with self.agent.write_sem:
+                async with self.agent.write_gate.priority():
                     await asyncio.get_running_loop().run_in_executor(
                         None, apply
                     )

@@ -138,9 +138,12 @@ async def wal_maintenance_loop(agent) -> None:
     attempt = 0
     while not agent.tripwire.tripped:
         try:
-            result = await asyncio.to_thread(
-                truncate_wal_if_needed, agent.store, threshold, attempt
-            )
+            # LOW write lane: maintenance must never delay client writes
+            # or remote applies (agent.rs:503-519 write_low)
+            async with agent.write_gate.low():
+                result = await asyncio.to_thread(
+                    truncate_wal_if_needed, agent.store, threshold, attempt
+                )
             attempt = attempt + 1 if result is False else 0
         except Exception:
             logger.exception("wal maintenance failed")
@@ -158,11 +161,12 @@ async def vacuum_loop(agent) -> None:
     perf = agent.config.perf
     while not agent.tripwire.tripped:
         try:
-            await asyncio.to_thread(
-                incremental_vacuum_if_needed,
-                agent.store,
-                perf.vacuum_min_freelist_pages,
-            )
+            async with agent.write_gate.low():
+                await asyncio.to_thread(
+                    incremental_vacuum_if_needed,
+                    agent.store,
+                    perf.vacuum_min_freelist_pages,
+                )
         except Exception:
             logger.exception("incremental vacuum failed")
         try:

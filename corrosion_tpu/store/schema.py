@@ -174,6 +174,11 @@ class SchemaDiff:
     new_indexes: List[Index] = field(default_factory=list)
     dropped_indexes: List[str] = field(default_factory=list)
     changed_indexes: List[Index] = field(default_factory=list)
+    # tables whose column definitions changed (type/default/nullability):
+    # applied via the 12-step rebuild (schema.rs:528-596) — the user table
+    # is recreated and data copied; clock/rows CRDT state is untouched
+    # because it lives in separate __crdt tables keyed by pk
+    rebuild_tables: List[Table] = field(default_factory=list)
 
 
 def diff_schemas(old: Schema, new: Schema) -> SchemaDiff:
@@ -192,6 +197,8 @@ def diff_schemas(old: Schema, new: Schema) -> SchemaDiff:
                 )
         if ot.pk_cols != t.pk_cols:
             raise SchemaError(f"changing the primary key of {name} is not supported")
+        needs_rebuild = False
+        new_cols: List[Tuple[str, Column, str]] = []
         for cname, c in t.columns.items():
             if cname not in ot.columns:
                 if not c.nullable and c.default is None:
@@ -203,13 +210,24 @@ def diff_schemas(old: Schema, new: Schema) -> SchemaDiff:
                     decl += f" DEFAULT {c.default}"
                 if not c.nullable:
                     decl += " NOT NULL"
-                d.new_columns.append((name, c, decl))
+                new_cols.append((name, c, decl))
             else:
                 oc = ot.columns[cname]
-                if (oc.sql_type or "").upper() != (c.sql_type or "").upper():
-                    raise SchemaError(
-                        f"changing type of {name}.{cname} is not supported yet"
-                    )
+                if (
+                    (oc.sql_type or "").upper() != (c.sql_type or "").upper()
+                    or str(oc.default) != str(c.default)
+                    or oc.nullable != c.nullable
+                ):
+                    # changed column definition → whole-table rebuild
+                    # (schema.rs:528-596), not a refusal
+                    needs_rebuild = True
+        if needs_rebuild:
+            # the rebuild recreates the table from the NEW definition
+            # (including any added columns and its indexes) — don't also
+            # emit piecewise column/index deltas for it
+            d.rebuild_tables.append(t)
+            continue
+        d.new_columns.extend(new_cols)
         # indexes
         for iname, idx in t.indexes.items():
             if iname not in ot.indexes:

@@ -113,11 +113,17 @@ def chunk_changes(
     changes: Iterable[Change],
     last_seq: int,
     max_bytes: int = MAX_CHANGES_BYTE_SIZE,
+    max_bytes_fn=None,
 ) -> Iterator[Tuple[List[Change], Tuple[int, int]]]:
     """Group ordered same-version changes into chunks of ≤ max_bytes,
     preserving contiguous seq coverage across gaps (change.rs:65-177):
     each emitted seq range starts where the previous ended + 1, and the
     final range extends to `last_seq`.
+
+    `max_bytes_fn`, when given, is consulted per chunk — the sync
+    server's adaptive sizing (halve on slow sends, regrow ×1.5;
+    peer/mod.rs:808-869) shrinks or grows the target between chunks of
+    the same version.
 
     Yields (chunk, (seq_start, seq_end)).
     """
@@ -129,7 +135,7 @@ def chunk_changes(
     for ch in it:
         buf.append(ch)
         size += ch.estimated_byte_size()
-        if size >= max_bytes:
+        if size >= (max_bytes_fn() if max_bytes_fn is not None else max_bytes):
             end = buf[-1].seq
             yield buf, (range_start, end)
             last_emitted_end = end

@@ -387,3 +387,64 @@ def test_load_booked_versions_roundtrip():
     bv = a.load_booked_versions(a.site_id)
     assert bv.max == 1
     assert a.booked_actor_ids() == [a.site_id]
+
+
+# -- r3: 12-step column-change table rebuild (schema.rs:528-596)
+
+
+def test_column_type_change_rebuilds_table(tmp_path):
+    store = CrdtStore(str(tmp_path / "r.db"))
+    store.apply_schema_sql(
+        "CREATE TABLE m (id INTEGER PRIMARY KEY, n TEXT, o TEXT);"
+    )
+    with store.write_tx(Timestamp.now()) as tx:
+        tx.execute("INSERT INTO m (id, n, o) VALUES (1, '42', 'keep')")
+        tx.execute("INSERT INTO m (id, n, o) VALUES (2, '7', 'also')")
+
+    # change n's type TEXT -> INTEGER with data present: must rebuild,
+    # not refuse, and must keep both the data and the CRDT clock state
+    clock_before = store._conn.execute(
+        'SELECT COUNT(*) FROM "m__crdt_clock"'
+    ).fetchone()[0]
+    store.apply_schema_sql(
+        "CREATE TABLE m (id INTEGER PRIMARY KEY, n INTEGER, o TEXT);"
+    )
+    rows = store._conn.execute("SELECT id, n, o FROM m ORDER BY id").fetchall()
+    assert [(r["id"], r["n"], r["o"]) for r in rows] == [
+        (1, 42, "keep"),
+        (2, 7, "also"),
+    ]
+    clock_after = store._conn.execute(
+        'SELECT COUNT(*) FROM "m__crdt_clock"'
+    ).fetchone()[0]
+    assert clock_after == clock_before  # replication state untouched
+    assert store.schema.tables["m"].columns["n"].sql_type.upper() == "INTEGER"
+
+    # writes keep replicating after the rebuild (triggers recreated)
+    with store.write_tx(Timestamp.now()) as tx:
+        tx.execute("INSERT INTO m (id, n, o) VALUES (3, 9, 'post')")
+    assert (
+        store._conn.execute(
+            'SELECT COUNT(*) FROM "m__crdt_clock"'
+        ).fetchone()[0]
+        > clock_after
+    )
+    store.close()
+
+
+def test_rebuild_with_added_column_and_default(tmp_path):
+    store = CrdtStore(str(tmp_path / "r2.db"))
+    store.apply_schema_sql("CREATE TABLE m (id INTEGER PRIMARY KEY, a TEXT);")
+    with store.write_tx(Timestamp.now()) as tx:
+        tx.execute("INSERT INTO m (id, a) VALUES (1, 'x')")
+    # change a's default AND add a column in one migration
+    store.apply_schema_sql(
+        "CREATE TABLE m (id INTEGER PRIMARY KEY, a TEXT DEFAULT 'dflt',"
+        " b INTEGER DEFAULT 5);"
+    )
+    row = store._conn.execute("SELECT a, b FROM m WHERE id = 1").fetchone()
+    assert (row["a"], row["b"]) == ("x", 5)
+    store._conn.execute("INSERT INTO m (id) VALUES (99)")
+    row = store._conn.execute("SELECT a, b FROM m WHERE id = 99").fetchone()
+    assert (row["a"], row["b"]) == ("dflt", 5)
+    store.close()

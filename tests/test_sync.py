@@ -133,3 +133,96 @@ def test_roundtrip_two_nodes_converge_needs():
 def test_chunk_range():
     assert chunk_range(1, 25, 10) == [(1, 10), (11, 20), (21, 25)]
     assert chunk_range(5, 5, 10) == [(5, 5)]
+
+
+# -- r3: adaptive chunk sizing + streaming serve (peer/mod.rs:444-447,808-869)
+
+
+def test_adaptive_chunk_policy():
+    from corrosion_tpu.agent.syncer import (
+        ADAPT_SLOW_SEND_S,
+        CHUNK_TARGET_FLOOR,
+        CHUNK_TARGET_MAX,
+        AdaptiveChunkSize,
+    )
+
+    a = AdaptiveChunkSize()
+    assert a.target == CHUNK_TARGET_MAX
+    # slow sends halve…
+    a.observe(ADAPT_SLOW_SEND_S + 0.1)
+    assert a.target == CHUNK_TARGET_MAX // 2
+    a.observe(ADAPT_SLOW_SEND_S + 0.1)
+    assert a.target == CHUNK_TARGET_MAX // 4
+    # …down to the 1 KiB floor
+    for _ in range(10):
+        a.observe(10.0)
+    assert a.target == CHUNK_TARGET_FLOOR
+    # fast sends grow ×1.5 back up to the 8 KiB cap
+    a.observe(0.01)
+    assert a.target == int(CHUNK_TARGET_FLOOR * 1.5)
+    for _ in range(20):
+        a.observe(0.01)
+    assert a.target == CHUNK_TARGET_MAX
+
+
+def test_chunk_changes_consults_target_per_chunk():
+    from corrosion_tpu.types.change import Change, chunk_changes
+
+    changes = [
+        Change(
+            table="t", pk=b"\x01", cid="v", val="x" * 100, col_version=1,
+            db_version=1, seq=i, site_id=b"\x00" * 16, cl=1,
+            ts=Timestamp(0),
+        )
+        for i in range(30)
+    ]
+    targets = iter([200, 200, 10_000, 10_000, 10_000, 10_000, 10_000])
+    current = {"t": 200}
+
+    def fn():
+        current["t"] = next(targets, current["t"])
+        return current["t"]
+
+    chunks = list(chunk_changes(changes, last_seq=29, max_bytes_fn=fn))
+    # first chunks were cut at the small target, later ones at the large
+    sizes = [len(c) for c, _ in chunks]
+    assert sizes[0] < sizes[-1]
+    # seq coverage still contiguous to last_seq
+    assert chunks[0][1][0] == 0
+    for (_, (s1, e1)), (_, (s2, _)) in zip(chunks, chunks[1:]):
+        assert s2 == e1 + 1
+    assert chunks[-1][1][1] == 29
+
+
+def test_changes_for_versions_streams_lazily(tmp_path):
+    """The serve path must not materialize every requested version:
+    pulling one version off the iterator touches only that version's
+    rows (bounded memory on a large sync)."""
+    from corrosion_tpu.store.crdt import CrdtStore
+
+    store = CrdtStore(str(tmp_path / "s.db"))
+    store.apply_schema_sql("CREATE TABLE tt (id INTEGER PRIMARY KEY, v TEXT);")
+    n_versions = 30
+    for i in range(n_versions):
+        with store.write_tx(Timestamp.now()) as tx:
+            tx.execute(
+                "INSERT OR REPLACE INTO tt (id, v) VALUES (?, ?)", (i, f"v{i}")
+            )
+
+    conn = store.read_conn()
+    row_queries = {"n": 0}
+
+    def trace(sql):
+        if "JOIN" in sql:  # the per-version row fetch
+            row_queries["n"] += 1
+
+    conn.set_trace_callback(trace)
+    gen = store.changes_for_versions(store.site_id, 1, n_versions, conn=conn)
+    first = next(gen)
+    assert first[0] == n_versions  # newest first (db_version DESC)
+    # only ONE version's rows were fetched so far (1 table → 1 JOIN query)
+    assert row_queries["n"] == 1, row_queries
+    rest = list(gen)
+    assert len(rest) == n_versions - 1
+    conn.close()
+    store.close()

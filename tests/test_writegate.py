@@ -1,0 +1,131 @@
+"""3-class priority write lanes + interruptible transactions
+(VERDICT r2 missing #8). Ref: `agent.rs:478-519`, `sqlite_pool/mod.rs`.
+"""
+
+import asyncio
+import sqlite3
+import time
+
+import pytest
+
+from corrosion_tpu.runtime.writegate import PriorityWriteGate, WritePriority
+
+
+def test_priority_lane_overtakes_normal_queue():
+    async def main():
+        gate = PriorityWriteGate()
+        order = []
+
+        async def worker(name, lane, hold=0.0):
+            async with gate.lane(lane):
+                order.append(name)
+                if hold:
+                    await asyncio.sleep(hold)
+
+        # occupy the gate, then queue: normal x3, low, THEN priority
+        first = asyncio.ensure_future(
+            worker("hold", WritePriority.NORMAL, hold=0.05)
+        )
+        await asyncio.sleep(0.01)
+        tasks = [
+            asyncio.ensure_future(worker(f"n{i}", WritePriority.NORMAL))
+            for i in range(3)
+        ]
+        tasks.append(asyncio.ensure_future(worker("low", WritePriority.LOW)))
+        await asyncio.sleep(0.01)
+        tasks.append(
+            asyncio.ensure_future(worker("prio", WritePriority.PRIORITY))
+        )
+        await asyncio.gather(first, *tasks)
+        # the late-arriving priority write ran before every queued
+        # normal/low writer; low ran last
+        assert order[0] == "hold"
+        assert order[1] == "prio", order
+        assert order[-1] == "low", order
+
+    asyncio.run(main())
+
+
+def test_gate_fifo_within_lane_and_release_correctness():
+    async def main():
+        gate = PriorityWriteGate()
+        order = []
+
+        async def worker(i):
+            async with gate:
+                order.append(i)
+
+        async with gate:
+            tasks = [asyncio.ensure_future(worker(i)) for i in range(5)]
+            await asyncio.sleep(0.01)
+        await asyncio.gather(*tasks)
+        assert order == list(range(5))
+        assert not gate.locked()
+
+    asyncio.run(main())
+
+
+def test_cancelled_waiter_does_not_leak_permit():
+    async def main():
+        gate = PriorityWriteGate()
+        await gate.acquire()
+
+        async def waiter():
+            await gate.acquire(WritePriority.PRIORITY)
+
+        t = asyncio.ensure_future(waiter())
+        await asyncio.sleep(0.01)
+        t.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await t
+        gate.release()
+        # gate must be acquirable again promptly
+        await asyncio.wait_for(gate.acquire(), 1.0)
+        gate.release()
+
+    asyncio.run(main())
+
+
+def test_local_write_latency_bounded_under_apply_flood():
+    """The starvation test: with the NORMAL lane saturated by simulated
+    remote applies, a PRIORITY local write waits ~one apply, not the
+    whole flood."""
+
+    async def main():
+        gate = PriorityWriteGate()
+        apply_time = 0.02
+        flood = 50
+
+        async def remote_apply():
+            async with gate.normal():
+                await asyncio.sleep(apply_time)
+
+        tasks = [asyncio.ensure_future(remote_apply()) for _ in range(flood)]
+        await asyncio.sleep(apply_time / 2)  # flood in progress
+        t0 = time.monotonic()
+        async with gate.priority():
+            latency = time.monotonic() - t0
+        await asyncio.gather(*tasks)
+        # bounded by ~the in-flight apply, far below flood * apply_time
+        assert latency < 5 * apply_time, latency
+
+    asyncio.run(main())
+
+
+def test_interrupt_after_kills_stuck_statement(tmp_path):
+    from corrosion_tpu.store.crdt import CrdtStore
+
+    store = CrdtStore(str(tmp_path / "i.db"))
+    store.apply_schema_sql("CREATE TABLE t (id INTEGER PRIMARY KEY, v TEXT);")
+    # a pathological query: large cross join, far beyond 0.2s of work
+    with pytest.raises(sqlite3.OperationalError, match="interrupt"):
+        with store.interrupt_after(0.2):
+            store._conn.execute(
+                "WITH RECURSIVE c(x) AS (SELECT 1 UNION ALL SELECT x+1 FROM c)"
+                " SELECT COUNT(*) FROM c LIMIT 1"
+            ).fetchone()
+    # the connection stays usable afterwards
+    with store.write_tx(__import__("corrosion_tpu.types.base", fromlist=["Timestamp"]).Timestamp.now()) as tx:
+        tx.execute("INSERT INTO t (id, v) VALUES (1, 'ok')")
+    assert store._conn.execute("SELECT COUNT(*) FROM t").fetchone()[0] == 1
+    store.close()
