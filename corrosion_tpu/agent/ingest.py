@@ -86,6 +86,7 @@ async def handle_changes(agent: Agent) -> None:
         if not buf:
             return
         batch, buf[:] = buf[:], []
+        METRICS.histogram("corro.agent.changes.batch.size").observe(len(batch))
         await apply_sem.acquire()
 
         async def job():

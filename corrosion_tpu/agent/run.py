@@ -206,6 +206,10 @@ async def run(agent: Agent) -> None:
 
     t.spawn(wal_maintenance_loop(agent))
     t.spawn(vacuum_loop(agent))
+    # periodic per-table/gap/membership gauges (metrics.rs:18-108)
+    from corrosion_tpu.agent.agent_metrics import metrics_loop
+
+    t.spawn(metrics_loop(agent))
     # schedule fully-buffered applies for partials already complete on disk
     for actor_id, booked in agent.bookie.items().items():
         with booked.read() as bv:

@@ -568,6 +568,8 @@ async def sync_loop(agent: Agent, rng: Optional[random.Random] = None) -> None:
         except asyncio.TimeoutError:
             received = 0
         elapsed = max(time.monotonic() - start, 1e-9)
+        METRICS.counter("corro.sync.client.rounds").inc()
+        METRICS.histogram("corro.sync.client.round.seconds").observe(elapsed)
         METRICS.histogram("corro.sync.client.changes_per_sec").observe(
             received / elapsed
         )

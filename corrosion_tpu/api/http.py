@@ -63,7 +63,7 @@ class ApiServer:
         self.addrs: List[str] = []
 
     def build_app(self) -> web.Application:
-        app = web.Application(middlewares=[self._authz])
+        app = web.Application(middlewares=[self._metrics_mw, self._authz])
         app.router.add_post("/v1/transactions", self.h_transactions)
         app.router.add_post("/v1/queries", self.h_queries)
         app.router.add_post("/v1/migrations", self.h_migrations)
@@ -98,6 +98,30 @@ class ApiServer:
             await self._runner.cleanup()
 
     # -- middleware --------------------------------------------------------
+
+    @web.middleware
+    async def _metrics_mw(self, request: web.Request, handler):
+        """Per-endpoint request counters + latency histograms (the
+        reference exports these via axum/metrics middleware)."""
+        start = time.monotonic()
+        endpoint = request.path
+        try:
+            resp = await handler(request)
+            status = resp.status
+            return resp
+        except web.HTTPException as e:
+            status = e.status
+            raise
+        except Exception:
+            status = 500
+            raise
+        finally:
+            METRICS.counter(
+                "corro.api.requests", endpoint=endpoint, status=str(status)
+            ).inc()
+            METRICS.histogram(
+                "corro.api.request.seconds", endpoint=endpoint
+            ).observe(time.monotonic() - start)
 
     @web.middleware
     async def _authz(self, request: web.Request, handler):

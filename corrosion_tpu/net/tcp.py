@@ -161,6 +161,7 @@ class TcpListener(Listener):
     async def _on_tcp_conn(self, reader, writer) -> None:
         peer = writer.get_extra_info("peername")
         peer_addr = f"{peer[0]}:{peer[1]}" if peer else "?"
+        METRICS.counter("corro.transport.accepted").inc()
         try:
             lane = await reader.readexactly(1)
         except (asyncio.IncompleteReadError, ConnectionError):
@@ -172,6 +173,12 @@ class TcpListener(Listener):
                 frame = await _read_frame(reader)
                 if frame is None:
                     break
+                METRICS.counter(
+                    "corro.transport.frames.received", lane="U"
+                ).inc()
+                METRICS.counter(
+                    "corro.transport.bytes.received", lane="U"
+                ).inc(len(frame) + 4)
                 if self._on_uni is not None:
                     await self._on_uni(peer_addr, frame)
             writer.close()
@@ -266,8 +273,12 @@ class TcpTransport(Transport):
                     asyncio.open_connection(host, port), CONNECT_TIMEOUT
                 )
         except (OSError, asyncio.TimeoutError) as e:
+            METRICS.counter("corro.transport.connect.failed").inc()
             raise TransportError(f"connect {addr}: {e}") from e
-        self.observe_rtt(addr, time.monotonic() - start)
+        elapsed = time.monotonic() - start
+        METRICS.counter("corro.transport.connect.total").inc()
+        METRICS.histogram("corro.transport.connect.seconds").observe(elapsed)
+        self.observe_rtt(addr, elapsed)
         writer.write(lane)
         await writer.drain()
         return reader, writer
@@ -285,10 +296,22 @@ class TcpTransport(Transport):
                     self._conns[conn_key] = writer
                 try:
                     await _write_frame(writer, payload)
+                    METRICS.counter(
+                        "corro.transport.frames.sent", lane=lane.decode()
+                    ).inc()
+                    METRICS.counter(
+                        "corro.transport.bytes.sent", lane=lane.decode()
+                    ).inc(len(payload) + 4)
+                    METRICS.gauge("corro.transport.conns.cached").set(
+                        len(self._conns)
+                    )
                     return
                 except (TransportError, ConnectionError, RuntimeError):
                     self._conns.pop(conn_key, None)
                     writer.close()
+                    METRICS.counter(
+                        "corro.transport.send.retried", lane=lane.decode()
+                    ).inc()
                     if attempt:
                         raise
 
@@ -297,6 +320,7 @@ class TcpTransport(Transport):
 
     async def open_bi(self, addr: str) -> BiStream:
         reader, writer = await self._connect(addr, LANE_BI)
+        METRICS.counter("corro.transport.bi.opened").inc()
         return TcpBiStream(reader, writer, addr)
 
     async def close(self) -> None:

@@ -34,6 +34,9 @@ class Sender(Generic[T]):
         start = time.monotonic()
         await self._ch.queue.put(item)
         METRICS.counter("corro.channel.message.sent", channel=self._ch.name).inc()
+        METRICS.gauge(
+            "corro.channel.queue.depth", channel=self._ch.name
+        ).set(self._ch.queue.qsize())
         METRICS.histogram(
             "corro.channel.message.send.delay.seconds", channel=self._ch.name
         ).observe(time.monotonic() - start)

@@ -134,3 +134,37 @@ def test_task_tracker():
         assert tracker.pending == 0
 
     asyncio.run(main())
+
+
+def test_agent_metrics_collection(tmp_path):
+    """The periodic metrics loop (metrics.rs:18-108 counterpart) produces
+    per-table, gap/buffered and membership gauges from a live agent."""
+    import asyncio
+
+    from corrosion_tpu.agent.agent_metrics import collect_once
+    from corrosion_tpu.agent.run import run, setup, shutdown
+    from corrosion_tpu.runtime.config import Config
+    from corrosion_tpu.runtime.metrics import METRICS
+
+    async def main():
+        cfg = Config()
+        cfg.db.path = str(tmp_path / "m.db")
+        cfg.gossip.bind_addr = "127.0.0.1:0"
+        agent = await setup(cfg)
+        agent.store.apply_schema_sql(
+            "CREATE TABLE mt (id INTEGER PRIMARY KEY, v TEXT);"
+        )
+        await run(agent)
+        collect_once(agent)
+        await shutdown(agent)
+
+    asyncio.run(main())
+    exposition = METRICS.render_prometheus()
+    for needle in (
+        'corro_db_table_rows{table="mt"}',
+        "corro_db_gaps_count",
+        "corro_db_buffered_changes_rows",
+        "corro_bookie_actors",
+        "corro_gossip_cluster_size",
+    ):
+        assert needle in exposition, needle
