@@ -104,7 +104,11 @@ class ApiServer:
         """Per-endpoint request counters + latency histograms (the
         reference exports these via axum/metrics middleware)."""
         start = time.monotonic()
-        endpoint = request.path
+        # canonical route template, NOT the raw path: parameterized
+        # routes (/v1/subscriptions/{id}) and unauthenticated path spray
+        # must not mint unbounded metric label values
+        resource = request.match_info.route.resource if request.match_info else None
+        endpoint = resource.canonical if resource is not None else "unmatched"
         try:
             resp = await handler(request)
             status = resp.status

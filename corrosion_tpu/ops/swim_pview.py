@@ -108,9 +108,14 @@ class PViewParams(NamedTuple):
     identity_hash: bool = False
 
 
+def _keycap(n: int) -> int:
+    """Key-field capacity of the packed word: keys occupy the LOW part."""
+    return 2**31 // _pow2(n)
+
+
 def inc_cap(n: int) -> int:
     """Largest incarnation representable in the packed word for n."""
-    return (2**31 // _pow2(n) - 1) // 4 - 1
+    return (_keycap(n) - 7) // 4
 
 
 def _hash(params: PViewParams, subj: jax.Array) -> jax.Array:
@@ -177,14 +182,25 @@ def _mask(params: PViewParams, rows, t) -> jax.Array:
 
 
 def _pack(params: PViewParams, subj: jax.Array, key: jax.Array, rows, t) -> jax.Array:
-    n2 = _pow2(params.n)
-    return key * n2 + (subj ^ _mask(params, rows, t))
+    """packed = (subj ^ mask) * KEYCAP + key.
+
+    Field order matters: the masked SUBJECT field is the HIGH part.
+    Same-subject entries always share a cell (same hash), so within a
+    cell the max still orders by key — the protocol merge. But when two
+    DIFFERENT subjects contend for a slot, the comparison is decided by
+    the masked fields alone, never by key: eviction fairness is
+    incarnation-independent. (With key as the high part, a member that
+    refuted to a high incarnation would permanently evict low-inc
+    bucket-mates everywhere — measured post-heal: one member pinned at
+    in-degree 0.)"""
+    kc = _keycap(params.n)
+    return (subj ^ _mask(params, rows, t)) * kc + key
 
 
 def _unpack(params: PViewParams, packed: jax.Array, rows, t):
-    n2 = _pow2(params.n)
-    subj = (packed % n2) ^ _mask(params, rows, t)
-    return subj, packed // n2  # (subj, key)
+    kc = _keycap(params.n)
+    subj = (packed // kc) ^ _mask(params, rows, t)
+    return subj, packed % kc  # (subj, key)
 
 
 class PViewState(NamedTuple):
@@ -202,6 +218,8 @@ class PViewState(NamedTuple):
     susp_subj: jax.Array  # [N, S] int32 (N = empty)
     susp_inc: jax.Array  # [N, S] int32
     susp_deadline: jax.Array  # [N, S] int32
+    partition: jax.Array  # [N] int32 — network partition group (see
+    # swim.SwimState.partition; same split-brain semantics)
 
 
 def init_state(
@@ -246,6 +264,7 @@ def init_state(
         susp_subj=jnp.full((n, s), n, dtype=jnp.int32),
         susp_inc=jnp.zeros((n, s), dtype=jnp.int32),
         susp_deadline=jnp.zeros((n, s), dtype=jnp.int32),
+        partition=jnp.zeros(n, dtype=jnp.int32),
     )
 
 
@@ -299,6 +318,7 @@ def tick_impl(
     packed = state.slot_packed
     inc = state.inc
     alive = state.alive
+    part = state.partition
     buf_subj, buf_key, buf_sent = state.buf_subj, state.buf_key, state.buf_sent
     susp_subj = state.susp_subj
     susp_inc = state.susp_inc
@@ -338,11 +358,15 @@ def tick_impl(
     expire1 = (phase == 1) & (t >= pdl) & alive
     fail1 = expire1 & ~pok
     helpers = jax.random.randint(r_helpers, (n, params.indirect_probes), 0, n)
-    tgt_alive = alive[jnp.clip(psubj, 0, n - 1)] & (psubj < n)
+    psafe_t = jnp.clip(psubj, 0, n - 1)
+    tgt_alive = alive[psafe_t] & (psubj < n)
     leg = jax.random.uniform(
         r_ack, (n, params.indirect_probes + 1)
     ) >= params.loss
-    helper_ok = alive[helpers] & leg[:, 1:] & tgt_alive[:, None]
+    helper_reach = (part[helpers] == part[:, None]) & (
+        part[helpers] == part[psafe_t][:, None]
+    )
+    helper_ok = alive[helpers] & leg[:, 1:] & tgt_alive[:, None] & helper_reach
     ind_ok = jnp.any(helper_ok, axis=1)
     phase = jnp.where(fail1, 2, jnp.where(expire1, 0, phase))
     pok = jnp.where(fail1, ind_ok, pok)
@@ -353,7 +377,10 @@ def tick_impl(
         params, packed, idx, r_probe, params.probe_candidates, t
     )
     will = start & (target < n)
-    direct_ok = alive[jnp.clip(target, 0, n - 1)] & (target < n) & leg[:, 0]
+    tsafe = jnp.clip(target, 0, n - 1)
+    direct_ok = (
+        alive[tsafe] & (target < n) & leg[:, 0] & (part[tsafe] == part)
+    )
     phase = jnp.where(will, 1, phase)
     psubj = jnp.where(will, target, psubj)
     pdl = jnp.where(will, t + params.direct_timeout, pdl)
@@ -409,15 +436,17 @@ def tick_impl(
         sendable = jnp.concatenate([sendable, ae_key > 0], axis=1)
         m = m + ae
 
+    tg_safe = jnp.clip(tg, 0, n - 1)
     msg_ok = (
         sendable[:, None, :]
         & valid_tgt[:, :, None]
         & alive[:, None, None]
-        & alive[jnp.clip(tg, 0, n - 1)][:, :, None]
+        & alive[tg_safe][:, :, None]
+        & (part[tg_safe] == part[:, None])[:, :, None]
     )
     drop = jax.random.uniform(r_loss, msg_ok.shape) < params.loss
     msg_ok = msg_ok & ~drop
-    dst = jnp.broadcast_to(jnp.clip(tg, 0, n - 1)[:, :, None], msg_ok.shape)
+    dst = jnp.broadcast_to(tg_safe[:, :, None], msg_ok.shape)
     subj = jnp.broadcast_to(send_subj[:, None, :], msg_ok.shape)
     key = jnp.broadcast_to(send_key[:, None, :], msg_ok.shape)
     dst = jnp.where(msg_ok, dst, n).reshape(-1)
@@ -433,15 +462,17 @@ def tick_impl(
     # receiver's row with one row-aligned max scatter
     fe = min(params.feed_entries, k)
     nfeeds = params.feeds_per_tick
+    steps_per_sweep = -(-k // fe) if fe > 0 else 1
     if fe > 0 and nfeeds > 0:
-        steps_per_sweep = -(-k // fe)
         spacing = max(1, steps_per_sweep // nfeeds)
 
         def one_feed(fk, pk):
             r_feed = jax.random.fold_in(r_gossip, 104729 + fk)
             partner = _pick_known_alive(params, pk, idx, r_feed, 2, t)
             psafe = jnp.clip(partner, 0, n - 1)
-            has_partner = (partner < n) & alive & alive[psafe]
+            has_partner = (
+                (partner < n) & alive & alive[psafe] & (part[psafe] == part)
+            )
             j = (t + fk * spacing) % steps_per_sweep
             w = jnp.minimum(j * fe, k - fe)
             vw = jax.lax.dynamic_slice(pk, (jnp.int32(0), w), (n, fe))
@@ -458,6 +489,25 @@ def tick_impl(
             return pk.at[idx[:, None], cols].max(repacked)
 
         packed = jax.lax.fori_loop(0, nfeeds, one_feed, packed)
+
+    # ---- 4c. bootstrap-seed exchange (see swim.py 4c: the reference's
+    # always-running bootstrap announcer; without it a healed partition
+    # never re-merges) ------------------------------------------------------
+    if fe > 0:
+        seed_off = 1 + (t // jnp.int32(max(1, params.announce_period))) % 3
+        sp = (idx + seed_off) % n
+        seed_ok = alive & alive[sp] & (part[sp] == part)
+        j = t % steps_per_sweep
+        w = jnp.minimum(j * fe, k - fe)
+        vw = jax.lax.dynamic_slice(packed, (jnp.int32(0), w), (n, fe))
+        pulled = jnp.take(vw, sp, axis=0)
+        pulled = jnp.where(seed_ok[:, None], pulled, 0)
+        p_subj, p_key = _unpack(params, pulled, sp[:, None], t)
+        repacked = jnp.where(
+            pulled > 0, _pack(params, p_subj, p_key, idx[:, None], t), 0
+        )
+        cols = _hash(params, p_subj)
+        packed = packed.at[idx[:, None], cols].max(repacked)
 
     # ---- 5. refutation (inbox + own slot) --------------------------------
     about_self = (in_subj == idx[:, None]) & (key_prec(in_key) >= PREC_SUSPECT)
@@ -537,6 +587,7 @@ def tick_impl(
         susp_subj=susp_subj,
         susp_inc=susp_inc,
         susp_deadline=susp_deadline,
+        partition=part,
     )
 
 
@@ -568,6 +619,11 @@ def set_alive(state: PViewState, member: int, value: bool) -> PViewState:
     alive = state.alive.at[member].set(value)
     inc = jnp.where(value, state.inc.at[member].add(1), state.inc)
     return state._replace(alive=alive, inc=inc)
+
+
+def set_partition(state: PViewState, groups) -> PViewState:
+    """Partition injection (see swim.set_partition)."""
+    return state._replace(partition=jnp.asarray(groups, dtype=jnp.int32))
 
 
 @functools.partial(jax.jit, static_argnames=("params",))

@@ -67,7 +67,16 @@ class SubEvent:
 
 def sql_hash(sql: str) -> str:
     """Dedupe key for subscriptions: also the `corro-query-hash` header
-    (the single definition — manager.py re-exports it)."""
+    (the single definition — manager.py re-exports it).
+
+    DIVERGENCE from the reference: the reference hashes the SQL with
+    seahash (`pubsub.rs:565`) while this uses sha256 truncated to 16 hex
+    chars. The value is opaque to this framework's own client
+    (`client.py` only echoes it back), but a reference-client that
+    compares `corro-query-hash` against a locally computed seahash will
+    NOT match. Wire-parity for this header is explicitly not claimed;
+    if it ever is, swap in a seahash implementation here and in the
+    client in lockstep."""
     import hashlib
 
     return hashlib.sha256(sql.encode()).hexdigest()[:16]

@@ -109,6 +109,25 @@ CREATE TABLE IF NOT EXISTS __corro_state (key TEXT PRIMARY KEY, value ANY);
 """
 
 
+def _corro_json_contains(selector, obj) -> bool:
+    """Custom SQL scalar `corro_json_contains(selector, object)`: true if
+    every key of the JSON selector appears in the JSON object with a
+    recursively-contained value; non-objects compare by equality
+    (reference `klukai-types/src/sqlite.rs:237-274`). Used by operators
+    to filter rows on JSON columns, e.g. consul service meta."""
+    import json
+
+    def contains(s, o) -> bool:
+        if isinstance(s, dict) and isinstance(o, dict):
+            return all(k in o and contains(v, o[k]) for k, v in s.items())
+        return s == o
+
+    try:
+        return contains(json.loads(selector), json.loads(obj))
+    except (ValueError, TypeError):
+        raise sqlite3.OperationalError("corro_json_contains: invalid JSON")
+
+
 def _clock_table(t: str) -> str:
     return f"{t}__crdt_clock"
 
@@ -253,6 +272,10 @@ class CrdtStore:
         # has identical semantics
         from corrosion_tpu import native
 
+        conn.create_function(
+            "corro_json_contains", 2, _corro_json_contains,
+            deterministic=True,
+        )
         if not native.load_into(conn):
             conn.create_function(
                 "crdt_pack", -1, _sql_pack, deterministic=True
@@ -290,6 +313,12 @@ class CrdtStore:
         conn = sqlite3.connect(self.path, check_same_thread=False, uri=True)
         conn.row_factory = sqlite3.Row
         conn.execute("PRAGMA query_only = ON")
+        # custom SQL fns must exist on READ connections too — that is
+        # where /v1/queries and the pubsub matcher run user SQL
+        conn.create_function(
+            "corro_json_contains", 2, _corro_json_contains,
+            deterministic=True,
+        )
         return conn
 
     def close(self) -> None:

@@ -448,3 +448,30 @@ def test_rebuild_with_added_column_and_default(tmp_path):
     row = store._conn.execute("SELECT a, b FROM m WHERE id = 99").fetchone()
     assert (row["a"], row["b"]) == ("dflt", 5)
     store.close()
+
+
+def test_corro_json_contains(tmp_path):
+    """Custom SQL fn parity (sqlite.rs:237-274) — present on BOTH the
+    write connection and read connections (the /v1/queries + pubsub
+    paths run user SQL on read conns)."""
+    store = CrdtStore(str(tmp_path / "j.db"))
+    rconn = store.read_conn()
+    assert rconn.execute(
+        "SELECT corro_json_contains(?, ?)", ('{"a": 1}', '{"a": 1}')
+    ).fetchone()[0] == 1
+    rconn.close()
+    q = lambda sel, obj: store._conn.execute(
+        "SELECT corro_json_contains(?, ?)", (sel, obj)
+    ).fetchone()[0]
+    assert q('{"a": 1}', '{"a": 1, "b": 2}') == 1
+    assert q('{"a": 1, "b": 2}', '{"a": 1}') == 0
+    assert q('{"a": {"x": 1}}', '{"a": {"x": 1, "y": 2}, "b": 0}') == 1
+    assert q('{"a": {"x": 2}}', '{"a": {"x": 1, "y": 2}}') == 0
+    assert q('"s"', '"s"') == 1
+    assert q("1", "2") == 0
+    assert q("{}", '{"anything": true}') == 1
+    import sqlite3 as s3
+    import pytest as pt
+    with pt.raises(s3.OperationalError):
+        q("not json", "{}")
+    store.close()

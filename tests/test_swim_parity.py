@@ -188,10 +188,11 @@ def test_parity_bootstrap_convergence():
         return ev_t
 
     ev_t = asyncio.run(main())
-    # same order of magnitude: neither path takes 5× the other's periods
-    # (both must anyway land inside the same CONVERGE_PERIODS budget)
+    # both land inside the shared budget AND within 2x of each other
+    # (measured: sim 3 vs ev ~2.7 periods — the paths share the same
+    # protocol cadence, so a real regression shows up well before 2x)
     assert sim_t <= CONVERGE_PERIODS and ev_t <= CONVERGE_PERIODS
-    assert max(sim_t, ev_t) / max(1.0, min(sim_t, ev_t)) <= 5.0, (
+    assert max(sim_t, ev_t) / max(1.0, min(sim_t, ev_t)) <= 2.0, (
         sim_t,
         ev_t,
     )
@@ -234,9 +235,14 @@ def test_parity_failure_detection_window():
         return ev_det
 
     ev_det = asyncio.run(main())
-    # both detect after the suspicion window opens and inside the slack
-    assert sim_det <= DETECT_PERIODS * 3
-    assert ev_det <= DETECT_PERIODS * 3
+    # the suspicion-window arithmetic both paths share: detection can
+    # only complete AFTER the suspicion window elapses (probe + window)
+    # and must land inside window + gossip slack; the two paths must
+    # agree within one suspicion window of each other (measured: sim 10
+    # vs ev ~8.9 periods)
+    assert SUSPICION_PERIODS <= sim_det <= DETECT_PERIODS, sim_det
+    assert SUSPICION_PERIODS <= ev_det <= DETECT_PERIODS, ev_det
+    assert abs(sim_det - ev_det) <= SUSPICION_PERIODS, (sim_det, ev_det)
 
 
 def test_parity_no_false_positives_under_loss():

@@ -162,8 +162,10 @@ def test_inc_cap_math():
     for n in (1_000_000, 262_144, 1000):
         cap = swim_pview.inc_cap(n)
         n2 = swim_pview._pow2(n)
+        kc = swim_pview._keycap(n)
         worst_key = swim.make_key(cap, swim.PREC_DOWN)
-        assert worst_key * n2 + (n2 - 1) < 2**31
+        assert worst_key < kc
+        assert (n2 - 1) * kc + worst_key < 2**31
 
 
 def test_retention_fairness_under_load():
