@@ -174,5 +174,9 @@ async def _send_one(agent: Agent, actor: Actor, payload: bytes) -> None:
     try:
         await agent.transport.send_uni(actor.addr, payload)
         METRICS.counter("corro.broadcast.sent").inc()
+        from corrosion_tpu.runtime.invariants import assert_sometimes
+
+        # ref assert_sometimes "changes broadcast" (broadcast.rs:642)
+        assert_sometimes("changes broadcast")
     except TransportError:
         METRICS.counter("corro.broadcast.send.failed").inc()

@@ -568,6 +568,12 @@ async def sync_loop(agent: Agent, rng: Optional[random.Random] = None) -> None:
         except asyncio.TimeoutError:
             received = 0
         elapsed = max(time.monotonic() - start, 1e-9)
+        if received:
+            from corrosion_tpu.runtime.invariants import assert_sometimes
+
+            # ref assert_sometimes "Corrosion syncs with other nodes"
+            # (handlers.rs:840)
+            assert_sometimes("syncs with other nodes")
         METRICS.counter("corro.sync.client.rounds").inc()
         METRICS.histogram("corro.sync.client.round.seconds").observe(elapsed)
         METRICS.histogram("corro.sync.client.changes_per_sec").observe(

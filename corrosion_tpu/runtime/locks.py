@@ -82,6 +82,14 @@ class LockRegistry:
                         meta.id, meta.label, meta.kind, meta.state, held,
                     )
                 if held > _INVARIANT_HELD_S:
+                    from corrosion_tpu.runtime.invariants import assert_always
+
+                    # ref assert_always: no lock held past 60s (setup.rs:231)
+                    assert_always(
+                        False,
+                        "locks.held_under_60s",
+                        {"label": meta.label, "held_s": round(held, 1)},
+                    )
                     METRICS.counter(
                         "corro_lock_held_over_invariant", label=meta.label
                     ).inc()

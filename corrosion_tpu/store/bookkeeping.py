@@ -112,6 +112,18 @@ class VersionsSnapshot:
             store.delete_gap(self.actor_id, s, e)
         for s, e in rows_after - rows_before:
             store.insert_gap(self.actor_id, s, e)
+        # gap deletion must be effective: no observed version may remain
+        # needed after the algebra runs (ref assert_always, agent.rs:1144)
+        from corrosion_tpu.runtime.invariants import assert_always
+
+        assert_always(
+            not any(
+                next(self.needed.overlapping(s, e), None) is not None
+                for s, e in versions
+            ),
+            "gaps.observed_versions_not_needed",
+            {"actor": str(self.actor_id)},
+        )
 
     def insert_gaps(self, versions: Iterable[Range]) -> None:
         for s, e in versions:

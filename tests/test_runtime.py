@@ -168,3 +168,87 @@ def test_agent_metrics_collection(tmp_path):
         "corro_gossip_cluster_size",
     ):
         assert needle in exposition, needle
+
+
+def test_invariant_hooks():
+    """Antithesis-style always/sometimes layer (SURVEY §4): strict mode
+    raises, log mode counts, markers register."""
+    import os
+
+    import pytest as pt
+
+    from corrosion_tpu.runtime import invariants as inv
+
+    old = os.environ.get(inv._MODE_ENV)
+    try:
+        os.environ[inv._MODE_ENV] = "strict"
+        assert inv.assert_always(True, "fine") is True
+        with pt.raises(inv.InvariantViolation):
+            inv.assert_always(False, "broken", {"k": 1})
+        with pt.raises(inv.InvariantViolation):
+            inv.assert_unreachable("nope")
+
+        os.environ[inv._MODE_ENV] = "log"
+        assert inv.assert_always(False, "soft") is False  # no raise
+
+        inv.reset_sometimes()
+        inv.assert_sometimes("covered")
+        inv.assert_sometimes("not-this-one", condition=False)
+        reg = inv.sometimes_registry()
+        assert reg.get("covered") == 1
+        assert "not-this-one" not in reg
+    finally:
+        if old is None:
+            os.environ.pop(inv._MODE_ENV, None)
+        else:
+            os.environ[inv._MODE_ENV] = old
+
+
+def test_invariants_hold_under_replication_workload(tmp_path):
+    """Run a two-node replication workload under strict invariants: the
+    woven assert_always sites must hold, and the sometimes markers must
+    actually fire (the Antithesis coverage contract)."""
+    import asyncio
+    import os
+
+    from corrosion_tpu.runtime import invariants as inv
+
+    old = os.environ.get(inv._MODE_ENV)
+    os.environ[inv._MODE_ENV] = "strict"
+    inv.reset_sometimes()
+    try:
+        from tests.test_agent import (
+            TEST_SCHEMA,
+            boot,
+            count_rows,
+            insert,
+            wait_until,
+        )
+        from corrosion_tpu.agent.run import shutdown
+        from corrosion_tpu.net.mem import MemNetwork
+
+        async def main():
+            net = MemNetwork(seed=21)
+            a = await boot(net, "inv-a")
+            b = await boot(net, "inv-b", bootstrap=["inv-a"])
+            try:
+                assert await wait_until(
+                    lambda: all(
+                        ag.membership.cluster_size == 2 for ag in (a, b)
+                    )
+                )
+                for i in range(5):
+                    await insert(a, i, f"row{i}")
+                assert await wait_until(lambda: count_rows(b) == 5)
+            finally:
+                for ag in (a, b):
+                    await shutdown(ag)
+
+        asyncio.run(main())
+        fired = inv.sometimes_registry()
+        assert fired.get("changes broadcast", 0) > 0, fired
+    finally:
+        if old is None:
+            os.environ.pop(inv._MODE_ENV, None)
+        else:
+            os.environ[inv._MODE_ENV] = old
