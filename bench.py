@@ -46,28 +46,45 @@ def child_main() -> None:
     n = int(os.environ.get("BENCH_N", "10000"))
     target = float(os.environ.get("BENCH_COVERAGE", "0.999"))
     # Feed bandwidth W = fe*F entries pulled per member per tick sized at
-    # ~n/5: convergence needs ~log2(n) spaced visits per subject, i.e.
-    # ticks ≈ log2(n) * n/W + gossip floor (measured: 176 ticks at n=10k).
+    # ~n/4: convergence needs ~log2(n) spaced visits per subject, i.e.
+    # ticks ≈ log2(n) * n/W + gossip floor (measured: 150 ticks at n=10k).
     # Few LARGE windows beat many small ones — same pulled volume, fewer
-    # slice dispatches (r3 profile).
+    # slice dispatches (r3 profile, PROFILE.md).
     feeds = max(1, int(os.environ.get("BENCH_FEEDS", "4")))
-    fe = max(25, n // (5 * feeds))
+    fe = max(25, n // (4 * feeds))
+    # boot-convergence-tuned gossip widths: during a mass boot the feed
+    # carries the bulk transfer, so trimmed gossip/probe widths shave
+    # ~20% off the tick without changing the tick count (measured sweep
+    # at n=10k, PROFILE.md)
+    params = dict(
+        feeds_per_tick=feeds,
+        feed_entries=fe,
+        piggyback=4,
+        incoming_slots=8,
+        buffer_slots=12,
+        probe_candidates=2,
+        antientropy=1,
+    )
 
     record_every = int(os.environ.get("BENCH_RECORD_EVERY", "50"))
     # compile warm-up on a THROWAWAY sim (same shapes/static args), so the
     # measured cluster starts cold at tick 0 — warming up the real state
     # would advance convergence before the clock starts
-    warm = ClusterSim(n, seed=1, feeds_per_tick=feeds, feed_entries=fe)
+    warm = ClusterSim(n, seed=1, **params)
     warm.step(record_every)
+    warm.step(10)  # the fine-phase chunk compiles too
     warm.stats()
     del warm
 
-    sim = ClusterSim(n, seed=0, feeds_per_tick=feeds, feed_entries=fe)
+    sim = ClusterSim(n, seed=0, **params)
     jax.block_until_ready(sim.state.view)
 
     t0 = time.monotonic()
     stable_tick = sim.run_until_stable(
-        coverage_target=target, max_ticks=5000, record_every=record_every
+        coverage_target=target,
+        max_ticks=5000,
+        record_every=record_every,
+        fine_every=10,
     )
     elapsed = time.monotonic() - t0
     stats = sim.stats()

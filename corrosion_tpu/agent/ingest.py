@@ -274,20 +274,20 @@ def _process_one(agent, actor_id, cv, bv, observed, to_apply_later) -> list:
 
 def process_fully_buffered(agent: Agent, actor_id: ActorId, version: int):
     """Drain a completed buffered version into the store (util.rs:552-700)."""
-    from corrosion_tpu.runtime.invariants import assert_always, assert_sometimes
+    from corrosion_tpu.runtime import invariants
 
     store = agent.store
     changes = store.take_buffered_version(actor_id, version)
-    if changes:
+    if changes and invariants.enabled():
         # seqs of a fully-buffered version must be gap-free before the
         # drain (ref assert_always "contiguous seq ranges", util.rs:1170)
         seqs = sorted(c.seq for c in changes)
-        assert_always(
+        invariants.assert_always(
             all(b - a <= 1 for a, b in zip(seqs, seqs[1:])),
             "buffered.seqs_contiguous",
             {"actor": str(actor_id), "version": version},
         )
-        assert_sometimes("buffered version drained")
+        invariants.assert_sometimes("buffered version drained")
     impactful = []
     if changes:
         applied = store.apply_changes(changes)

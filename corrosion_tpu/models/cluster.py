@@ -76,15 +76,23 @@ class ClusterSim:
         coverage_target: float = 0.999,
         max_ticks: int = 10_000,
         record_every: int = 1,
+        fine_every: Optional[int] = None,
+        fine_threshold: float = 0.9,
     ) -> Optional[int]:
         """Advance up to `max_ticks` further steps until live-member
         coverage reaches the target; returns the (global) tick count at
         stability or None. Records metric history. Tick counting is
-        host-side so no device readback happens between stats checks."""
+        host-side so no device readback happens between stats checks.
+
+        With `fine_every`, stepping switches to the smaller chunk once
+        coverage crosses `fine_threshold` — coarse chunks amortize
+        dispatch early on, fine chunks avoid overshooting the target by
+        most of a coarse chunk at the end."""
         start = time.monotonic()
         done = 0
+        step_size = record_every
         while done < max_ticks:
-            batch = min(record_every, max_ticks - done)
+            batch = min(step_size, max_ticks - done)
             self.step(batch)
             done += batch
             s = self.stats()
@@ -99,6 +107,8 @@ class ClusterSim:
             )
             if s["coverage"] >= coverage_target:
                 return self.ticks
+            if fine_every is not None and s["coverage"] >= fine_threshold:
+                step_size = fine_every
         return None
 
     def run_until_detected(

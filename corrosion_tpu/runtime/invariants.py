@@ -48,6 +48,13 @@ def _mode() -> str:
     return os.environ.get(_MODE_ENV, "off")
 
 
+def enabled() -> bool:
+    """Fast-path guard for call sites whose CONDITION is expensive to
+    compute: `if invariants.enabled(): assert_always(costly(), ...)`.
+    Off mode (the production default) then costs one env lookup."""
+    return _mode() != "off"
+
+
 def assert_always(
     condition: bool, name: str, details: Optional[dict] = None
 ) -> bool:

@@ -82,17 +82,30 @@ class LockRegistry:
                         meta.id, meta.label, meta.kind, meta.state, held,
                     )
                 if held > _INVARIANT_HELD_S:
-                    from corrosion_tpu.runtime.invariants import assert_always
-
-                    # ref assert_always: no lock held past 60s (setup.rs:231)
-                    assert_always(
-                        False,
-                        "locks.held_under_60s",
-                        {"label": meta.label, "held_s": round(held, 1)},
+                    from corrosion_tpu.runtime.invariants import (
+                        InvariantViolation,
+                        assert_always,
                     )
+
+                    # metric FIRST — it must fire even in strict mode
                     METRICS.counter(
                         "corro_lock_held_over_invariant", label=meta.label
                     ).inc()
+                    # ref assert_always: no lock held past 60s
+                    # (setup.rs:231). Contained: strict mode must not
+                    # kill the watchdog task itself — the violation is
+                    # recorded and monitoring continues
+                    try:
+                        assert_always(
+                            False,
+                            "locks.held_under_60s",
+                            {"label": meta.label, "held_s": round(held, 1)},
+                        )
+                    except InvariantViolation:
+                        log.error(
+                            "lock invariant violated (watchdog continues): "
+                            "%s held %.1fs", meta.label, held,
+                        )
             warned &= set(self._live)
 
 

@@ -113,17 +113,19 @@ class VersionsSnapshot:
         for s, e in rows_after - rows_before:
             store.insert_gap(self.actor_id, s, e)
         # gap deletion must be effective: no observed version may remain
-        # needed after the algebra runs (ref assert_always, agent.rs:1144)
-        from corrosion_tpu.runtime.invariants import assert_always
+        # needed after the algebra runs (ref assert_always, agent.rs:1144).
+        # Condition guarded by enabled(): off mode must not pay the scan
+        from corrosion_tpu.runtime import invariants
 
-        assert_always(
-            not any(
-                next(self.needed.overlapping(s, e), None) is not None
-                for s, e in versions
-            ),
-            "gaps.observed_versions_not_needed",
-            {"actor": str(self.actor_id)},
-        )
+        if invariants.enabled():
+            invariants.assert_always(
+                not any(
+                    next(self.needed.overlapping(s, e), None) is not None
+                    for s, e in versions
+                ),
+                "gaps.observed_versions_not_needed",
+                {"actor": str(self.actor_id)},
+            )
 
     def insert_gaps(self, versions: Iterable[Range]) -> None:
         for s, e in versions:
