@@ -10,7 +10,10 @@ it on gap or disconnect (`sub.rs:328-388`).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
+import sqlite3
+import threading
 from typing import Any, AsyncIterator, Dict, List, Optional
 
 import aiohttp
@@ -211,3 +214,68 @@ async def _body_json(resp) -> Any:
         return json.loads(raw)
     except json.JSONDecodeError:
         return raw
+
+
+class CorrosionClient(CorrosionApiClient):
+    """API client + a direct read-only sqlite pool over the agent's local
+    database file — the reference's `CorrosionClient`
+    (`klukai-client/src/lib.rs:365-403`): writes go through the HTTP API
+    (the only correct write path), while local reads skip HTTP entirely.
+    The consul-sync sidecar is the canonical user.
+
+    The pool holds up to `pool_size` lazily-opened read-only connections
+    (reference default 5); `read()` checks one out as a context manager.
+    """
+
+    def __init__(
+        self,
+        addr: str,
+        db_path: str,
+        token: Optional[str] = None,
+        pool_size: int = 5,
+    ):
+        super().__init__(addr, token=token)
+        self.db_path = db_path
+        self._pool_size = pool_size
+        self._pool: List["sqlite3.Connection"] = []
+        self._pool_lock = threading.Lock()
+
+    def _open_read_conn(self):
+        import sqlite3
+
+        conn = sqlite3.connect(
+            f"file:{self.db_path}?mode=ro",
+            uri=True,
+            check_same_thread=False,
+        )
+        conn.row_factory = sqlite3.Row
+        return conn
+
+    @contextlib.contextmanager
+    def read(self):
+        """Check a read-only connection out of the local pool."""
+        with self._pool_lock:
+            conn = self._pool.pop() if self._pool else None
+        if conn is None:
+            conn = self._open_read_conn()
+        try:
+            yield conn
+        finally:
+            with self._pool_lock:
+                if len(self._pool) < self._pool_size:
+                    self._pool.append(conn)
+                    conn = None
+            if conn is not None:
+                conn.close()
+
+    def local_query(self, sql: str, params=()) -> List[tuple]:
+        """Convenience: run a read-only query against the local db."""
+        with self.read() as conn:
+            return [tuple(r) for r in conn.execute(sql, params).fetchall()]
+
+    async def close(self) -> None:
+        await super().close()
+        with self._pool_lock:
+            for conn in self._pool:
+                conn.close()
+            self._pool.clear()

@@ -242,3 +242,40 @@ def test_db_lock_runs_command_under_lock(tmp_path):
     r = run_cli(["-c", cfg, "db", "lock", "echo locked-ok"])
     assert r.returncode == 0, r.stderr
     assert "locked-ok" in r.stdout
+
+
+def test_corrosion_client_local_read_pool(tmp_path):
+    """CorrosionClient (klukai-client lib.rs:365-403): API client + direct
+    read-only sqlite pool over the local db file."""
+    import asyncio
+
+    from corrosion_tpu.client import CorrosionClient
+    from corrosion_tpu.store.crdt import CrdtStore
+    from corrosion_tpu.types.base import Timestamp
+
+    db = str(tmp_path / "local.db")
+    store = CrdtStore(db)
+    store.apply_schema_sql("CREATE TABLE lt (id INTEGER PRIMARY KEY, v TEXT);")
+    with store.write_tx(Timestamp.now()) as tx:
+        tx.execute("INSERT INTO lt (id, v) VALUES (1, 'direct')")
+    store.close()
+
+    async def main():
+        client = CorrosionClient("127.0.0.1:1", db)  # API addr unused here
+        rows = client.local_query("SELECT id, v FROM lt")
+        assert rows == [(1, "direct")]
+        # read-only: writes through the pool must fail
+        import sqlite3 as s3
+
+        import pytest as pt
+
+        with client.read() as conn, pt.raises(s3.OperationalError):
+            conn.execute("INSERT INTO lt (id, v) VALUES (2, 'nope')")
+        # pool reuse: same connection object comes back
+        with client.read() as c1:
+            first = id(c1)
+        with client.read() as c2:
+            assert id(c2) == first
+        await client.close()
+
+    asyncio.run(main())
