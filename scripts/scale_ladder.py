@@ -166,16 +166,22 @@ def rung2() -> None:
 
 def rung3() -> None:
     n = int(os.environ.get("LADDER_R3_N", "10000"))
-    feeds = max(4, n // (25 * 50))
-    sim = ClusterSim(n, seed=0, feeds_per_tick=feeds)
+    # bench.py's boot-tuned configuration (W = n/4 feed bandwidth, few
+    # large windows, trimmed gossip widths — PROFILE.md)
+    sim = ClusterSim(
+        n, seed=0, feeds_per_tick=4, feed_entries=max(25, n // 16),
+        piggyback=4, incoming_slots=8, buffer_slots=12,
+        probe_candidates=2, antientropy=1,
+    )
     sim.step()
+    sim.step(5)  # compile the 5-tick scan BEFORE timing it
     jax.block_until_ready(sim.state.view)
     # steady-state per-tick cost (the number that scales to TPU)
     t0 = time.monotonic()
     sim.step(5)
     jax.block_until_ready(sim.state.view)
     per_tick = (time.monotonic() - t0) / 5
-    tick, wall = _converge(sim, every=10)
+    tick, wall = _converge(sim, every=50)
     s = sim.stats()
     emit(
         3,
