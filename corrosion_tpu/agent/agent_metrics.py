@@ -23,7 +23,7 @@ COLLECT_INTERVAL_S = 10.0
 def collect_once(agent) -> None:
     """One synchronous collection pass (runs on a worker thread)."""
     store = agent.store
-    conn = store.read_conn()
+    conn = store.acquire_read()
     try:
         # per-table data + clock-table sizes (metrics.rs:18-60); the
         # "invalid table" signal is clock rows far exceeding data rows
@@ -57,7 +57,7 @@ def collect_once(agent) -> None:
         ).fetchone()[0]
         METRICS.gauge("corro.db.members.persisted").set(members)
     finally:
-        conn.close()
+        store.release_read(conn)
 
     # host-side state gauges (no db access)
     METRICS.gauge("corro.bookie.actors").set(len(agent.bookie.items()))

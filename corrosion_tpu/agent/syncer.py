@@ -179,9 +179,9 @@ async def _handle_need(
         # version's changes in memory (changes_for_versions itself reads
         # per-version, db_version DESC — peer/mod.rs:620-700)
         def open_conn():
-            # snapshot-isolated read conn: never observe a writer thread's
-            # in-flight BEGIN IMMEDIATE on the shared write connection
-            return store.read_conn()
+            # snapshot-isolated pooled read conn: never observe a writer
+            # thread's in-flight BEGIN IMMEDIATE on the write connection
+            return store.acquire_read()
 
         conn = await loop.run_in_executor(None, open_conn)
         try:
@@ -222,7 +222,7 @@ async def _handle_need(
                     await chunker.timed_send(stream, encode_sync_msg(cv))
                     sent += len(chunk)
         finally:
-            await loop.run_in_executor(None, conn.close)
+            await loop.run_in_executor(None, store.release_read, conn)
         # versions we know (≤ our head for this actor) but have no live
         # rows for were overwritten/cleared → EmptySet (peer/mod.rs:532-566)
         empties = _empty_versions(agent, actor_id, start, end, served)
@@ -238,7 +238,7 @@ async def _handle_need(
         version = need.version
 
         def read_partial():
-            conn = store.read_conn()
+            conn = store.acquire_read()
             try:
                 buffered = store.take_buffered_version(
                     actor_id, version, conn=conn
@@ -267,7 +267,7 @@ async def _handle_need(
                         )
                 return buffered, true_last, covered, live
             finally:
-                conn.close()
+                store.release_read(conn)
 
         (
             buffered,

@@ -199,7 +199,7 @@ class ApiServer:
             def run_query():
                 from corrosion_tpu.runtime.trace import timed_query
 
-                conn = self.agent.store.read_conn()
+                conn = self.agent.store.acquire_read()
                 try:
                     with timed_query(stmt.query):
                         cur = conn.execute(
@@ -213,7 +213,7 @@ class ApiServer:
                     rows = cur.fetchall()
                     return cols, rows
                 finally:
-                    conn.close()
+                    self.agent.store.release_read(conn)
 
             try:
                 cols, rows = await loop.run_in_executor(None, run_query)
@@ -272,7 +272,7 @@ class ApiServer:
                 tables = list(self.agent.store.schema.tables)
 
             def stats():
-                conn = self.agent.store.read_conn()
+                conn = self.agent.store.acquire_read()
                 try:
                     total = 0
                     invalid = []
@@ -291,7 +291,7 @@ class ApiServer:
                             invalid.append(t)
                     return total, invalid
                 finally:
-                    conn.close()
+                    self.agent.store.release_read(conn)
 
             total, invalid = await asyncio.get_running_loop().run_in_executor(
                 None, stats

@@ -121,7 +121,7 @@ class SubsManager:
         via match_changes_from_db_version, updates.rs:490)."""
         from corrosion_tpu.types.pack import pack_columns
 
-        conn = self.store.read_conn()
+        conn = self.store.acquire_read()
         try:
             for t in handle.matcher.parsed.tables:
                 pks = self.store.schema.table(t.name).pk_cols
@@ -134,7 +134,7 @@ class SubsManager:
                         handle._queue.put_nowait, {t.name: cands}
                     )
         finally:
-            conn.close()
+            self.store.release_read(conn)
 
     def _read_meta_sql(self, db: Path) -> str:
         conn = sqlite3.connect(db)

@@ -247,6 +247,7 @@ class CrdtStore:
         self.site_id: ActorId = sid
         self.schema: Schema = Schema()
         self._pk_unpack_cache: Dict[bytes, tuple] = {}
+        self._read_pool: List[sqlite3.Connection] = []
         self._watchdog = _InterruptWatchdog(self._conn)
         self._load_schema()
 
@@ -307,6 +308,34 @@ class CrdtStore:
         finally:
             self._watchdog.disarm(token)
 
+    READ_POOL_MAX = 20  # SplitPool read side: 20 RO conns (agent.rs:478)
+
+    def acquire_read(self) -> sqlite3.Connection:
+        """Check a read connection out of the pool (or open a fresh one).
+        Return it with `release_read`, or use `pooled_read()`."""
+        with self._lock:
+            if self._read_pool:
+                return self._read_pool.pop()
+        return self.read_conn()
+
+    def release_read(self, conn: sqlite3.Connection) -> None:
+        with self._lock:
+            if len(self._read_pool) < self.READ_POOL_MAX:
+                self._read_pool.append(conn)
+                return
+        conn.close()
+
+    @contextlib.contextmanager
+    def pooled_read(self):
+        """Context-managed pooled read connection — the SplitPool read
+        side (1 RW + 20 RO, agent.rs:478-519): hot read paths (queries,
+        sync serves, metrics) skip per-call sqlite connection setup."""
+        conn = self.acquire_read()
+        try:
+            yield conn
+        finally:
+            self.release_read(conn)
+
     def read_conn(self) -> sqlite3.Connection:
         """A new read connection (WAL snapshot isolation for file stores,
         shared cache for in-memory). Caller closes."""
@@ -322,6 +351,10 @@ class CrdtStore:
         return conn
 
     def close(self) -> None:
+        with self._lock:
+            for conn in self._read_pool:
+                conn.close()
+            self._read_pool.clear()
         self._conn.close()
 
     # -- schema ------------------------------------------------------------

@@ -475,3 +475,22 @@ def test_corro_json_contains(tmp_path):
     with pt.raises(s3.OperationalError):
         q("not json", "{}")
     store.close()
+
+
+def test_pooled_read_connections(tmp_path):
+    """SplitPool read side (agent.rs:478-519): pooled RO conns are reused
+    and capped at READ_POOL_MAX; pool drains on close."""
+    store = CrdtStore(str(tmp_path / "p.db"))
+    store.apply_schema_sql("CREATE TABLE pt (id INTEGER PRIMARY KEY);")
+    with store.pooled_read() as c1:
+        first = id(c1)
+        assert c1.execute("SELECT COUNT(*) FROM pt").fetchone()[0] == 0
+    with store.pooled_read() as c2:
+        assert id(c2) == first  # reused
+    # cap: release more than READ_POOL_MAX and the extras close
+    conns = [store.acquire_read() for _ in range(store.READ_POOL_MAX + 3)]
+    for c in conns:
+        store.release_read(c)
+    assert len(store._read_pool) == store.READ_POOL_MAX
+    store.close()
+    assert not store._read_pool
