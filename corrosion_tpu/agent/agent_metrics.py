@@ -56,7 +56,10 @@ def collect_once(agent) -> None:
             "SELECT COUNT(*) FROM __corro_members"
         ).fetchone()[0]
         METRICS.gauge("corro.db.members.persisted").set(members)
-    finally:
+    except BaseException:
+        store.release_read(conn, discard=True)
+        raise
+    else:
         store.release_read(conn)
 
     # host-side state gauges (no db access)

@@ -184,6 +184,7 @@ async def _handle_need(
             return store.acquire_read()
 
         conn = await loop.run_in_executor(None, open_conn)
+        ok = False
         try:
             gen = store.changes_for_versions(actor_id, start, end, conn=conn)
 
@@ -221,8 +222,11 @@ async def _handle_need(
                     )
                     await chunker.timed_send(stream, encode_sync_msg(cv))
                     sent += len(chunk)
+            ok = True
         finally:
-            await loop.run_in_executor(None, store.release_read, conn)
+            # a send error abandons the half-consumed generator: its open
+            # cursor pins the conn's read snapshot, so discard, not pool
+            await loop.run_in_executor(None, store.release_read, conn, not ok)
         # versions we know (≤ our head for this actor) but have no live
         # rows for were overwritten/cleared → EmptySet (peer/mod.rs:532-566)
         empties = _empty_versions(agent, actor_id, start, end, served)
@@ -266,7 +270,10 @@ async def _handle_need(
                             )
                         )
                 return buffered, true_last, covered, live
-            finally:
+            except BaseException:
+                store.release_read(conn, discard=True)
+                raise
+            else:
                 store.release_read(conn)
 
         (

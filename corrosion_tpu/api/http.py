@@ -212,7 +212,10 @@ class ApiServer:
                     )
                     rows = cur.fetchall()
                     return cols, rows
-                finally:
+                except BaseException:
+                    self.agent.store.release_read(conn, discard=True)
+                    raise
+                else:
                     self.agent.store.release_read(conn)
 
             try:

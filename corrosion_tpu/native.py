@@ -68,51 +68,106 @@ def extension_path() -> Optional[str]:
     with _lock:
         if _built is not None:
             return _built or None
-        # Reuse the cached .so only when a recorded content hash of the
-        # source matches — mtimes are arbitrary after a fresh clone, and a
-        # stale or tampered binary must never be silently loaded.
-        src_hash = (
-            hashlib.sha256(_SRC.read_bytes()).hexdigest()
-            if _SRC.exists()
-            else ""
-        )
-        hash_file = _SO.with_suffix(".so.srchash")
-        if _SO.exists() and hash_file.exists() and src_hash:
-            if hash_file.read_text().strip() == src_hash:
-                _built = str(_SO)
-                return _built
         include = _sqlite_include_dir()
-        if include is None or not _SRC.exists():
+        if include is None:
             log.warning("native crdt extension unavailable: no sqlite headers")
             _built = ""
             return None
-        _BUILD_DIR.mkdir(parents=True, exist_ok=True)
-        tmp = _SO.with_suffix(".so.tmp")
-        cmd = [
-            "g++", "-O2", "-fPIC", "-shared", "-std=c++17",
-            f"-I{include}",
-            str(_SRC), "-o", str(tmp),
-        ]
-        try:
-            subprocess.run(
-                cmd, check=True, capture_output=True, text=True, timeout=120
-            )
-            os.replace(tmp, _SO)
-            _built = str(_SO)
-            log.info("built native crdt extension at %s", _SO)
-        except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
-                OSError) as e:
-            detail = getattr(e, "stderr", "") or str(e)
-            log.warning("native crdt extension build failed: %s", detail[:500])
-            _built = ""
+        path = _build_so(_SRC, _SO, include=include)
+        _built = path or ""
+        return path
+
+
+_BATCH_SRC = _SRC.parent / "crdt_batch.cpp"
+_BATCH_SO = _BUILD_DIR / "crdt_batch.so"
+
+_batch_lock = threading.Lock()
+_batch_lib = None  # ctypes.CDLL, or False = unavailable (don't retry)
+
+
+def _build_so(src: Path, so: Path, include: Optional[Path] = None) -> Optional[str]:
+    """Hash-gated g++ shared-library build (shared by the SQLite extension
+    and the batch-merge library): reuse the cached .so only when the
+    recorded content hash of the source matches — mtimes are arbitrary
+    after a fresh clone, and a stale or tampered binary must never be
+    silently loaded."""
+    src_hash = hashlib.sha256(src.read_bytes()).hexdigest() if src.exists() else ""
+    hash_file = so.with_suffix(".so.srchash")
+    if so.exists() and hash_file.exists() and src_hash:
+        if hash_file.read_text().strip() == src_hash:
+            return str(so)
+    if not src.exists():
+        return None
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    tmp = so.with_suffix(".so.tmp")
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17"]
+    if include is not None:
+        cmd.append(f"-I{include}")
+    cmd += [str(src), "-o", str(tmp)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True, timeout=120)
+        os.replace(tmp, so)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired, OSError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        log.warning("native build of %s failed: %s", src.name, detail[:500])
+        return None
+    try:
+        # Best-effort: a failed hash write must not disable the freshly
+        # built library — it only costs a rebuild next process.
+        hash_file.write_text(src_hash)
+    except OSError as e:
+        log.warning("could not record native source hash: %s", e)
+    return str(so)
+
+
+def merge_batch_lib():
+    """ctypes handle to the columnar CRDT merge engine
+    (`native/crdt_batch.cpp::crdt_merge_batch`), or None when the native
+    path is unavailable.  Built once per process, content-hash gated."""
+    global _batch_lib
+    with _batch_lock:
+        if _batch_lib is not None:
+            return _batch_lib or None
+        import ctypes
+
+        path = _build_so(_BATCH_SRC, _BATCH_SO)
+        if path is None:
+            _batch_lib = False
             return None
         try:
-            # Best-effort: a failed hash write must not disable the freshly
-            # built extension — it only costs a rebuild next process.
-            hash_file.write_text(src_hash)
-        except OSError as e:
-            log.warning("could not record native ext source hash: %s", e)
-        return _built
+            lib = ctypes.CDLL(path)
+            fn = lib.crdt_merge_batch
+        except (OSError, AttributeError) as e:
+            log.warning("could not load native batch-merge library: %s", e)
+            _batch_lib = False
+            return None
+        c = ctypes
+        fn.restype = c.c_int
+        fn.argtypes = [
+            # batch
+            c.c_int32, c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+            c.POINTER(c.c_int64), c.POINTER(c.c_int64),
+            c.POINTER(c.c_uint8), c.POINTER(c.c_int64), c.POINTER(c.c_double),
+            c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.c_char_p,
+            # snapshot
+            c.c_int32, c.POINTER(c.c_int64),
+            c.c_int32, c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+            c.POINTER(c.c_int64),
+            # disk values
+            c.c_int32, c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+            c.POINTER(c.c_uint8), c.POINTER(c.c_int64), c.POINTER(c.c_double),
+            c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.c_char_p,
+            # outputs
+            c.POINTER(c.c_uint8),
+            c.POINTER(c.c_int64), c.POINTER(c.c_uint8),
+            c.POINTER(c.c_int32),
+            c.POINTER(c.c_int32), c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+            c.POINTER(c.c_int32),
+            c.POINTER(c.c_int32), c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+            c.POINTER(c.c_int32),
+        ]
+        _batch_lib = lib
+        return lib
 
 
 def load_into(conn) -> bool:

@@ -133,7 +133,10 @@ class SubsManager:
                     handle.loop.call_soon_threadsafe(
                         handle._queue.put_nowait, {t.name: cands}
                     )
-        finally:
+        except BaseException:
+            self.store.release_read(conn, discard=True)
+            raise
+        else:
             self.store.release_read(conn)
 
     def _read_meta_sql(self, db: Path) -> str:

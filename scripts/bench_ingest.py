@@ -56,7 +56,7 @@ def run(mode: str, changes, batch: int, tmp: str) -> float:
     st = CrdtStore(path)
     st.apply_schema_sql(SCHEMA)
     t0 = time.monotonic()
-    if mode == "batched":
+    if mode in ("batched", "native"):
         for i in range(0, len(changes), batch):
             st.apply_changes(changes[i : i + batch])
     else:
@@ -78,12 +78,22 @@ def main() -> None:
     changes = gen(n, n_pks=max(100, n // 50))
     with tempfile.TemporaryDirectory() as tmp:
         per_row = run("per_row", changes, batch, tmp)
+        os.environ["CORRO_NATIVE_BATCH"] = "0"
         batched = run("batched", changes, batch, tmp)
+        os.environ["CORRO_NATIVE_BATCH"] = "1"
+        from corrosion_tpu import native as native_mod
+
+        native = (
+            run("native", changes, batch, tmp)
+            if native_mod.merge_batch_lib() is not None
+            else 0.0
+        )
     print(
         f"ingest throughput n={n} batch={batch}: "
         f"per_row={per_row:,.0f} changes/s  "
         f"batched={batched:,.0f} changes/s  "
-        f"speedup={batched / per_row:.2f}x"
+        f"native={native:,.0f} changes/s  "
+        f"speedup={(native or batched) / per_row:.2f}x"
     )
 
 
