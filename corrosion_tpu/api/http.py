@@ -293,7 +293,10 @@ class ApiServer:
                         if clock_n > n:
                             invalid.append(t)
                     return total, invalid
-                finally:
+                except BaseException:
+                    self.agent.store.release_read(conn, discard=True)
+                    raise
+                else:
                     self.agent.store.release_read(conn)
 
             total, invalid = await asyncio.get_running_loop().run_in_executor(

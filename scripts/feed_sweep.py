@@ -1,0 +1,86 @@
+"""Find the true convergence tick of the bench config for a given
+feeds_per_tick (the bench's 50-tick stats cadence can overshoot by up to
+49 ticks). Stats are only checked inside the expected convergence window
+so the probe itself stays cheap.
+
+Usage: python scripts/feed_sweep.py <feeds> [n] [start] [step] [stop]
+Appends one line to FEED_SWEEP.txt at the repo root.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from corrosion_tpu.runtime import jaxenv  # noqa: E402
+
+# JAX_PLATFORMS=cpu alone is NOT enough: with the TPU plugin still on
+# PYTHONPATH a fresh `import jax` can hang in plugin discovery (see
+# jaxenv). Re-exec under the known-good stripped CPU env.
+if os.environ.get("FEED_SWEEP_CHILD") != "1":
+    import subprocess
+
+    env = jaxenv.stripped_env()
+    env["FEED_SWEEP_CHILD"] = "1"
+    sys.exit(
+        subprocess.run(
+            [sys.executable, "-u", os.path.abspath(__file__)] + sys.argv[1:],
+            env=env,
+        ).returncode
+    )
+
+from corrosion_tpu.models.cluster import ClusterSim  # noqa: E402
+
+
+def main() -> None:
+    import jax
+
+    feeds = int(sys.argv[1])
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+    start = int(sys.argv[3]) if len(sys.argv) > 3 else 70
+    step = int(sys.argv[4]) if len(sys.argv) > 4 else 10
+    stop = int(sys.argv[5]) if len(sys.argv) > 5 else 300
+    fe = max(25, n // (4 * feeds))
+    params = dict(
+        feeds_per_tick=feeds, feed_entries=fe, piggyback=4,
+        incoming_slots=8, buffer_slots=12, probe_candidates=2, antientropy=1,
+    )
+    # ONE scan length for every dispatch — each distinct chunk size is a
+    # separate (slow) XLA compile on this host
+    warm = ClusterSim(n, seed=1, **params)
+    warm.step(step)
+    warm.stats()
+    del warm
+
+    sim = ClusterSim(n, seed=0, **params)
+    jax.block_until_ready(sim.state.view)
+    t0 = time.monotonic()
+    ticks = 0
+    line = None
+    while ticks < stop:
+        sim.step(step)
+        ticks += step
+        if ticks < start:
+            continue
+        wall = time.monotonic() - t0
+        s = sim.stats()
+        if s["coverage"] >= 0.999:
+            line = (
+                f"n={n} feeds={feeds} fe={fe}: tick={ticks} "
+                f"tick_wall={wall:.1f}s cov={s['coverage']:.5f} "
+                f"fp={s['false_positive']}"
+            )
+            break
+    if line is None:
+        line = f"n={n} feeds={feeds} fe={fe}: NOT converged by {ticks}"
+    print(line, flush=True)
+    with open(os.path.join(REPO, "FEED_SWEEP.txt"), "a") as f:
+        f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -218,3 +218,56 @@ def test_feeds_disabled_config_still_ticks():
     ps = swim_pview.init_state(pp, jax.random.PRNGKey(0))
     out = swim_pview.tick(ps, jax.random.PRNGKey(1), pp)
     assert int(out.t) == 1
+
+
+def test_view_key_saturation_preserves_precedence():
+    """The int16 view clamp must never change a key's precedence class:
+    a saturated ALIVE key stays ALIVE, DOWN stays DOWN (review finding:
+    a min()-style clamp decoded as SUSPECT and re-registered as improved
+    forever). In-range keys pass through untouched."""
+    import numpy as np
+
+    for prec in (swim.PREC_ALIVE, swim.PREC_SUSPECT, swim.PREC_DOWN):
+        # in-range: identity
+        k = swim.make_key(swim.INC_CAP, prec)
+        stored = int(swim.to_view_key(jnp.int32(k)))
+        assert stored == k
+        assert int(swim.key_prec(jnp.int16(stored))) == prec
+        # out-of-range: saturates, same precedence
+        k_over = swim.make_key(swim.INC_CAP + 500, prec)
+        stored = int(swim.to_view_key(jnp.int32(k_over)))
+        assert int(swim.key_prec(jnp.int16(stored))) == prec
+        assert stored <= np.iinfo(np.int16).max
+        assert stored > 0
+
+
+def test_refutation_incarnation_caps():
+    """Refutation increments saturate at INC_CAP so generated keys always
+    fit the int16 view: below the cap a suspected member refutes normally
+    (bumps inc, self entry returns to ALIVE); AT the cap the bump cannot
+    exceed the suspicion's incarnation, so the suspicion stands — the
+    accepted saturation trade-off (reaching inc 8189 needs thousands of
+    refutation cycles; real SWIM incarnations stay in the tens)."""
+    params = swim.SwimParams(n=8)
+
+    def suspected_at(inc0):
+        state = swim.init_state(params, jax.random.PRNGKey(0))
+        state = state._replace(
+            inc=state.inc.at[1].set(inc0),
+            view=state.view.at[1, 1].set(
+                swim.to_view_key(
+                    jnp.int32(swim.make_key(inc0, swim.PREC_SUSPECT))
+                )
+            ),
+        )
+        return swim.tick(state, jax.random.PRNGKey(1), params)
+
+    # below the cap: refutation bumps inc and restores ALIVE precedence
+    out = suspected_at(swim.INC_CAP - 10)
+    assert int(out.inc[1]) == swim.INC_CAP - 9
+    assert int(swim.key_prec(out.view[1, 1])) == swim.PREC_ALIVE
+
+    # at the cap: inc saturates and the suspicion stands
+    out = suspected_at(swim.INC_CAP)
+    assert int(out.inc[1]) == swim.INC_CAP
+    assert int(swim.key_prec(out.view[1, 1])) == swim.PREC_SUSPECT
