@@ -219,7 +219,16 @@ class TcpListener(Listener):
 
 class TcpTransport(Transport):
     """Client side: shares the listener's UDP socket so replies carry the
-    right source address; caches one uni-lane TCP connection per peer."""
+    right source address; caches one uni-lane TCP connection per peer.
+
+    Deliberate deviation from the reference: quinn's client side spreads
+    connections over 8 UDP sockets hashed by peer to dilute per-socket
+    kernel buffer pressure (transport.rs:57-71). Here the gossip plane is
+    one asyncio datagram endpoint per node — SWIM packets are ≤1178 B at
+    ~1/s/peer, the asyncio loop drains the socket on every wakeup, and
+    the single bound port doubles as the node's reply identity; sharding
+    sends across extra sockets would buy nothing at this layer while
+    complicating addr-based peer bookkeeping."""
 
     def __init__(self, listener: TcpListener, ssl_context=None):
         self._listener = listener

@@ -160,6 +160,40 @@ def ensure_usable_backend(
     return platform
 
 
+def reexec_under_cpu(
+    child_flag: str,
+    n_devices: int | None = None,
+    timeout: float | None = None,
+    prefer_inherited_probe_s: float | None = None,
+) -> None:
+    """Measurement-script preamble: re-exec this script as a child under
+    a known-good env, then `sys.exit` with its return code. No-op (returns)
+    when ``child_flag`` is already set in the environment.
+
+    By default the child gets :func:`stripped_env` (plugin removed, CPU
+    forced, optional virtual device count) — `JAX_PLATFORMS=cpu` alone is
+    NOT safe with the TPU plugin on PYTHONPATH (import can hang in plugin
+    discovery). With ``prefer_inherited_probe_s``, the inherited env is
+    probed first and kept when it exposes a live non-CPU backend (the
+    scale-ladder policy: run on the real chip when the tunnel is up).
+    """
+    if os.environ.get(child_flag) == "1":
+        return
+    env = None
+    if prefer_inherited_probe_s is not None:
+        if probe(None, prefer_inherited_probe_s) not in (None, "cpu"):
+            env = os.environ.copy()
+    if env is None:
+        env = stripped_env(n_devices=n_devices)
+    env[child_flag] = "1"
+    proc = subprocess.run(
+        [sys.executable, "-u", os.path.abspath(sys.argv[0])] + sys.argv[1:],
+        env=env,
+        timeout=timeout,
+    )
+    sys.exit(proc.returncode)
+
+
 def run_python(
     code: str,
     env: dict[str, str],

@@ -21,17 +21,7 @@ from corrosion_tpu.runtime import jaxenv  # noqa: E402
 # JAX_PLATFORMS=cpu alone is NOT enough: with the TPU plugin still on
 # PYTHONPATH a fresh `import jax` can hang in plugin discovery (see
 # jaxenv). Re-exec under the known-good stripped CPU env.
-if os.environ.get("FEED_SWEEP_CHILD") != "1":
-    import subprocess
-
-    env = jaxenv.stripped_env()
-    env["FEED_SWEEP_CHILD"] = "1"
-    sys.exit(
-        subprocess.run(
-            [sys.executable, "-u", os.path.abspath(__file__)] + sys.argv[1:],
-            env=env,
-        ).returncode
-    )
+jaxenv.reexec_under_cpu("FEED_SWEEP_CHILD")
 
 from corrosion_tpu.models.cluster import ClusterSim  # noqa: E402
 

@@ -29,17 +29,11 @@ sys.path.insert(0, REPO)
 
 from corrosion_tpu.runtime import jaxenv  # noqa: E402
 
-if os.environ.get("PVIEW_SCALE_CHILD") != "1":
-    import subprocess
-
-    env = jaxenv.stripped_env(n_devices=8)
-    env["PVIEW_SCALE_CHILD"] = "1"
-    proc = subprocess.run(
-        [sys.executable, "-u", os.path.abspath(__file__)] + sys.argv[1:],
-        env=env,
-        timeout=float(os.environ.get("PVIEW_SCALE_BUDGET_S", "3000")),
-    )
-    sys.exit(proc.returncode)
+jaxenv.reexec_under_cpu(
+    "PVIEW_SCALE_CHILD",
+    n_devices=8,
+    timeout=float(os.environ.get("PVIEW_SCALE_BUDGET_S", "3000")),
+)
 
 import jax  # noqa: E402
 
@@ -182,8 +176,18 @@ def main():
     rung_a(int(sys.argv[1]) if len(sys.argv) > 1 else 8192)
     rung_b(int(sys.argv[2]) if len(sys.argv) > 2 else 262_144)
     rung_c()
-    with open(os.path.join(REPO, "PVIEW_SCALE.json"), "w") as f:
-        json.dump(results, f, indent=2)
+    # merge-write: other scripts (pview_1m.py) record their own rungs in
+    # the same file — replace only the rungs this run re-measured
+    path = os.path.join(REPO, "PVIEW_SCALE.json")
+    try:
+        with open(path) as f:
+            existing = json.load(f)
+    except (OSError, ValueError):
+        existing = []
+    mine = {r["rung"] for r in results}
+    merged = [r for r in existing if r.get("rung") not in mine] + results
+    with open(path, "w") as f:
+        json.dump(merged, f, indent=2)
 
 
 if __name__ == "__main__":

@@ -7,8 +7,8 @@ Rungs (BASELINE.json `configs`):
   3. 10k-member batched SWIM on a single device
   4. member-sharded kernel over an 8-device mesh at the largest
      host-feasible size, plus the 100k memory/extrapolation math
-     (a real 100k run needs a v5e-8's HBM; the [N,N] int32 view is 40 GB
-     sharded to 5 GB/chip — infeasible on a CPU host, validated here by
+     (a real 100k run needs a v5e-8's HBM; the [N,N] int16 view is 19 GB
+     sharded to 2.3 GB/chip — infeasible on a CPU host, validated here by
      running the identical sharded program at smaller N)
 
 Usage:  python scripts/scale_ladder.py [rung ...]   (default: all)
@@ -32,20 +32,14 @@ from corrosion_tpu.runtime import jaxenv  # noqa: E402
 # Re-exec under the known-good CPU env when the inherited backend is
 # unusable (same policy as bench.py). An 8-device count serves rung 4;
 # single-device rungs ignore the extra devices.
-if os.environ.get("SCALE_LADDER_CHILD") != "1":
-    import subprocess
-
-    env = (
-        os.environ.copy()
-        if jaxenv.probe(None, float(os.environ.get("BENCH_PROBE_S", "60")))
-        not in (None, "cpu")
-        else jaxenv.stripped_env(n_devices=8)
-    )
-    env["SCALE_LADDER_CHILD"] = "1"
-    proc = subprocess.run([sys.executable, "-u"] + sys.argv, env=env)
-    sys.exit(proc.returncode)
+jaxenv.reexec_under_cpu(
+    "SCALE_LADDER_CHILD",
+    n_devices=8,
+    prefer_inherited_probe_s=float(os.environ.get("BENCH_PROBE_S", "60")),
+)
 
 import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
 
 from corrosion_tpu.models.cluster import ClusterSim  # noqa: E402
 from corrosion_tpu.ops import swim  # noqa: E402
@@ -225,7 +219,8 @@ def rung4() -> None:
     jax.block_until_ready(state.view)
     per_tick = (time.monotonic() - t0) / steps
     s = swim.membership_stats(state)
-    view_gb_100k = 100_000**2 * 4 / 2**30
+    itemsize = jnp.dtype(swim.VIEW_DTYPE).itemsize
+    view_gb_100k = 100_000**2 * itemsize / 2**30
     emit(
         4,
         "sharded_8dev_largest_host_feasible",
@@ -236,8 +231,10 @@ def rung4() -> None:
         view_bytes_per_chip_at_100k_gb=round(view_gb_100k / 8, 2),
         note=(
             "identical sharded program as the 100k v5e-8 target; "
-            f"[N,N] int32 view at 100k = {view_gb_100k:.0f} GiB total, "
-            "5 GiB/chip on 8 chips — fits v5e-8 HBM (16 GiB/chip)"
+            f"[N,N] {jnp.dtype(swim.VIEW_DTYPE).name} view at 100k = "
+            f"{view_gb_100k:.0f} GiB total, {view_gb_100k / 8:.1f} GiB/chip "
+            "on 8 chips — fits v5e-8 HBM (16 GiB/chip) with 2x headroom "
+            "vs the int32 layout"
         ),
         platform=jax.devices()[0].platform,
     )

@@ -161,6 +161,10 @@ def battery_steps() -> list[tuple[str, list[str], dict, float, str]]:
         ("bench40k",
          [py, "-u", "bench.py"],
          {**bench_env, "BENCH_N": "40000"}, 2400.0, "BENCH_TPU_40k.json"),
+        # int16 view: [80k,80k] = 12.8 GB, fits one 16 GB v5e chip donated
+        ("bench80k",
+         [py, "-u", "bench.py"],
+         {**bench_env, "BENCH_N": "80000"}, 3000.0, "BENCH_TPU_80k.json"),
         ("pview100k",
          [py, "-u", "-c", PVIEW_CODE.format(repo=REPO)],
          {"PVIEW_N": "100000", "PVIEW_K": "2048"}, 2400.0,
