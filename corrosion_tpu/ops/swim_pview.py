@@ -688,3 +688,21 @@ def membership_stats(state: PViewState, params: PViewParams) -> dict:
         "occupancy": float(vals[3]),
         "false_positive": float(vals[4]),
     }
+
+
+def memory_gb(n: int, slots: int) -> dict:
+    """Per-chip memory math for a PView state of `n` members × `slots`
+    hash-slot entries, sharded over a v5e-8. The single source for the
+    scale scripts' recorded notes — derives from the actual array dtypes
+    (slot table int32 packed words; gossip buffers 3×16 int32 columns +
+    ~10 int32 FSM fields per member)."""
+    import numpy as np
+
+    item = np.dtype(np.int32).itemsize
+    table_gb = n * slots * item / 2**30
+    bufs_gb = n * (16 * 3 + 10) * item / 2**30
+    return {
+        "slot_table_gb": round(table_gb, 2),
+        "buffers_fsm_gb": round(bufs_gb, 2),
+        "per_chip_gb_v5e8": round((table_gb + bufs_gb) / 8, 3),
+    }

@@ -1,0 +1,30 @@
+"""Measurement-record JSON files with merge-by-key writes.
+
+Several scale scripts (`scripts/pview_scale.py`, `scripts/pview_1m.py`,
+`scripts/scale_ladder.py`) record rungs into shared JSON artifacts; each
+must replace only the rungs it re-measured, never clobber another
+script's records. This is the single copy of that merge.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Sequence
+
+
+def merge_records(
+    path: str, records: Sequence[dict], key: str = "rung"
+) -> List[dict]:
+    """Replace-by-``key`` merge of ``records`` into the JSON list at
+    ``path`` (existing records whose key value is re-measured are
+    dropped; everything else is preserved). Returns the merged list."""
+    try:
+        with open(path) as f:
+            existing = json.load(f)
+    except (OSError, ValueError):
+        existing = []
+    mine = {r.get(key) for r in records}
+    merged = [r for r in existing if r.get(key) not in mine] + list(records)
+    with open(path, "w") as f:
+        json.dump(merged, f, indent=2)
+    return merged

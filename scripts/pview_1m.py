@@ -10,7 +10,8 @@ On one CPU core this is slow (~3 min/tick); the point is an executed
 proof, not a converged run: real ticks, real collectives, stats sane.
 
 Usage: python scripts/pview_1m.py [n] [ticks_per_dispatch] [dispatches]
-Appends the record to PVIEW_SCALE.json ("rung D-1M-executed").
+Merges the record into PVIEW_SCALE.json as rung "D-{n}-executed"
+(e.g. "D-1048576-executed" for the default 1M run).
 """
 
 from __future__ import annotations
@@ -34,6 +35,7 @@ jaxenv.reexec_under_cpu(
 import jax  # noqa: E402
 
 from corrosion_tpu.ops import swim_pview  # noqa: E402
+from corrosion_tpu.runtime.records import merge_records  # noqa: E402
 from corrosion_tpu.parallel import (  # noqa: E402
     member_mesh,
     shard_member_state,
@@ -77,13 +79,11 @@ def main() -> None:
     jax.block_until_ready(state.slot_packed)
     per_tick = (time.monotonic() - t0) / max(1, ticks)
     stats = swim_pview.membership_stats(state, params)
-    # per-chip math derived from the actual n/k (the script takes n as an
-    # argument; the label and note must describe the run that happened)
-    table_gb = n * k * 4 / 2**30
-    bufs_gb = n * (16 * 3 + 10) * 4 / 2**30
-    rung = f"D-{n}-executed"
+    # label + per-chip math derived from the actual n/k (the script takes
+    # n as an argument; the record must describe the run that happened)
+    mem = swim_pview.memory_gb(n, k)
     rec = {
-        "rung": rung,
+        "rung": f"D-{n}-executed",
         "n": n,
         "slots": k,
         "devices": ndev,
@@ -95,20 +95,11 @@ def main() -> None:
         "note": (
             "executed on the 8-device virtual CPU mesh backed by one core; "
             "identical sharded program at "
-            f"{(table_gb + bufs_gb) / ndev:.2f} GB/chip on a v5e-8"
+            f"{mem['per_chip_gb_v5e8']} GB/chip on a v5e-8"
         ),
     }
     print(json.dumps(rec), flush=True)
-    path = os.path.join(REPO, "PVIEW_SCALE.json")
-    try:
-        with open(path) as f:
-            records = json.load(f)
-    except (OSError, ValueError):
-        records = []
-    records = [r for r in records if r.get("rung") != rung]
-    records.append(rec)
-    with open(path, "w") as f:
-        json.dump(records, f, indent=2)
+    merge_records(os.path.join(REPO, "PVIEW_SCALE.json"), [rec])
 
 
 if __name__ == "__main__":

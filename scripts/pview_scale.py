@@ -147,17 +147,19 @@ def rung_b(n: int):
 
 
 def rung_c():
+    import jax.numpy as jnp
+
+    from corrosion_tpu.ops import swim
+
+    dense_item = jnp.dtype(swim.VIEW_DTYPE).itemsize
+
     def math_for(n, k):
-        table_gb = n * k * 4 / 2**30
-        bufs_gb = n * (16 * 3 + 10) * 4 / 2**30
-        return {
-            "n": n,
-            "slots": k,
-            "slot_table_gb": round(table_gb, 2),
-            "buffers_fsm_gb": round(bufs_gb, 2),
-            "per_chip_gb_v5e8": round((table_gb + bufs_gb) / 8, 3),
-            "dense_view_gb_for_comparison": round(n * n * 4 / 2**30, 1),
-        }
+        rec = {"n": n, "slots": k}
+        rec.update(swim_pview.memory_gb(n, k))
+        rec["dense_view_gb_for_comparison"] = round(
+            n * n * dense_item / 2**30, 1
+        )
+        return rec
 
     emit(
         {
@@ -178,16 +180,9 @@ def main():
     rung_c()
     # merge-write: other scripts (pview_1m.py) record their own rungs in
     # the same file — replace only the rungs this run re-measured
-    path = os.path.join(REPO, "PVIEW_SCALE.json")
-    try:
-        with open(path) as f:
-            existing = json.load(f)
-    except (OSError, ValueError):
-        existing = []
-    mine = {r["rung"] for r in results}
-    merged = [r for r in existing if r.get("rung") not in mine] + results
-    with open(path, "w") as f:
-        json.dump(merged, f, indent=2)
+    from corrosion_tpu.runtime.records import merge_records
+
+    merge_records(os.path.join(REPO, "PVIEW_SCALE.json"), results)
 
 
 if __name__ == "__main__":

@@ -258,3 +258,76 @@ def test_column_change_migration_replicates_across_nodes():
                 await shutdown(ag)
 
     asyncio.run(main())
+
+
+def test_configurable_stress_random_topology_concurrent_writers():
+    """The reference's stress-test shape (`configurable_stress_test`,
+    agent/tests.rs:284, wrapped by chill/stress variants at :261-281):
+    N agents on a RANDOM bootstrap topology, every agent writing
+    concurrently, then full convergence — same rows everywhere, every
+    writer's versions booked by every peer, membership complete, and
+    zero spurious down-markings. Sized as the "chill" variant so the
+    1-core CI host finishes in seconds."""
+    import random
+
+    n_agents = 6
+    rows_per_agent = 5
+
+    async def main():
+        rng = random.Random(4242)
+        net = MemNetwork(seed=23)
+        names = [f"stress-{i}" for i in range(n_agents)]
+        agents = [await boot(net, names[0])]
+        for i in range(1, n_agents):
+            # random topology: bootstrap via 1-2 random already-up nodes
+            boots = rng.sample(names[:i], k=min(i, rng.choice((1, 2))))
+            agents.append(await boot(net, names[i], bootstrap=boots))
+        try:
+            assert await wait_until(
+                lambda: all(
+                    ag.membership.cluster_size == n_agents for ag in agents
+                ),
+                timeout=20.0,
+            ), [ag.membership.cluster_size for ag in agents]
+
+            # every agent writes concurrently into a disjoint id range
+            async def writer(ai, ag):
+                for r in range(rows_per_agent):
+                    await insert(
+                        ag, ai * 1000 + r, f"w{ai}-r{r}"
+                    )
+
+            await asyncio.gather(
+                *(writer(ai, ag) for ai, ag in enumerate(agents))
+            )
+
+            total = n_agents * rows_per_agent
+            assert await wait_until(
+                lambda: all(count_rows(ag) == total for ag in agents),
+                timeout=30.0,
+            ), [count_rows(ag) for ag in agents]
+
+            # bookkeeping: every peer has booked every writer's versions
+            def fully_booked():
+                for ag in agents:
+                    for other in agents:
+                        if other is ag:
+                            continue
+                        booked = ag.bookie.get(other.actor_id)
+                        if booked is None:
+                            return False
+                        with booked.read() as bv:
+                            if not bv.contains_all((1, rows_per_agent)):
+                                return False
+                return True
+
+            assert await wait_until(fully_booked, timeout=20.0)
+
+            # healthy cluster: nobody marked anybody down
+            for ag in agents:
+                assert ag.membership.cluster_size == n_agents
+        finally:
+            for ag in agents:
+                await shutdown(ag)
+
+    asyncio.run(main())
