@@ -76,6 +76,10 @@ from corrosion_tpu.ops.swim import (
 
 _HASH_MULT = jnp.uint32(2654435761)  # Knuth multiplicative constant
 
+SLOT_DTYPE = jnp.int32  # packed (key*P + subj^mask) words need 31 bits;
+# int16 is NOT an option here (unlike the dense kernel's VIEW_DTYPE) —
+# the pack bound key*P < 2^31 already consumes the whole word
+
 
 class PViewParams(NamedTuple):
     """Static parameters. FSM fields mirror `swim.SwimParams`; `slots`
@@ -232,7 +236,7 @@ def init_state(
     n, k, b, s = params.n, params.slots, params.buffer_slots, params.susp_slots
     idx = jnp.arange(n, dtype=jnp.int32)
     alive_key = make_key(0, PREC_ALIVE)
-    packed = jnp.zeros((n, k), dtype=jnp.int32)
+    packed = jnp.zeros((n, k), dtype=SLOT_DTYPE)
     packed = packed.at[idx, _hash(params, idx)].set(
         _pack(params, idx, alive_key, idx, 0)
     )
@@ -693,12 +697,13 @@ def membership_stats(state: PViewState, params: PViewParams) -> dict:
 def memory_gb(n: int, slots: int) -> dict:
     """Per-chip memory math for a PView state of `n` members × `slots`
     hash-slot entries, sharded over a v5e-8. The single source for the
-    scale scripts' recorded notes — derives from the actual array dtypes
-    (slot table int32 packed words; gossip buffers 3×16 int32 columns +
-    ~10 int32 FSM fields per member)."""
+    scale scripts' recorded notes — sized from SLOT_DTYPE (the packed
+    words need the full 31 bits, so unlike the dense kernel's VIEW_DTYPE
+    this cannot narrow) for the table, and int32 gossip buffers (3×16
+    columns + ~10 FSM fields per member)."""
     import numpy as np
 
-    item = np.dtype(np.int32).itemsize
+    item = jnp.dtype(SLOT_DTYPE).itemsize
     table_gb = n * slots * item / 2**30
     bufs_gb = n * (16 * 3 + 10) * item / 2**30
     return {

@@ -1,9 +1,11 @@
 """Measurement-record JSON files with merge-by-key writes.
 
-Several scale scripts (`scripts/pview_scale.py`, `scripts/pview_1m.py`,
-`scripts/scale_ladder.py`) record rungs into shared JSON artifacts; each
-must replace only the rungs it re-measured, never clobber another
-script's records. This is the single copy of that merge.
+The pview scale scripts (`scripts/pview_scale.py`, `scripts/pview_1m.py`)
+record rungs into the shared PVIEW_SCALE.json; each must replace only the
+rungs it re-measured, never clobber another script's records. This is the
+single copy of that merge. (`scripts/scale_ladder.py` keeps its own
+composite-key last-wins merge over BASELINE_MEASURED.json — a different
+contract, deliberately not unified.)
 """
 
 from __future__ import annotations
@@ -17,14 +19,25 @@ def merge_records(
 ) -> List[dict]:
     """Replace-by-``key`` merge of ``records`` into the JSON list at
     ``path`` (existing records whose key value is re-measured are
-    dropped; everything else is preserved). Returns the merged list."""
+    dropped; everything else is preserved). Returns the merged list.
+
+    Every new record must carry ``key`` — a keyless record would
+    otherwise silently match (and delete) unrelated keyless entries in
+    the shared artifact, so it raises instead."""
+    missing = [r for r in records if key not in r]
+    if missing:
+        raise KeyError(
+            f"record(s) missing merge key {key!r}: {missing[:2]!r}"
+        )
     try:
         with open(path) as f:
             existing = json.load(f)
     except (OSError, ValueError):
         existing = []
-    mine = {r.get(key) for r in records}
-    merged = [r for r in existing if r.get(key) not in mine] + list(records)
+    mine = {r[key] for r in records}
+    merged = [
+        r for r in existing if not (key in r and r[key] in mine)
+    ] + list(records)
     with open(path, "w") as f:
         json.dump(merged, f, indent=2)
     return merged
