@@ -700,12 +700,10 @@ def memory_gb(n: int, slots: int) -> dict:
     scale scripts' recorded notes — sized from SLOT_DTYPE (the packed
     words need the full 31 bits, so unlike the dense kernel's VIEW_DTYPE
     this cannot narrow) for the table, and int32 gossip buffers (3×16
-    columns + ~10 FSM fields per member)."""
-    import numpy as np
-
-    item = jnp.dtype(SLOT_DTYPE).itemsize
-    table_gb = n * slots * item / 2**30
-    bufs_gb = n * (16 * 3 + 10) * item / 2**30
+    columns + ~10 FSM fields per member — hard-coded int32 in
+    init_state, sized independently of the slot words here)."""
+    table_gb = n * slots * jnp.dtype(SLOT_DTYPE).itemsize / 2**30
+    bufs_gb = n * (16 * 3 + 10) * jnp.dtype(jnp.int32).itemsize / 2**30
     return {
         "slot_table_gb": round(table_gb, 2),
         "buffers_fsm_gb": round(bufs_gb, 2),

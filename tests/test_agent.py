@@ -42,8 +42,8 @@ def fast_config(addr: str, bootstrap=()) -> Config:
     return cfg
 
 
-async def boot(net, addr, bootstrap=()):
-    agent = await setup(fast_config(addr, bootstrap), network=net)
+async def boot(net, addr, bootstrap=(), cfg=None):
+    agent = await setup(cfg or fast_config(addr, bootstrap), network=net)
     agent.membership.config = FAST_SWIM
     agent.store.apply_schema_sql(TEST_SCHEMA)
     await run(agent)
@@ -329,5 +329,56 @@ def test_configurable_stress_random_topology_concurrent_writers():
         finally:
             for ag in agents:
                 await shutdown(ag)
+
+    asyncio.run(main())
+
+
+def test_loadshed_drop_oldest_then_sync_repairs():
+    """The reference's backpressure test shape (test_loadshed_handle_
+    changes, handlers.rs:934-1018): shrink the ingestion queue so a
+    broadcast flood forces drop-oldest, then prove the data plane heals
+    — dropped changes are re-fetched by anti-entropy sync and the
+    receiver still converges to the full row set."""
+    from corrosion_tpu.runtime.metrics import METRICS
+
+    async def main():
+        net = MemNetwork(seed=29)
+        a = await boot(net, "shed-a")
+        # b: tiny processing queue + large flush threshold/timeout so the
+        # buffer backs up between flushes and drop-oldest fires
+        cfg = fast_config("shed-b", bootstrap=["shed-a"])
+        cfg.perf.processing_queue_len = 2
+        cfg.perf.apply_queue_len = 10_000
+        cfg.perf.apply_queue_timeout_ms = 200
+        b = await boot(net, "shed-b", cfg=cfg)
+        try:
+            assert await wait_until(
+                lambda: all(ag.membership.cluster_size == 2 for ag in (a, b))
+            )
+            dropped0 = METRICS.counter("corro.agent.changes.dropped").value
+
+            # flood: every insert is its own broadcast change version
+            n_rows = 40
+            for i in range(n_rows):
+                await insert(a, i, f"flood-{i}")
+
+            # the shrunken queue must actually shed under the flood
+            assert await wait_until(
+                lambda: METRICS.counter("corro.agent.changes.dropped").value
+                > dropped0,
+                timeout=10.0,
+            ), "queue never shed — flood did not exceed processing_queue_len"
+
+            # and anti-entropy repairs b to the full row set anyway
+            assert await wait_until(
+                lambda: count_rows(b) == n_rows, timeout=30.0
+            ), count_rows(b)
+            booked = b.bookie.get(a.actor_id)
+            assert booked is not None
+            with booked.read() as bv:
+                assert bv.contains_all((1, n_rows))
+        finally:
+            await shutdown(a)
+            await shutdown(b)
 
     asyncio.run(main())

@@ -170,9 +170,9 @@ def test_subscription_exactly_once_under_concurrent_writers():
         c, api_c, client_c = await boot_with_api(net, "agent-c", ["agent-a"])
         agents = (a, b, c)
         try:
-            await wait_until(
+            assert await wait_until(
                 lambda: all(len(ag.members) == 2 for ag in agents)
-            )
+            ), "cluster never converged"
             stream = client_c.subscribe("SELECT id, text FROM tests")
             it = stream.__aiter__()
             await next_of(it, "eoq")
@@ -204,6 +204,14 @@ def test_subscription_exactly_once_under_concurrent_writers():
             assert change_ids == list(
                 range(change_ids[0], change_ids[0] + len(change_ids))
             ), change_ids
+
+            # the stream must now be QUIET: a late duplicate from a
+            # re-gossiped delivery would arrive before this sentinel
+            await insert(a, 9999, "sentinel")
+            ev = await next_of(it, "change", timeout=15.0)
+            assert ev["change"][2] == [9999, "sentinel"], (
+                f"late duplicate event before the sentinel: {ev}"
+            )
         finally:
             for cl in (client_a, client_b, client_c):
                 await cl.close()
