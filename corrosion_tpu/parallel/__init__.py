@@ -1,7 +1,9 @@
 """Device-mesh sharding for the member axis (ICI-scaled SWIM)."""
 
 from corrosion_tpu.parallel.mesh import (
+    host_member_spec,
     member_mesh,
+    multihost_member_mesh,
     shard_member_state,
     shard_swim_state,
     sharded_pview_tick,
@@ -9,7 +11,9 @@ from corrosion_tpu.parallel.mesh import (
 )
 
 __all__ = [
+    "host_member_spec",
     "member_mesh",
+    "multihost_member_mesh",
     "shard_member_state",
     "shard_swim_state",
     "sharded_pview_tick",

@@ -21,6 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from corrosion_tpu.ops import swim, swim_pview
 
 MEMBER_AXIS = "members"
+HOST_AXIS = "hosts"
 
 
 def member_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
@@ -28,8 +29,76 @@ def member_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return Mesh(devs, axis_names=(MEMBER_AXIS,))
 
 
+def multihost_member_mesh(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> Mesh:
+    """Member-axis mesh spanning EVERY process of a multi-host job.
+
+    This is the DCN story (the reference scales out with one QUIC mesh
+    per process; we scale the member axis over hosts): jax.distributed
+    connects the processes (coordinator via args or the standard
+    JAX_COORDINATOR_ADDRESS / Cloud TPU metadata), after which
+    `jax.devices()` lists every chip in the job. The mesh is shaped
+    [hosts, members] with the HOST axis outermost, so a sharding of
+    `P((HOST_AXIS, MEMBER_AXIS))` keeps each host's member block
+    contiguous on its own chips: the per-tick gossip/feed collectives
+    between co-located chips ride ICI, and only the cross-host slices of
+    the delivery all-to-all cross DCN — the layout rule from the scaling
+    playbook (collectives on the fast axis innermost).
+
+    In a single-process job this degrades to the ordinary member mesh
+    (no jax.distributed needed), which is what the tests drive; real
+    multi-host runs need the actual fleet and are exercised operationally
+    rather than in CI.
+    """
+    import os
+    from collections import Counter
+
+    already = jax.distributed.is_initialized()
+    # auto-init when the caller passed coordinates OR the standard env
+    # carries them (jax.distributed.initialize reads the env itself);
+    # a bare single-process run must NOT attempt cluster discovery
+    wants_init = (
+        coordinator_address is not None
+        or num_processes is not None
+        or os.environ.get("JAX_COORDINATOR_ADDRESS") is not None
+    )
+    if not already and wants_init:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    # group by owning process — jax.devices() order is NOT guaranteed
+    # process-contiguous, and a positional reshape could put two hosts'
+    # chips in one mesh row (ICI row becomes a DCN row, silently)
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    counts = Counter(d.process_index for d in devs)
+    per_host = set(counts.values())
+    if len(per_host) != 1:
+        raise ValueError(
+            f"uneven device count per host: {dict(counts)} — a rectangular "
+            "[hosts, members] mesh needs equal chips per process"
+        )
+    grid = np.array(devs).reshape(len(counts), per_host.pop())
+    return Mesh(grid, axis_names=(HOST_AXIS, MEMBER_AXIS))
+
+
+def host_member_spec(ndim: int) -> P:
+    """PartitionSpec sharding the leading (member) axis over BOTH mesh
+    axes of a `multihost_member_mesh` — host-major blocks, ICI-contiguous
+    within a host."""
+    return P((HOST_AXIS, MEMBER_AXIS), *([None] * (ndim - 1)))
+
+
 def _sharding_for(mesh: Mesh, ndim: int) -> NamedSharding:
-    # observer axis sharded, every other axis replicated-dim
+    # observer axis sharded, every other axis replicated-dim; on a
+    # multi-host [hosts, members] mesh the observer axis spans BOTH mesh
+    # axes host-major (see multihost_member_mesh)
+    if HOST_AXIS in mesh.axis_names:
+        return NamedSharding(mesh, host_member_spec(ndim))
     spec = [MEMBER_AXIS] + [None] * (ndim - 1)
     return NamedSharding(mesh, P(*spec))
 

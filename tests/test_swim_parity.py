@@ -277,3 +277,43 @@ def test_parity_no_false_positives_under_loss():
         assert all(s == N_PARITY for s in sizes), sizes
 
     asyncio.run(main())
+
+
+def test_multihost_mesh_matches_flat_mesh():
+    """The [hosts, members] mesh (DCN layout: host axis outermost, each
+    host's member block ICI-contiguous) is a LAYOUT change only — the
+    sharded tick must stay bit-identical to the flat member mesh. In a
+    single-process job multihost_member_mesh folds all 8 virtual devices
+    into hosts=1, which is the degenerate case CI can drive."""
+    from corrosion_tpu.parallel import (
+        multihost_member_mesh,
+        shard_member_state,
+    )
+
+    n_dev = 8
+    devices = jax.devices()
+    assert len(devices) >= n_dev
+    params = swim.SwimParams(n=8 * n_dev)
+
+    flat = member_mesh(devices[:n_dev])
+    multi = multihost_member_mesh()
+    assert multi.devices.shape == (1, len(devices))
+
+    state_a = shard_member_state(
+        swim.init_state(params, jax.random.PRNGKey(3)), flat
+    )
+    state_b = shard_member_state(
+        swim.init_state(params, jax.random.PRNGKey(3)), multi
+    )
+    tick_flat = sharded_tick(params, flat)
+    tick_multi = sharded_tick(params, multi)
+
+    rng = jax.random.PRNGKey(9)
+    for _ in range(5):
+        rng, key = jax.random.split(rng)
+        state_a = tick_flat(state_a, key)
+        state_b = tick_multi(state_b, key)
+
+    for name, arr_a in state_a._asdict().items():
+        arr_b = getattr(state_b, name)
+        assert jnp.array_equal(arr_a, arr_b), f"field {name} diverged"
