@@ -157,7 +157,14 @@ def init_state(
 ) -> SwimState:
     """Freshly booted cluster: every member knows itself plus a few
     bootstrap seeds (`seed_mode="ring"`: the next k members, like a
-    devcluster ring topology; `"hub"`: everyone knows members 0..k-1)."""
+    devcluster ring topology; `"hub"`: everyone knows members 0..k-1;
+    `"fingers"`: Chord-style offsets 1, 2, 4, ..., n/2 — a bootstrap
+    list whose graph is a log-diameter expander, so feed-partner picks
+    reach long-range peers from tick 0 instead of staying ring-local
+    until random picks start landing. All three are just devcluster
+    bootstrap-address choices: a real deployment configures
+    gossip.bootstrap freely, and log2(n) configured addresses is modest
+    (17 entries at 100k)."""
     n, b, s = params.n, params.buffer_slots, params.susp_slots
     view = jnp.zeros((n, n), dtype=VIEW_DTYPE)
     idx = jnp.arange(n)
@@ -170,6 +177,14 @@ def init_state(
         k = min(seeds_per_member, n)
         view = view.at[:, :k].set(alive_key)
         view = view.at[idx, idx].set(make_key(0, PREC_ALIVE))
+    elif seed_mode == "fingers":
+        # one batched scatter (a per-stride loop would copy the [N, N]
+        # view log2(n) times at init)
+        bits = max(1, (n - 1).bit_length())
+        strides = 2 ** jnp.arange(bits)
+        view = view.at[
+            idx[:, None], (idx[:, None] + strides[None, :]) % n
+        ].set(alive_key)
     else:
         raise ValueError(f"unknown seed_mode {seed_mode!r}")
 

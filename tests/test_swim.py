@@ -271,3 +271,40 @@ def test_refutation_incarnation_caps():
     out = suspected_at(swim.INC_CAP)
     assert int(out.inc[1]) == swim.INC_CAP
     assert int(swim.key_prec(out.view[1, 1])) == swim.PREC_SUSPECT
+
+
+def test_fingers_bootstrap_converges_faster_than_ring():
+    """The Chord-style finger bootstrap (offsets 1,2,4,...,n/2) is the
+    bench's devcluster topology: its expander bootstrap graph must (a)
+    seed exactly the finger entries, and (b) converge a boot in fewer
+    ticks than the 3-neighbor ring at the same feed bandwidth — the
+    early epidemic is partner-correlation bound (PROFILE.md)."""
+    import math
+
+    n = 512
+    params = swim.SwimParams(n=n, feeds_per_tick=2, feed_entries=32)
+    st = swim.init_state(params, jax.random.PRNGKey(0), seed_mode="fingers")
+    row0 = st.view[0]
+    known = {int(i) for i in jnp.nonzero(row0)[0]}
+    fingers = {0} | {2**j % n for j in range(int(math.log2(n)) + 1)}
+    assert known == fingers, (known, fingers)
+
+    def ticks_to(target, state):
+        rng = jax.random.PRNGKey(1)
+        for t in range(1, 41):
+            rng, key = jax.random.split(rng)
+            state = swim.tick_n_donated(state, key, params, 5)
+            s = swim.membership_stats(state)
+            assert s["false_positive"] == 0.0
+            if s["coverage"] >= target:
+                return t * 5
+        return 10_000
+
+    t_fingers = ticks_to(
+        0.999, swim.init_state(params, jax.random.PRNGKey(0), seed_mode="fingers")
+    )
+    t_ring = ticks_to(
+        0.999, swim.init_state(params, jax.random.PRNGKey(0), seed_mode="ring")
+    )
+    assert t_fingers < t_ring, (t_fingers, t_ring)
+    assert t_fingers < 10_000, "fingers boot never converged"
