@@ -66,8 +66,8 @@ def child_main() -> None:
         antientropy=1,
     )
 
-    # Bootstrap topology: Chord-style finger list (offsets 1, 2, 4, ...,
-    # n/2 — log2(n) configured bootstrap addresses per node, a modest
+    # Bootstrap topology: Chord-style finger list (power-of-two offsets,
+    # swim.finger_offsets — log2(n) configured addresses per node, a modest
     # deployment choice: 14 entries at 10k). The expander bootstrap graph
     # gives feed-partner picks long-range reach from tick 0; measured at
     # n=10k it converges in ~70 ticks vs ~161 for a 3-neighbor ring

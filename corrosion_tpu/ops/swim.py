@@ -101,6 +101,15 @@ buffers and inboxes stay int32."""
 _KEY_CLAMP_BASE = (INC_CAP - 1) * 4 + 4  # multiple of 4: prec bits survive
 
 
+def finger_offsets(n: int) -> jnp.ndarray:
+    """Chord-style bootstrap offsets: powers of two 1, 2, 4, ..., up to
+    the largest power of two below n (8192 at n=10000 — NOT exactly n/2
+    for non-power-of-2 n). One definition shared by the dense and
+    partial-view kernels so their bootstrap graphs cannot diverge."""
+    bits = max(1, (n - 1).bit_length())
+    return (2 ** jnp.arange(bits)).astype(jnp.int32)
+
+
 def to_view_key(key):
     """Cast an int32 key for storage in the int16 view; out-of-range keys
     (unreachable once incarnations cap at INC_CAP) saturate WITHOUT
@@ -158,13 +167,13 @@ def init_state(
     """Freshly booted cluster: every member knows itself plus a few
     bootstrap seeds (`seed_mode="ring"`: the next k members, like a
     devcluster ring topology; `"hub"`: everyone knows members 0..k-1;
-    `"fingers"`: Chord-style offsets 1, 2, 4, ..., n/2 — a bootstrap
-    list whose graph is a log-diameter expander, so feed-partner picks
-    reach long-range peers from tick 0 instead of staying ring-local
-    until random picks start landing. All three are just devcluster
-    bootstrap-address choices: a real deployment configures
-    gossip.bootstrap freely, and log2(n) configured addresses is modest
-    (17 entries at 100k)."""
+    `"fingers"`: Chord-style power-of-two offsets (`finger_offsets`) — a
+    bootstrap list whose graph is a log-diameter expander, so
+    feed-partner picks reach long-range peers from tick 0 instead of
+    staying ring-local until random picks start landing. All three are
+    just devcluster bootstrap-address choices: a real deployment
+    configures gossip.bootstrap freely, and log2(n) configured
+    addresses is modest (17 entries at 100k)."""
     n, b, s = params.n, params.buffer_slots, params.susp_slots
     view = jnp.zeros((n, n), dtype=VIEW_DTYPE)
     idx = jnp.arange(n)
@@ -180,8 +189,7 @@ def init_state(
     elif seed_mode == "fingers":
         # one batched scatter (a per-stride loop would copy the [N, N]
         # view log2(n) times at init)
-        bits = max(1, (n - 1).bit_length())
-        strides = 2 ** jnp.arange(bits)
+        strides = finger_offsets(n)
         view = view.at[
             idx[:, None], (idx[:, None] + strides[None, :]) % n
         ].set(alive_key)

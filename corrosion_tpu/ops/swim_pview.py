@@ -68,6 +68,7 @@ from corrosion_tpu.ops.swim import (
     PREC_SUSPECT,
     _buffer_merge,
     build_inbox,
+    finger_offsets,
     key_inc,
     key_known,
     key_prec,
@@ -230,9 +231,13 @@ def init_state(
     params: PViewParams,
     rng: jax.Array,
     seeds_per_member: int = 3,
+    seed_mode: str = "ring",
 ) -> PViewState:
-    """Freshly booted cluster: every member knows itself plus the next
-    `seeds_per_member` ring neighbours (devcluster ring bootstrap)."""
+    """Freshly booted cluster: every member knows itself plus bootstrap
+    seeds — `seed_mode="ring"`: the next `seeds_per_member` neighbours;
+    `"fingers"`: Chord-style power-of-two offsets (`swim.finger_offsets`,
+    same expander bootstrap rationale as `swim.init_state`: long-range
+    feed partners from tick 0)."""
     n, k, b, s = params.n, params.slots, params.buffer_slots, params.susp_slots
     idx = jnp.arange(n, dtype=jnp.int32)
     alive_key = make_key(0, PREC_ALIVE)
@@ -240,11 +245,18 @@ def init_state(
     packed = packed.at[idx, _hash(params, idx)].set(
         _pack(params, idx, alive_key, idx, 0)
     )
-    for off in range(1, seeds_per_member + 1):
-        peer = (idx + off) % n
-        packed = packed.at[idx, _hash(params, peer)].max(
-            _pack(params, peer, alive_key, idx, 0)
-        )
+    if seed_mode == "ring":
+        offs = jnp.arange(1, seeds_per_member + 1, dtype=jnp.int32)
+    elif seed_mode == "fingers":
+        offs = finger_offsets(n)
+    else:
+        raise ValueError(f"unknown seed_mode {seed_mode!r}")
+    # one batched scatter-max over all seed offsets (a per-offset loop
+    # would copy the [N, K] table once per stride at init)
+    peers = (idx[:, None] + offs[None, :]) % n  # [N, F]
+    packed = packed.at[idx[:, None], _hash(params, peers)].max(
+        _pack(params, peers, alive_key, idx[:, None], 0)
+    )
 
     buf_subj = jnp.full((n, b), n, dtype=jnp.int32)
     buf_key = jnp.zeros((n, b), dtype=jnp.int32)

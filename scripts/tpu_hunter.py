@@ -103,7 +103,9 @@ params = swim_pview.PViewParams(
 )
 plat = jax.devices()[0].platform
 t0 = time.monotonic()
-state = swim_pview.init_state(params, jax.random.PRNGKey(0))
+state = swim_pview.init_state(
+    params, jax.random.PRNGKey(0), seed_mode="fingers"
+)
 jax.block_until_ready(state.slot_packed)
 init_s = time.monotonic() - t0
 rng = jax.random.PRNGKey(1)
@@ -133,7 +135,7 @@ wall = time.monotonic() - t0
 rec = {{
     "metric": f"pview_stable_membership_n{{n}}",
     "platform": plat,
-    "n": n, "slots": k, "quorum_floor": q,
+    "n": n, "slots": k, "quorum_floor": q, "seed_mode": "fingers",
     "init_s": round(init_s, 2), "compile_s": round(compile_s, 2),
     "ticks": ticks, "wall_s": round(wall, 2),
     "s_per_tick": round(wall / max(1, ticks - 25), 4),
@@ -147,7 +149,9 @@ sys.exit(0 if converged else 1)
 
 def battery_steps() -> list[tuple[str, list[str], dict, float, str]]:
     py = sys.executable
-    bench_env = {"CORRO_BENCH_CHILD": "1", "BENCH_RECORD_EVERY": "50"}
+    # no BENCH_RECORD_EVERY override: the TPU runs must use bench.py's
+    # default cadence so records stay comparable with the CPU baselines
+    bench_env = {"CORRO_BENCH_CHILD": "1"}
     return [
         ("smoke",
          [py, "-u", "scripts/profile_swim.py", "1024", "4"],

@@ -274,7 +274,7 @@ def test_refutation_incarnation_caps():
 
 
 def test_fingers_bootstrap_converges_faster_than_ring():
-    """The Chord-style finger bootstrap (offsets 1,2,4,...,n/2) is the
+    """The Chord-style finger bootstrap (power-of-two offsets) is the
     bench's devcluster topology: its expander bootstrap graph must (a)
     seed exactly the finger entries, and (b) converge a boot in fewer
     ticks than the 3-neighbor ring at the same feed bandwidth — the
