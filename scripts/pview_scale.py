@@ -64,7 +64,11 @@ def rung_a(n: int):
     params = swim_pview.PViewParams(
         n=n, slots=k, feeds_per_tick=4, feed_entries=max(16, k // 16)
     )
-    state = swim_pview.init_state(params, jax.random.PRNGKey(0))
+    # fingers bootstrap: the same topology the TPU hunter's pview run
+    # uses, so CPU and TPU convergence records stay like-for-like
+    state = swim_pview.init_state(
+        params, jax.random.PRNGKey(0), seed_mode="fingers"
+    )
     rng = jax.random.PRNGKey(1)
     t0 = time.monotonic()
     stats = {}

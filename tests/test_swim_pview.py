@@ -191,3 +191,55 @@ def test_retention_fairness_under_load():
     assert min(mins[-4:]) > 0, mins  # nobody extinct in steady state
     assert tail[len(tail) // 2] >= 8, mins  # median tail at the quorum floor
     assert stats["pv_coverage"] >= 0.97, stats
+
+
+def test_fingers_seed_mode_pview():
+    """Finger bootstrap for the bounded partial view: seeds the correct
+    hash slots (own entry + every power-of-two offset peer) and boots to
+    quorum with zero false positives."""
+    import jax
+
+    from corrosion_tpu.ops import swim, swim_pview
+
+    n, k = 256, 64
+    params = swim_pview.PViewParams(n=n, slots=k, feeds_per_tick=4,
+                                    feed_entries=16)
+    st = swim_pview.init_state(
+        params, jax.random.PRNGKey(0), seed_mode="fingers"
+    )
+    # member 0 must know itself and each finger peer (entries land in
+    # the peers' hash slots; collisions can only merge, not vanish,
+    # because all seeds share the same key and the max keeps one)
+    offs = [int(o) for o in swim.finger_offsets(n)]
+    import jax.numpy as jnp
+
+    subj, key = swim_pview._unpack(
+        params, st.slot_packed[:1], jnp.zeros((1, 1), jnp.int32), 0
+    )
+    known = {int(s) for s, valid in zip(subj[0], key[0] > 0) if valid}
+    expected = {0} | {o % n for o in offs}
+    # every expected subject present unless evicted by a same-slot
+    # sibling (same key: max picks the larger masked subject)
+    missing = expected - known
+    for m in missing:
+        h = int(swim_pview._hash(params, jax.numpy.int32(m)))
+        others = [s for s in expected if s != m
+                  and int(swim_pview._hash(params, jax.numpy.int32(s))) == h]
+        assert others, f"subject {m} missing without a slot collision"
+
+    rng = jax.random.PRNGKey(1)
+    state = st
+    stats = {}
+    for _ in range(16):
+        rng, kk = jax.random.split(rng)
+        state = swim_pview.tick_n_donated(state, kk, params, 10)
+        stats = swim_pview.membership_stats(state, params)
+        if stats["min_in_degree"] >= 8 and stats["pv_coverage"] >= 0.95:
+            break
+    assert stats["false_positive"] == 0.0
+    assert stats["min_in_degree"] >= 8, stats
+
+    with __import__("pytest").raises(ValueError):
+        swim_pview.init_state(
+            params, jax.random.PRNGKey(0), seed_mode="nope"
+        )
