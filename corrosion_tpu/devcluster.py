@@ -97,6 +97,7 @@ class DevCluster:
         self.swim_config = swim_config
         self.agents: Dict[str, object] = {}
         self.started_at: Optional[float] = None
+        self._db_paths: List[str] = []
 
     async def start(self) -> None:
         from corrosion_tpu.agent.run import run, setup
@@ -106,8 +107,14 @@ class DevCluster:
         addrs: Dict[str, str] = {}
 
         async def boot(name: str) -> None:
+            from corrosion_tpu.runtime.tmpdb import fresh_db_path
+
             cfg = Config()
-            cfg.db.path = ":memory:"
+            # file-backed, not :memory: (see runtime/tmpdb.py: the
+            # shared-cache in-memory fallback has no real WAL and flakes
+            # concurrent read+apply)
+            cfg.db.path = fresh_db_path(name)
+            self._db_paths.append(cfg.db.path)
             if self.network is not None:
                 cfg.gossip.bind_addr = name
             else:
@@ -133,11 +140,21 @@ class DevCluster:
             await boot(name)
 
     async def stop(self) -> None:
+        import glob
+        import os
+
         from corrosion_tpu.agent.run import shutdown
 
         for agent in self.agents.values():
             await shutdown(agent)
         self.agents.clear()
+        for path in self._db_paths:
+            for f in glob.glob(path + "*"):  # db + -wal/-shm sidecars
+                try:
+                    os.unlink(f)
+                except OSError:
+                    pass
+        self._db_paths.clear()
 
     # -- measurements ------------------------------------------------------
 

@@ -109,7 +109,9 @@ def main() -> None:
     per_tick = (time.monotonic() - t0) / max(1, measured)
     rec = {
         "rung": 5,
-        "name": "dense_sharded_convergence",
+        # n in the name: it is part of the merge key, so a smoke run at
+        # a toy size can never overwrite the canonical measured record
+        "name": f"dense_sharded_convergence_n{n}",
         "n": n,
         "n_devices": ndev,
         "seed_mode": "fingers",

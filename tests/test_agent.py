@@ -29,10 +29,15 @@ TEST_SCHEMA = (
 
 FAST_SWIM = SwimConfig(probe_period=0.05, probe_rtt=0.02, suspicion_mult=1.0)
 
+# File-backed test dbs, NOT :memory: (runtime/tmpdb.py: the shared-cache
+# in-memory fallback has no real WAL and flakes concurrent read+apply as
+# "database is locked" on a loaded host). Cleaned up at interpreter exit.
+from corrosion_tpu.runtime.tmpdb import fresh_db_path
+
 
 def fast_config(addr: str, bootstrap=()) -> Config:
     cfg = Config()
-    cfg.db.path = ":memory:"
+    cfg.db.path = fresh_db_path(addr.replace(":", "_"))
     cfg.gossip.bind_addr = addr
     cfg.gossip.bootstrap = list(bootstrap)
     cfg.perf.broadcast_interval_ms = 20

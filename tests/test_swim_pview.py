@@ -197,10 +197,6 @@ def test_fingers_seed_mode_pview():
     """Finger bootstrap for the bounded partial view: seeds the correct
     hash slots (own entry + every power-of-two offset peer) and boots to
     quorum with zero false positives."""
-    import jax
-
-    from corrosion_tpu.ops import swim, swim_pview
-
     n, k = 256, 64
     params = swim_pview.PViewParams(n=n, slots=k, feeds_per_tick=4,
                                     feed_entries=16)
@@ -211,8 +207,6 @@ def test_fingers_seed_mode_pview():
     # the peers' hash slots; collisions can only merge, not vanish,
     # because all seeds share the same key and the max keeps one)
     offs = [int(o) for o in swim.finger_offsets(n)]
-    import jax.numpy as jnp
-
     subj, key = swim_pview._unpack(
         params, st.slot_packed[:1], jnp.zeros((1, 1), jnp.int32), 0
     )
@@ -222,9 +216,9 @@ def test_fingers_seed_mode_pview():
     # sibling (same key: max picks the larger masked subject)
     missing = expected - known
     for m in missing:
-        h = int(swim_pview._hash(params, jax.numpy.int32(m)))
+        h = int(swim_pview._hash(params, jnp.int32(m)))
         others = [s for s in expected if s != m
-                  and int(swim_pview._hash(params, jax.numpy.int32(s))) == h]
+                  and int(swim_pview._hash(params, jnp.int32(s))) == h]
         assert others, f"subject {m} missing without a slot collision"
 
     rng = jax.random.PRNGKey(1)
@@ -239,7 +233,7 @@ def test_fingers_seed_mode_pview():
     assert stats["false_positive"] == 0.0
     assert stats["min_in_degree"] >= 8, stats
 
-    with __import__("pytest").raises(ValueError):
+    with pytest.raises(ValueError):
         swim_pview.init_state(
             params, jax.random.PRNGKey(0), seed_mode="nope"
         )
