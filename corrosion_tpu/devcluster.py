@@ -149,9 +149,12 @@ class DevCluster:
             await shutdown(agent)
         self.agents.clear()
         for path in self._db_paths:
-            for f in glob.glob(path + "*"):  # db + -wal/-shm sidecars
+            # escape: node names feed the path prefix, and a glob
+            # metacharacter (e.g. an IPv6 '[::1]' bind addr) must not
+            # break cleanup or match another cluster's files
+            for f in glob.glob(glob.escape(path) + "*"):
                 try:
-                    os.unlink(f)
+                    os.unlink(f)  # db + -wal/-shm sidecars
                 except OSError:
                     pass
         self._db_paths.clear()

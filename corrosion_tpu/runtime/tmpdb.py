@@ -24,12 +24,15 @@ _dir: Optional[str] = None
 
 def fresh_db_path(prefix: str = "agent") -> str:
     """A unique path for a new file-backed SQLite db in the per-process
-    scratch directory (created lazily, removed at exit)."""
+    scratch directory (created lazily, removed at exit). The prefix is
+    sanitized — node names can be bind addresses ('[::1]:8080') and must
+    not leak glob/path metacharacters into filenames."""
     global _dir
     if _dir is None:
         _dir = tempfile.mkdtemp(prefix="corro-dbs-")
         atexit.register(_cleanup)
-    return os.path.join(_dir, f"{prefix}-{uuid.uuid4().hex}.db")
+    safe = "".join(c if c.isalnum() or c in "-_" else "_" for c in prefix)
+    return os.path.join(_dir, f"{safe or 'agent'}-{uuid.uuid4().hex}.db")
 
 
 def _cleanup() -> None:

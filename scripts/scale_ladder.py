@@ -174,7 +174,8 @@ def rung3() -> None:
     # bench.py's boot-tuned configuration (W = n/4 feed bandwidth, few
     # large windows, trimmed gossip widths — PROFILE.md)
     sim = ClusterSim(
-        n, seed=0, feeds_per_tick=4, feed_entries=max(25, n // 16),
+        n, seed=0, seed_mode="fingers",
+        feeds_per_tick=4, feed_entries=max(25, n // 16),
         piggyback=4, incoming_slots=8, buffer_slots=12,
         probe_candidates=2, antientropy=1,
     )
@@ -192,6 +193,7 @@ def rung3() -> None:
         3,
         "batched_10k_single_device",
         n=n,
+        seed_mode="fingers",
         per_tick_s=round(per_tick, 4),
         convergence_ticks=tick,
         convergence_wall_s=round(wall, 3),

@@ -1,6 +1,7 @@
 """Admin UDS protocol tests: command dispatch end-to-end over a real unix
 socket against a live agent. Mirrors `klukai/src/admin.rs` coverage."""
 
+from corrosion_tpu.runtime.tmpdb import fresh_db_path
 import asyncio
 import logging
 
@@ -17,7 +18,7 @@ TEST_SCHEMA = (
 
 def cfg(addr):
     c = Config()
-    c.db.path = ":memory:"
+    c.db.path = fresh_db_path()
     c.gossip.bind_addr = addr
     return c
 

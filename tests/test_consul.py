@@ -2,6 +2,7 @@
 Mirrors `klukai/src/command/consul/sync.rs` coverage: hash-based change
 detection, upsert/delete flow, notes hash directives, restart warm-up."""
 
+from corrosion_tpu.runtime.tmpdb import fresh_db_path
 import asyncio
 import json
 
@@ -140,7 +141,7 @@ def test_diff_services_upsert_delete_unchanged():
 
 async def boot(tmp_path):
     cfg = Config()
-    cfg.db.path = ":memory:"
+    cfg.db.path = fresh_db_path()
     cfg.gossip.bind_addr = "a:1"
     cfg.api.bind_addr = ["127.0.0.1:0"]
     net = MemNetwork()
@@ -217,7 +218,7 @@ async def test_end_to_end_sync_flow(tmp_path):
 
 async def test_setup_rejects_missing_schema(tmp_path):
     cfg = Config()
-    cfg.db.path = ":memory:"
+    cfg.db.path = fresh_db_path()
     cfg.gossip.bind_addr = "a:1"
     cfg.api.bind_addr = ["127.0.0.1:0"]
     net = MemNetwork()

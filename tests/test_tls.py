@@ -9,6 +9,7 @@ rejects clients without a CA-signed cert, plaintext remains the explicit
 default, and two full agents gossip + replicate over a TLS transport.
 """
 
+from corrosion_tpu.runtime.tmpdb import fresh_db_path
 import asyncio
 import socket
 import ssl
@@ -189,7 +190,7 @@ def test_plaintext_off_without_certs_fails_loudly(tmp_path):
 
     async def main():
         cfg = Config()
-        cfg.db.path = ":memory:"
+        cfg.db.path = fresh_db_path()
         cfg.gossip.bind_addr = "127.0.0.1:0"
         cfg.gossip.plaintext = False  # no tls section configured
         with pytest.raises(ValueError, match="cert_file"):

@@ -1,6 +1,7 @@
 """Template engine tests: compiler, rendering against a live API, watch
 mode re-render on data change. Mirrors `klukai/src/tpl` coverage."""
 
+from corrosion_tpu.runtime.tmpdb import fresh_db_path
 import asyncio
 import os
 
@@ -71,7 +72,7 @@ def test_parse_spec():
 
 async def boot_api(tmp_path):
     cfg = Config()
-    cfg.db.path = ":memory:"
+    cfg.db.path = fresh_db_path()
     cfg.gossip.bind_addr = "a:1"
     cfg.api.bind_addr = ["127.0.0.1:0"]
     net = MemNetwork()
