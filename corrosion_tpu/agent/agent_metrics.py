@@ -23,8 +23,7 @@ COLLECT_INTERVAL_S = 10.0
 def collect_once(agent) -> None:
     """One synchronous collection pass (runs on a worker thread)."""
     store = agent.store
-    conn = store.acquire_read()
-    try:
+    with store.pooled_read() as conn:
         # per-table data + clock-table sizes (metrics.rs:18-60); the
         # "invalid table" signal is clock rows far exceeding data rows
         for tname in list(store.schema.tables):
@@ -56,11 +55,6 @@ def collect_once(agent) -> None:
             "SELECT COUNT(*) FROM __corro_members"
         ).fetchone()[0]
         METRICS.gauge("corro.db.members.persisted").set(members)
-    except BaseException:
-        store.release_read(conn, discard=True)
-        raise
-    else:
-        store.release_read(conn)
 
     # host-side state gauges (no db access)
     METRICS.gauge("corro.bookie.actors").set(len(agent.bookie.items()))

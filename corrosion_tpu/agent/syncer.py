@@ -242,8 +242,7 @@ async def _handle_need(
         version = need.version
 
         def read_partial():
-            conn = store.acquire_read()
-            try:
+            with store.pooled_read() as conn:
                 buffered = store.take_buffered_version(
                     actor_id, version, conn=conn
                 )
@@ -270,11 +269,6 @@ async def _handle_need(
                             )
                         )
                 return buffered, true_last, covered, live
-            except BaseException:
-                store.release_read(conn, discard=True)
-                raise
-            else:
-                store.release_read(conn)
 
         (
             buffered,

@@ -199,8 +199,7 @@ class ApiServer:
             def run_query():
                 from corrosion_tpu.runtime.trace import timed_query
 
-                conn = self.agent.store.acquire_read()
-                try:
+                with self.agent.store.pooled_read() as conn:
                     with timed_query(stmt.query):
                         cur = conn.execute(
                             stmt.query, _bind_params(stmt)
@@ -212,11 +211,6 @@ class ApiServer:
                     )
                     rows = cur.fetchall()
                     return cols, rows
-                except BaseException:
-                    self.agent.store.release_read(conn, discard=True)
-                    raise
-                else:
-                    self.agent.store.release_read(conn)
 
             try:
                 cols, rows = await loop.run_in_executor(None, run_query)
@@ -275,8 +269,7 @@ class ApiServer:
                 tables = list(self.agent.store.schema.tables)
 
             def stats():
-                conn = self.agent.store.acquire_read()
-                try:
+                with self.agent.store.pooled_read() as conn:
                     total = 0
                     invalid = []
                     for t in tables:
@@ -293,11 +286,6 @@ class ApiServer:
                         if clock_n > n:
                             invalid.append(t)
                     return total, invalid
-                except BaseException:
-                    self.agent.store.release_read(conn, discard=True)
-                    raise
-                else:
-                    self.agent.store.release_read(conn)
 
             total, invalid = await asyncio.get_running_loop().run_in_executor(
                 None, stats

@@ -121,8 +121,7 @@ class SubsManager:
         via match_changes_from_db_version, updates.rs:490)."""
         from corrosion_tpu.types.pack import pack_columns
 
-        conn = self.store.acquire_read()
-        try:
+        with self.store.pooled_read() as conn:
             for t in handle.matcher.parsed.tables:
                 pks = self.store.schema.table(t.name).pk_cols
                 sel = ", ".join(f'"{c}"' for c in pks)
@@ -133,11 +132,6 @@ class SubsManager:
                     handle.loop.call_soon_threadsafe(
                         handle._queue.put_nowait, {t.name: cands}
                     )
-        except BaseException:
-            self.store.release_read(conn, discard=True)
-            raise
-        else:
-            self.store.release_read(conn)
 
     def _read_meta_sql(self, db: Path) -> str:
         conn = sqlite3.connect(db)

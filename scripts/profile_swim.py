@@ -102,7 +102,7 @@ def main():
     @jax.jit
     def ph_viewupd(view, in_subj, in_key):
         safe = jnp.clip(in_subj, 0, n - 1)
-        eff = jnp.where(in_subj < n, in_key, 0)
+        eff = swim.to_view_key(jnp.where(in_subj < n, in_key, 0))
         prev = view[idx[:, None], safe]
         improved = eff > prev
         return view.at[idx[:, None], safe].max(eff), improved
