@@ -62,6 +62,26 @@ def collect_once(agent) -> None:
     METRICS.gauge("corro.gossip.cluster_size").set(
         agent.membership.cluster_size
     )
+    # effective SWIM config (log-scaled with cluster size — the reference
+    # publishes these so operators see the *live* values, agent.rs:29-63)
+    cfg = agent.membership.config
+    csize = max(1, agent.membership.cluster_size)
+    METRICS.gauge("corro.gossip.config.max_transmissions").set(
+        cfg.max_transmissions(csize)
+    )
+    METRICS.gauge("corro.gossip.config.num_indirect_probes").set(
+        cfg.num_indirect_probes
+    )
+    # membership FSM state census (corro.gossip.member.states) — every
+    # enum value is written each pass so a count that drops to zero
+    # actually reads zero instead of freezing at its last value
+    from corrosion_tpu.agent.membership import MemberState
+
+    by_state = {s.name: 0 for s in MemberState}
+    for m in agent.membership.members.values():
+        by_state[m.state.name] = by_state.get(m.state.name, 0) + 1
+    for name, count in by_state.items():
+        METRICS.gauge("corro.gossip.member.states", state=name).set(count)
     METRICS.gauge("corro.sync.server.permits_available").set(
         getattr(agent.sync_serve_sem, "_value", 0)
     )

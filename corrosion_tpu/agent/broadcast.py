@@ -116,6 +116,10 @@ async def broadcast_loop(agent: Agent) -> None:
                 METRICS.counter("corro.broadcast.rate_limited").inc()
         for p in requeue:
             heapq.heappush(pending, p)
+        METRICS.gauge("corro.broadcast.pending.count").set(len(pending))
+        METRICS.gauge("corro.broadcast.limiter.remaining_burst").set(
+            bucket.tokens
+        )
 
         # overflow: drop the most-sent items first (mod.rs:793-812)
         if len(pending) > perf.max_inflight_broadcasts:

@@ -72,7 +72,7 @@ async def handle_changes(agent: Agent) -> None:
     """The hot ingestion loop; owns rx_changes."""
     perf = agent.config.perf
     seen: "OrderedDict[_SeenKey, None]" = OrderedDict()
-    buf: List[Tuple[ChangeV1, ChangeSource, List[_SeenKey]]] = []
+    buf: List[Tuple[ChangeV1, ChangeSource, List[_SeenKey], float]] = []
     apply_sem = asyncio.Semaphore(perf.max_concurrent_applies)
     jobs: Set[asyncio.Task] = set()
 
@@ -86,8 +86,18 @@ async def handle_changes(agent: Agent) -> None:
         if not buf:
             return
         batch, buf[:] = buf[:], []
+        now = time.monotonic()
+        for _, _, _, t_enq in batch:
+            METRICS.histogram("corro.agent.changes.queued.seconds").observe(
+                now - t_enq
+            )
         METRICS.histogram("corro.agent.changes.batch.size").observe(len(batch))
+        METRICS.counter("corro.agent.changes.batch.spawned").inc()
+        METRICS.counter("corro.agent.changes.processing.started").inc(
+            len(batch)
+        )
         await apply_sem.acquire()
+        METRICS.gauge("corro.agent.changes.processing.jobs").set(len(jobs) + 1)
 
         async def job():
             try:
@@ -98,15 +108,18 @@ async def handle_changes(agent: Agent) -> None:
                     await asyncio.to_thread(
                         process_multiple_changes,
                         agent,
-                        [(cv, src) for cv, src, _ in batch],
+                        [(cv, src) for cv, src, _, _ in batch],
                     )
             except Exception:
                 METRICS.counter("corro.agent.changes.processing.failed").inc()
-                for _, _, keys in batch:
+                for _, _, keys, _ in batch:
                     unsee(keys)
                 raise
             finally:
                 apply_sem.release()
+                METRICS.gauge("corro.agent.changes.processing.jobs").set(
+                    max(0, len(jobs) - 1)
+                )
 
         t = asyncio.ensure_future(job())
         jobs.add(t)
@@ -129,6 +142,8 @@ async def handle_changes(agent: Agent) -> None:
 
         if item is not None:
             cv, source = item
+            METRICS.counter("corro.agent.changes.recv").inc()
+            METRICS.gauge("corro.agent.changes.in_queue").set(len(buf))
             keys = _seen_key(cv)
             if all(k in seen for k in keys) or _bookie_has(agent, cv):
                 METRICS.counter("corro.agent.changes.skipped").inc()
@@ -147,9 +162,9 @@ async def handle_changes(agent: Agent) -> None:
                     agent.tx_bcast.try_send(
                         BroadcastInput(change=cv, is_local=False)
                     )
-                buf.append((cv, source, keys))
+                buf.append((cv, source, keys, time.monotonic()))
                 if len(buf) > perf.processing_queue_len:
-                    _, _, old_keys = buf.pop(0)  # drop oldest
+                    _, _, old_keys, _ = buf.pop(0)  # drop oldest
                     unsee(old_keys)
                     METRICS.counter("corro.agent.changes.dropped").inc()
                 if deadline is None:
@@ -157,10 +172,11 @@ async def handle_changes(agent: Agent) -> None:
                         time.monotonic() + perf.apply_queue_timeout_ms / 1000.0
                     )
 
-        cost = sum(_cost(cv) for cv, _, _ in buf)
+        cost = sum(_cost(cv) for cv, _, _, _ in buf)
         expired = deadline is not None and time.monotonic() >= deadline
         if cost >= perf.apply_queue_len or (expired and buf):
             await flush()
+            METRICS.gauge("corro.agent.changes.in_queue").set(0)
             deadline = None
         elif expired:
             deadline = None

@@ -210,6 +210,10 @@ async def run(agent: Agent) -> None:
     from corrosion_tpu.agent.agent_metrics import metrics_loop
 
     t.spawn(metrics_loop(agent))
+    # event-loop lag/task gauges — tokio-metrics analog (agent.rs:29-63)
+    from corrosion_tpu.runtime import loopmon
+
+    loopmon.start(t, agent.tripwire)
     # schedule fully-buffered applies for partials already complete on disk
     for actor_id, booked in agent.bookie.items().items():
         with booked.read() as bv:

@@ -214,6 +214,10 @@ class ApiServer:
 
             try:
                 cols, rows = await loop.run_in_executor(None, run_query)
+                METRICS.counter("corro.api.queries.count").inc()
+                METRICS.histogram(
+                    "corro.api.queries.processing.time.seconds"
+                ).observe(time.monotonic() - start)
                 await resp.write((ev_columns(cols) + "\n").encode())
                 for i, row in enumerate(rows):
                     line = ev_row(i + 1, [row[k] for k in row.keys()])

@@ -194,12 +194,15 @@ async def _cmd_agent(cfg: Config) -> int:
     agent = await setup(cfg, tripwire=tripwire)
     await run(agent)
 
+    # Admin socket binds before the API listener: external supervisors
+    # (devcluster.wait_up) treat "api port accepts" as ready, so everything
+    # ready implies must already be bound by then.
+    admin = AdminServer(agent, cfg.admin.uds_path)
+    await admin.start()
+
     api = ApiServer(agent)
     await api.start()
     print(f"api listening on {', '.join(api.addrs)}")
-
-    admin = AdminServer(agent, cfg.admin.uds_path)
-    await admin.start()
 
     prom_runner = None
     if cfg.telemetry.prometheus_bind_addr:

@@ -5,6 +5,15 @@ Counterpart of `klukai-client` (`crates/klukai-client/src/lib.rs:33-420`,
 queries, subscriptions and table updates. `SubscriptionStream` tracks the
 last observed ChangeId and transparently reconnects + resubscribes from
 it on gap or disconnect (`sub.rs:328-388`).
+
+Protocol note: the reference client is HTTP/2-only (`lib.rs:33-47`,
+hyper with `http2_only(true)`). This image ships no h2 stack (`h2` and
+`hypercorn` are absent; httpx is present but its HTTP/2 mode requires
+the `h2` package), so both this client and the aiohttp server speak
+HTTP/1.1 with identical paths, headers, and NDJSON framing — an
+environment constraint, recorded the same way `runtime/trace.py` records
+the missing OTLP SDK. Streaming multiplexing loss is mitigated by
+per-stream connections (aiohttp pools keep-alive conns).
 """
 
 from __future__ import annotations

@@ -14,6 +14,12 @@ Consul client types in `klukai-types/src/consul/mod.rs`:
     corrosion HTTP API in one transaction (hash bookkeeping rides along)
   - rows written with `node = <hostname>`; deletes/upserts are scoped to
     this node's rows
+  - reverse TTL sync: configured `[[consul.ttl_checks]]` entries map a
+    store SQL query onto a Consul TTL check; statuses are PUT back to
+    `/v1/agent/check/update/<id>`, hash-gated on (status, output) with a
+    forced refresh inside the TTL window (this reference snapshot's
+    consul client is poll-only — consul/mod.rs:111-116 — so the write
+    direction follows Consul's own TTL check-update API contract)
 """
 
 from __future__ import annotations
@@ -118,6 +124,22 @@ class ConsulClient:
             resp.raise_for_status()
             data = await resp.json()
         return {k: AgentCheck.from_json(v) for k, v in data.items()}
+
+    async def update_ttl_check(
+        self, check_id: str, status: str, output: str = ""
+    ) -> None:
+        """PUT /v1/agent/check/update/<id> — refresh a TTL check.
+
+        The reverse half of the sync: this reference snapshot's client
+        only polls (consul/mod.rs:111-116 — GETs, no writer), so the
+        write-back follows Consul's own TTL check-update API contract
+        (status must be passing|warning|critical)."""
+        s = await self._ensure()
+        async with s.put(
+            f"{self.base}/v1/agent/check/update/{check_id}",
+            json={"Status": status, "Output": output},
+        ) as resp:
+            resp.raise_for_status()
 
 
 # -- hashing ---------------------------------------------------------------
@@ -330,6 +352,31 @@ def _check_statements(node, check: AgentCheck, h: int, updated_at: int):
     ]
 
 
+# -- reverse TTL status derivation ----------------------------------------
+
+_TTL_STATUSES = ("passing", "warning", "critical")
+
+
+def derive_ttl_status(rows: List[Any]) -> Tuple[str, str]:
+    """Map a store query result onto a Consul TTL status.
+
+    Contract: no rows → critical; if the first cell is a literal status
+    string it is used verbatim (second cell, if any, becomes the output);
+    otherwise the first cell's truthiness decides passing/critical. This
+    lets one `SELECT 'passing', 'detail'`-style query drive the check
+    directly, while `SELECT count(*) > 0 FROM ...` works unadorned."""
+    if not rows:
+        return "critical", "query returned no rows"
+    row = rows[0]
+    cell = row[0] if isinstance(row, (list, tuple)) else row
+    if isinstance(cell, str) and cell in _TTL_STATUSES:
+        out = ""
+        if isinstance(row, (list, tuple)) and len(row) > 1 and row[1] is not None:
+            out = str(row[1])
+        return cell, out
+    return ("passing", "") if cell else ("critical", f"query returned {cell!r}")
+
+
 # -- sync engine -----------------------------------------------------------
 
 
@@ -341,12 +388,18 @@ class ConsulSync:
         consul: ConsulClient,
         api,
         node: Optional[str] = None,
+        ttl_checks: Optional[List[dict]] = None,
+        ttl_refresh: float = 30.0,
     ):
         self.consul = consul
         self.api = api
         self.node = node or socket.gethostname()
         self.service_hashes: Dict[str, int] = {}
         self.check_hashes: Dict[str, int] = {}
+        self.ttl_checks = list(ttl_checks or ())
+        self.ttl_refresh = ttl_refresh
+        # check id -> (hash of last PUT (status, output), monotonic time)
+        self._ttl_state: Dict[str, Tuple[int, float]] = {}
 
     async def load_hashes(self) -> None:
         """Warm the in-memory hash caches from the persisted tables."""
@@ -362,9 +415,13 @@ class ConsulSync:
 
     async def tick(self) -> Tuple[ApplyStats, ApplyStats]:
         """One pull + diff + apply round (sync.rs update_consul)."""
+        t_poll = time.monotonic()
         services, checks = await asyncio.gather(
             asyncio.wait_for(self.consul.agent_services(), CONSUL_TIMEOUT),
             asyncio.wait_for(self.consul.agent_checks(), CONSUL_TIMEOUT),
+        )
+        METRICS.histogram("corro_consul.consul.response.time.seconds").observe(
+            time.monotonic() - t_poll
         )
         svc_up, svc_del = diff_services(services, self.service_hashes)
         chk_up, chk_del = diff_checks(checks, self.check_hashes)
@@ -414,6 +471,9 @@ class ConsulSync:
         for cid in chk_del:
             self.check_hashes.pop(cid, None)
 
+        if self.ttl_checks:
+            await self.update_ttl_checks()
+
         svc_stats = ApplyStats(len(svc_up), len(svc_del))
         chk_stats = ApplyStats(len(chk_up), len(chk_del))
         METRICS.counter("corro_consul.services.upserted").inc(svc_stats.upserted)
@@ -421,6 +481,47 @@ class ConsulSync:
         METRICS.counter("corro_consul.checks.upserted").inc(chk_stats.upserted)
         METRICS.counter("corro_consul.checks.deleted").inc(chk_stats.deleted)
         return svc_stats, chk_stats
+
+    async def update_ttl_checks(self) -> int:
+        """Reverse sync: evaluate each configured TTL check's query against
+        the store and PUT the derived status back to the local Consul
+        agent. Hash-gated like the forward path — an unchanged
+        (status, output) pair is NOT re-sent unless `ttl_refresh` seconds
+        have elapsed since the last PUT (TTL checks lapse to critical on
+        the Consul side if never refreshed, so gating can't be absolute).
+        Returns the number of PUTs issued."""
+        sent = 0
+        for spec in self.ttl_checks:
+            cid = spec.get("id")
+            query = spec.get("query")
+            if not cid or not query:
+                continue
+            try:
+                rows = await self.api.query_rows(query)
+                status, output = derive_ttl_status(rows)
+            except Exception as e:  # store unreachable → check fails
+                status, output = "critical", f"query failed: {e}"
+            h = _h64(status, output)
+            prev = self._ttl_state.get(cid)
+            now = time.monotonic()
+            if (
+                prev is not None
+                and prev[0] == h
+                and now - prev[1] < self.ttl_refresh
+            ):
+                continue
+            # one failing PUT (e.g. check not yet registered → 404) must
+            # not starve the remaining checks or abort the tick
+            try:
+                await self.consul.update_ttl_check(cid, status, output)
+            except Exception as e:
+                METRICS.counter("corro_consul.consul.response.errors").inc()
+                log.warning("ttl check %s update failed: %s", cid, e)
+                continue
+            self._ttl_state[cid] = (h, now)
+            sent += 1
+            METRICS.counter("corro_consul.ttl_checks.updated").inc()
+        return sent
 
     async def run(self, tripwire=None) -> None:
         await setup(self.api)
@@ -459,7 +560,12 @@ async def consul_sync_loop(agent, consul_cfg: ConsulConfig, tripwire) -> None:
     )
     consul = ConsulClient(consul_cfg.address)
     try:
-        await ConsulSync(consul, api).run(tripwire)
+        await ConsulSync(
+            consul,
+            api,
+            ttl_checks=consul_cfg.ttl_checks,
+            ttl_refresh=consul_cfg.ttl_refresh_seconds,
+        ).run(tripwire)
     finally:
         await consul.close()
         await api.close()
@@ -477,7 +583,12 @@ async def run_consul_sync_cli(cfg) -> int:
     consul = ConsulClient(consul_cfg.address)
     tripwire = Tripwire.from_signals()
     try:
-        await ConsulSync(consul, api).run(tripwire)
+        await ConsulSync(
+            consul,
+            api,
+            ttl_checks=consul_cfg.ttl_checks,
+            ttl_refresh=consul_cfg.ttl_refresh_seconds,
+        ).run(tripwire)
         return 0
     except ConsulSetupError as e:
         print(f"error: {e}")

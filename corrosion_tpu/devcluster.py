@@ -297,6 +297,14 @@ uds_path = "{admin}"
             )
 
     def wait_up(self, timeout: float = 60.0) -> None:
+        """Block until every node's API port accepts AND its admin socket
+        answers a ping.
+
+        The admin check is load-bearing: the agent binds the admin socket
+        before the API listener, but callers that connect to admin.sock the
+        instant the API port opens were racing socket creation on slow
+        machines (the r3 flake).  Ready means both surfaces answer.
+        """
         deadline = time.monotonic() + timeout
         for name, port in self.api_ports.items():
             while time.monotonic() < deadline:
@@ -310,6 +318,34 @@ uds_path = "{admin}"
                     time.sleep(0.1)
             else:
                 raise TimeoutError(f"node {name} api never came up")
+        for name, path in self.admin_paths.items():
+            while time.monotonic() < deadline:
+                if self._admin_ping(path):
+                    break
+                if self.procs[name].poll() is not None:
+                    raise RuntimeError(f"node {name} exited early")
+                time.sleep(0.1)
+            else:
+                raise TimeoutError(f"node {name} admin never answered ping")
+
+    @staticmethod
+    def _admin_ping(path: str) -> bool:
+        """Synchronous UDS ping using the admin frame protocol
+        (4-byte BE length + JSON; admin.py read_frame/write_frame)."""
+        import struct
+
+        try:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(1.0)
+            s.connect(path)
+            body = b'{"cmd":"ping"}'
+            s.sendall(struct.pack(">I", len(body)) + body)
+            hdr = s.recv(4)
+            ok = len(hdr) == 4
+            s.close()
+            return ok
+        except OSError:
+            return False
 
     def stop(self, timeout: float = 15.0) -> None:
         import signal as _signal

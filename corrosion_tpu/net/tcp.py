@@ -109,6 +109,8 @@ class _UdpProtocol(asyncio.DatagramProtocol):
         self._owner = owner
 
     def datagram_received(self, data: bytes, addr) -> None:
+        METRICS.counter("corro.transport.udp_rx.datagrams").inc()
+        METRICS.counter("corro.transport.udp_rx.bytes").inc(len(data))
         handler = self._owner._on_datagram
         if handler is not None:
             asyncio.ensure_future(handler(f"{addr[0]}:{addr[1]}", data))
@@ -191,6 +193,12 @@ class TcpListener(Listener):
                 frame = await _read_frame(reader)
                 if frame is None:
                     break
+                METRICS.counter(
+                    "corro.transport.datagram.recv.total"
+                ).inc()
+                METRICS.counter(
+                    "corro.transport.datagram.bytes.recv.total"
+                ).inc(len(frame) + 4)
                 if self._on_datagram is not None:
                     asyncio.ensure_future(self._on_datagram(peer_addr, frame))
             writer.close()
@@ -265,6 +273,8 @@ class TcpTransport(Transport):
         host, port = split_addr(addr)
         udp.sendto(data, (host, port))
         METRICS.counter("corro.transport.datagram.sent").inc()
+        METRICS.counter("corro.transport.udp_tx.datagrams").inc()
+        METRICS.counter("corro.transport.udp_tx.bytes").inc(len(data))
 
     async def _connect(self, addr: str, lane: bytes):
         host, port = split_addr(addr)

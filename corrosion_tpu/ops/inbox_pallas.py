@@ -1,0 +1,143 @@
+"""Pallas TPU kernel for the bounded gossip-inbox build.
+
+The SWIM tick's hottest host-of-FLOPs-free op is compacting ~N*fanout
+gossip packets (each carrying m piggybacked updates for ONE destination)
+into bounded [N, slots] per-member inboxes (`ops/swim.py:build_inbox`,
+the r3 profile's dominant phase). The XLA paths express this as a
+lexicographic `lax.sort` — O(M log M) over M = G*m messages ("sort") or
+G packet heads ("gsort").
+
+This kernel replaces the sort with what the operation actually is: a
+sequential scatter with per-destination fill counters. TPU has no
+scatter unit, but Pallas gives us what XLA's HLO can't express — a
+single program that walks the G packets in order, keeps the fill
+counters `counts[n]` and both inbox planes resident in VMEM, and does a
+read-modify-write of ONE [slots]-wide row per packet. Order of work:
+O(G * slots) with no log factor and no [M]-wide intermediate arrays.
+
+Semantics are bit-identical to `build_inbox` on the flattened message
+list (tests/test_inbox_impls.py): packets are visited in group-major
+(= flat stable-sort) order, so each destination receives its first
+`slots` valid messages in arrival order.
+
+The per-packet inner step is vectorized: a packet's m messages land in
+columns base+prefix, expressed as an [slots, m] match matrix reduced on
+the VPU — no scalar inner loop. Only the packet walk itself is serial
+(the counts[] carry makes it inherently so).
+
+Selected via `SwimParams.inbox_impl = "pallas"`; `build_inbox_pallas`
+falls back to interpret mode off-TPU so the flag is portable (and the
+bit-equality tests run on CPU).
+
+Reference lineage: the inbox bound mirrors the reference's bounded
+processing queue with drop semantics (broadcast/mod.rs:793-812); the
+kernel form is ours (SURVEY §7 "Pallas kernels — not Python stand-ins").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+# VMEM budget gate: inputs (4 planes of [G, m] int32) + outputs
+# ([n, slots] * 2) + counts must fit comfortably in ~16 MB VMEM.
+_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def _kernel(dst_ref, subj_ref, key_ref, pos_ref, cnt_ref,
+            out_subj_ref, out_key_ref, counts_ref, *, n, slots, m):
+    g_total = dst_ref.shape[0]
+
+    # init: outputs and counters (allocations arrive uninitialized)
+    out_subj_ref[:] = jnp.full((n, slots), n, dtype=jnp.int32)
+    out_key_ref[:] = jnp.zeros((n, slots), dtype=jnp.int32)
+    counts_ref[:] = jnp.zeros((n, 1), dtype=jnp.int32)
+
+    col_iota = jax.lax.broadcasted_iota(jnp.int32, (slots, m), 0)
+
+    def body(g, _):
+        d = dst_ref[g, 0]
+        base = counts_ref[d, 0]
+        subj = subj_ref[g, :]          # [m]
+        key = key_ref[g, :]
+        pos = pos_ref[g, :]            # exclusive valid-prefix, -1 = masked
+        valid = pos >= 0
+        col = base + pos               # [m]
+        keep = valid & (col < slots)
+        # match[c, k]: message k lands in column c — VPU reduce, no loop
+        match = keep[None, :] & (col[None, :] == col_iota)  # [slots, m]
+        upd_subj = jnp.min(
+            jnp.where(match, subj[None, :], n), axis=1
+        )                              # [slots]
+        upd_key = jnp.max(jnp.where(match, key[None, :], 0), axis=1)
+        hit = jnp.any(match, axis=1)   # [slots]
+        cur_subj = out_subj_ref[d, :]
+        cur_key = out_key_ref[d, :]
+        out_subj_ref[d, :] = jnp.where(hit, upd_subj, cur_subj)
+        out_key_ref[d, :] = jnp.where(hit, upd_key, cur_key)
+        counts_ref[d, 0] = base + cnt_ref[g, 0]
+        return _
+
+    jax.lax.fori_loop(0, g_total, body, 0)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def build_inbox_pallas(
+    n: int,
+    slots: int,
+    dst_g: jax.Array,
+    subj: jax.Array,
+    key: jax.Array,
+    ok: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Same contract as `swim.build_inbox_grouped`: dst_g [G] in [0, n),
+    subj/key/ok [G, m]; returns ([n, slots] subj, [n, slots] key)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    g, m = subj.shape
+    # valid-prefix within the packet, computed vectorized outside the
+    # kernel; -1 marks masked messages so the kernel needs no ok plane
+    oki = ok.astype(jnp.int32)
+    pos = jnp.where(ok, jnp.cumsum(oki, axis=1) - oki, -1).astype(jnp.int32)
+    cnt = jnp.sum(oki, axis=1, keepdims=True)
+
+    total = 4 * (4 * g * m + 2 * n * slots + n)
+    if total > _VMEM_BUDGET_BYTES:
+        raise ValueError(
+            f"inbox_pallas: working set {total}B exceeds VMEM budget"
+            f" (G={g}, m={m}, n={n}); use inbox_impl='gsort'"
+        )
+
+    interpret = jax.default_backend() != "tpu"
+    kernel = functools.partial(_kernel, n=n, slots=slots, m=m)
+    vm = pltpu.VMEM
+    return pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((n, slots), jnp.int32),
+            jax.ShapeDtypeStruct((n, slots), jnp.int32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=vm),  # dst [G, 1]
+            pl.BlockSpec(memory_space=vm),  # subj [G, m]
+            pl.BlockSpec(memory_space=vm),  # key [G, m]
+            pl.BlockSpec(memory_space=vm),  # pos [G, m]
+            pl.BlockSpec(memory_space=vm),  # cnt [G, 1]
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=vm),
+            pl.BlockSpec(memory_space=vm),
+        ),
+        scratch_shapes=[pltpu.VMEM((n, 1), jnp.int32)],  # fill counters
+        interpret=interpret,
+    )(
+        dst_g.reshape(g, 1).astype(jnp.int32),
+        subj.astype(jnp.int32),
+        key.astype(jnp.int32),
+        pos,
+        cnt.astype(jnp.int32),
+    )

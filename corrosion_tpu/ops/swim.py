@@ -83,6 +83,12 @@ class SwimParams(NamedTuple):
     # widely it is currently held — without it, bounded partial views
     # drift rich-get-richer until rare members go extinct
     loss: float = 0.0  # iid per-leg message loss probability
+    inbox_impl: str = "gsort"  # gossip-inbox build: "sort" (flat
+    # lax.sort, the r3 baseline), "gsort" (grouped sort: only the
+    # N*fanout packet heads are sorted — messages in one packet share a
+    # destination; ~20% faster tick at n=10k on the CPU fallback, default),
+    # or "pallas" (sequential grouped scatter kernel, ops/inbox_pallas.py).
+    # All three are bit-equal (tests/test_inbox_impls.py).
 
 
 VIEW_DTYPE = jnp.int16
@@ -98,7 +104,11 @@ the clamp there is defense in depth and preserves the precedence bits —
 a saturated key must not decode as a different member state. Gossip
 buffers and inboxes stay int32."""
 
-_KEY_CLAMP_BASE = (INC_CAP - 1) * 4 + 4  # multiple of 4: prec bits survive
+# Saturated keys clamp to incarnation INC_CAP exactly — the maximum any
+# in-repo generator can emit — so an overflowing int32 gossip key ranks
+# EQUAL to a capped-generation key, never below it (a lower clamp would
+# let a stale capped key beat a saturated refutation).
+_KEY_CLAMP_BASE = (INC_CAP + 1) * 4  # multiple of 4: prec bits survive
 
 
 def finger_offsets(n: int) -> jnp.ndarray:
@@ -305,6 +315,83 @@ def build_inbox(
     return in_subj, in_key
 
 
+def build_inbox_grouped(
+    n: int,
+    slots: int,
+    dst_g: jax.Array,
+    subj: jax.Array,
+    key: jax.Array,
+    ok: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Grouped inbox build, bit-equal to `build_inbox` over the flattened
+    message list. Gossip messages leave in packets: all `m` piggybacked
+    updates of one (sender, fanout-slot) pair share a destination, so the
+    flat [G*m] list is G runs of m equal-dst messages in group-major
+    order. Only the G packet heads need the stable sort-by-destination;
+    a message's inbox column is then (exclusive prefix of valid counts
+    over earlier same-dst packets) + (valid-prefix within its packet).
+    Shrinks the dominant lax.sort from G*m to G elements — the r3 CPU
+    profile had the flat sort at ~60% of the tick.
+
+    `dst_g` is [G] (real destinations, already clipped to [0, n));
+    `subj`/`key`/`ok` are [G, m]; masked messages are dropped exactly
+    like the flat path's dst=n sentinel ones.
+    """
+    g = dst_g.shape[0]
+    cnt = jnp.sum(ok, axis=1).astype(jnp.int32)
+    pos = jnp.cumsum(ok, axis=1).astype(jnp.int32) - ok.astype(jnp.int32)
+    order = jnp.arange(g, dtype=jnp.int32)
+    dst_s, idx_s, cnt_s = jax.lax.sort(
+        (dst_g, order, cnt), dimension=0, num_keys=1, is_stable=True
+    )
+    cum_before = jnp.cumsum(cnt_s) - cnt_s
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), dst_s[1:] != dst_s[:-1]]
+    )
+    # cum_before is non-decreasing, so a running max of segment-start
+    # values yields each packet's own segment base
+    seg_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(is_start, cum_before, 0)
+    )
+    base_s = cum_before - seg_start
+    base = jnp.zeros((g,), jnp.int32).at[idx_s].set(base_s)
+    col = base[:, None] + pos
+    keep = ok & (col < slots)
+    rows = jnp.where(keep, dst_g[:, None], 0)
+    cols = jnp.where(keep, col, 0)
+    # same unique-cell scatter as build_inbox: each real (row, col) cell
+    # receives at most one message, masked writes to (0, 0) are no-ops
+    in_subj = jnp.full((n, slots), n, dtype=jnp.int32)
+    in_key = jnp.zeros((n, slots), dtype=jnp.int32)
+    in_subj = in_subj.at[rows, cols].min(jnp.where(keep, subj, n))
+    in_key = in_key.at[rows, cols].max(jnp.where(keep, key, 0))
+    return in_subj, in_key
+
+
+def dispatch_inbox(
+    impl: str,
+    n: int,
+    slots: int,
+    dst_g: jax.Array,
+    subj_gm: jax.Array,
+    key_gm: jax.Array,
+    ok_gm: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Build the bounded inbox with the selected implementation. All
+    impls consume the grouped [G, m] form and are bit-equal; "sort"
+    flattens to the r3 flat-sort path."""
+    if impl == "gsort":
+        return build_inbox_grouped(n, slots, dst_g, subj_gm, key_gm, ok_gm)
+    if impl == "pallas":
+        from corrosion_tpu.ops.inbox_pallas import build_inbox_pallas
+
+        return build_inbox_pallas(n, slots, dst_g, subj_gm, key_gm, ok_gm)
+    dst = jnp.where(ok_gm, dst_g[:, None], n).reshape(-1)
+    subj = jnp.where(ok_gm, subj_gm, n).reshape(-1)
+    key = jnp.where(ok_gm, key_gm, 0).reshape(-1)
+    return build_inbox(n, slots, dst, subj, key)
+
+
 def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState:
     """Advance every member one SWIM protocol period (trace-level impl;
     use `tick` for the jitted form, `tick_n` for k periods per dispatch)."""
@@ -477,17 +564,20 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
         jax.random.uniform(r_loss, msg_ok.shape) < params.loss
     )
     msg_ok = msg_ok & ~drop
-    dst = jnp.broadcast_to(tg_safe[:, :, None], msg_ok.shape)
-    subj = jnp.broadcast_to(send_subj[:, None, :], msg_ok.shape)
-    key = jnp.broadcast_to(send_key[:, None, :], msg_ok.shape)
-    # masked → dst n: sorts past every real destination, never delivered
-    dst = jnp.where(msg_ok, dst, n).reshape(-1)
-    subj = jnp.where(msg_ok, subj, n).reshape(-1)
-    key = jnp.where(msg_ok, key, 0).reshape(-1)
 
-    # ---- 4. inbox: sort by destination, rank in group, compact ----------
-    in_subj, in_key = build_inbox(
-        n, params.incoming_slots, dst, subj, key
+    # ---- 4. inbox: compact messages into bounded per-member inboxes ----
+    # grouped [G, m] form (G = N*fanout packets, equal-dst runs); the
+    # impl choice (flat sort / grouped sort / pallas) is bit-equal
+    subj_gm = jnp.broadcast_to(send_subj[:, None, :], msg_ok.shape)
+    key_gm = jnp.broadcast_to(send_key[:, None, :], msg_ok.shape)
+    in_subj, in_key = dispatch_inbox(
+        params.inbox_impl,
+        n,
+        params.incoming_slots,
+        tg_safe.reshape(-1),
+        subj_gm.reshape(-1, m),
+        key_gm.reshape(-1, m),
+        msg_ok.reshape(-1, m),
     )
 
     # ---- 4b. announce/feed exchange --------------------------------------

@@ -67,7 +67,7 @@ from corrosion_tpu.ops.swim import (
     PREC_DOWN,
     PREC_SUSPECT,
     _buffer_merge,
-    build_inbox,
+    dispatch_inbox,
     finger_offsets,
     key_inc,
     key_known,
@@ -111,6 +111,7 @@ class PViewParams(NamedTuple):
     tie_epoch: int = 48  # ticks between tie-break re-maskings (see _mask)
     loss: float = 0.0
     identity_hash: bool = False
+    inbox_impl: str = "gsort"  # see swim.SwimParams.inbox_impl
 
 
 def _keycap(n: int) -> int:
@@ -462,15 +463,19 @@ def tick_impl(
     )
     drop = jax.random.uniform(r_loss, msg_ok.shape) < params.loss
     msg_ok = msg_ok & ~drop
-    dst = jnp.broadcast_to(tg_safe[:, :, None], msg_ok.shape)
-    subj = jnp.broadcast_to(send_subj[:, None, :], msg_ok.shape)
-    key = jnp.broadcast_to(send_key[:, None, :], msg_ok.shape)
-    dst = jnp.where(msg_ok, dst, n).reshape(-1)
-    subj = jnp.where(msg_ok, subj, n).reshape(-1)
-    key = jnp.where(msg_ok, key, 0).reshape(-1)
 
-    # ---- 4. inbox (shared sort/rank/compact) -----------------------------
-    in_subj, in_key = build_inbox(n, params.incoming_slots, dst, subj, key)
+    # ---- 4. inbox (shared grouped build, impl-dispatched) ----------------
+    subj_gm = jnp.broadcast_to(send_subj[:, None, :], msg_ok.shape)
+    key_gm = jnp.broadcast_to(send_key[:, None, :], msg_ok.shape)
+    in_subj, in_key = dispatch_inbox(
+        params.inbox_impl,
+        n,
+        params.incoming_slots,
+        tg_safe.reshape(-1),
+        subj_gm.reshape(-1, m),
+        key_gm.reshape(-1, m),
+        msg_ok.reshape(-1, m),
+    )
 
     # ---- 4b. announce/feed exchange over SLOT space ----------------------
     # identical window/rng structure to the dense kernel, but the window

@@ -49,9 +49,11 @@ def multihost_member_mesh(
     playbook (collectives on the fast axis innermost).
 
     In a single-process job this degrades to the ordinary member mesh
-    (no jax.distributed needed), which is what the tests drive; real
-    multi-host runs need the actual fleet and are exercised operationally
-    rather than in CI.
+    (no jax.distributed needed). The multi-process path is exercised for
+    real in CI: tests/test_dcn_multiprocess.py joins two local processes
+    (4 virtual CPU devices each) through jax.distributed and asserts the
+    cross-process sharded tick stays bit-identical to the single-process
+    flat-mesh run.
     """
     import os
     from collections import Counter

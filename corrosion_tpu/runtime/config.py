@@ -104,6 +104,14 @@ class AdminConfig:
 class ConsulConfig:
     enabled: bool = False
     address: str = "127.0.0.1:8500"  # consul agent HTTP address
+    # Reverse TTL sync: each entry is a `[[consul.ttl_checks]]` TOML table
+    # {"id": <consul check id>, "query": <SQL run against the store>}.
+    # The sync loop evaluates the query each tick and PUTs the derived
+    # pass/warn/fail status to /v1/agent/check/update/<id>, hash-gated on
+    # (status, output) with a forced refresh every ttl_refresh_seconds so
+    # the Consul-side TTL never lapses while we're healthy.
+    ttl_checks: List[dict] = field(default_factory=list)
+    ttl_refresh_seconds: float = 30.0
 
 
 @dataclass

@@ -1,0 +1,56 @@
+"""Event-loop instrumentation — the asyncio analog of tokio-metrics.
+
+The reference samples its tokio runtime every 5 s and publishes worker/
+scheduling gauges (`klukai/src/command/agent.rs:29-63`: park counts,
+steal counts, queue depths, `corro.tokio.*`). asyncio has no worker pool,
+so the translation keeps what is diagnosable on a single-threaded loop:
+
+  corro.runtime.loop.lag.seconds       sampled scheduling lag histogram —
+                                       sleep(dt) vs actual wakeup delta;
+                                       the single most useful stall signal
+  corro.runtime.loop.lag.max.seconds   gauge: worst lag in the last window
+  corro.runtime.loop.tasks.alive       gauge: len(asyncio.all_tasks())
+  corro.runtime.loop.ticks             counter: monitor wakeups
+
+The thread-pool analogs of tokio's stealing/park metrics
+(corro.tokio.total_steal_count etc.) have no asyncio counterpart and are
+itemized as inapplicable in COMPONENTS.md §metrics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from corrosion_tpu.runtime.metrics import METRICS
+
+SAMPLE_INTERVAL = 0.5
+REPORT_EVERY = 10  # samples per max-lag window (≈5 s, agent.rs:63 cadence)
+
+
+async def loop_lag_monitor(tripwire=None) -> None:
+    """Run forever (until cancelled or tripped), publishing loop health."""
+    lag_hist = METRICS.histogram("corro.runtime.loop.lag.seconds")
+    lag_max = METRICS.gauge("corro.runtime.loop.lag.max.seconds")
+    tasks_g = METRICS.gauge("corro.runtime.loop.tasks.alive")
+    ticks = METRICS.counter("corro.runtime.loop.ticks")
+    window_max = 0.0
+    i = 0
+    while tripwire is None or not tripwire.tripped:
+        t0 = time.monotonic()
+        await asyncio.sleep(SAMPLE_INTERVAL)
+        lag = max(0.0, time.monotonic() - t0 - SAMPLE_INTERVAL)
+        lag_hist.observe(lag)
+        window_max = max(window_max, lag)
+        ticks.inc()
+        i += 1
+        if i % REPORT_EVERY == 0:
+            lag_max.set(window_max)
+            window_max = 0.0
+            tasks_g.set(len(asyncio.all_tasks()))
+
+
+def start(tracker, tripwire=None) -> Optional[asyncio.Task]:
+    """Spawn the monitor on the agent's task tracker (spawn_counted)."""
+    return tracker.spawn(loop_lag_monitor(tripwire))

@@ -10,7 +10,9 @@ contract, deliberately not unified.)
 
 from __future__ import annotations
 
+import fcntl
 import json
+import os
 from typing import List, Sequence
 
 
@@ -29,15 +31,25 @@ def merge_records(
         raise KeyError(
             f"record(s) missing merge key {key!r}: {missing[:2]!r}"
         )
-    try:
-        with open(path) as f:
-            existing = json.load(f)
-    except (OSError, ValueError):
-        existing = []
-    mine = {r[key] for r in records}
-    merged = [
-        r for r in existing if not (key in r and r[key] in mine)
-    ] + list(records)
-    with open(path, "w") as f:
-        json.dump(merged, f, indent=2)
+    # The read-merge-write below must be atomic across processes: two
+    # scripts recording concurrently would otherwise each read the same
+    # base list and the second write would drop the first's rungs.  A
+    # sidecar .lock file (flock does not survive os.replace of the
+    # locked file) serializes the whole cycle.
+    lock_path = path + ".lock"
+    with open(lock_path, "a") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        try:
+            with open(path) as f:
+                existing = json.load(f)
+        except (OSError, ValueError):
+            existing = []
+        mine = {r[key] for r in records}
+        merged = [
+            r for r in existing if not (key in r and r[key] in mine)
+        ] + list(records)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(merged, f, indent=2)
+        os.replace(tmp, path)
     return merged

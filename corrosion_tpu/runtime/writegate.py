@@ -51,6 +51,13 @@ class PriorityWriteGate:
             METRICS.gauge(
                 f"corro.write_gate.waiting.{lane.name.lower()}"
             ).set(len(self._waiters[lane]))
+        # SplitPool write-side parity (agent.rs:478): 1 permit total
+        METRICS.gauge("corro.sqlite.write.permits.available").set(
+            0 if self._held else 1
+        )
+        METRICS.gauge("corro.sqlite.pool.write.connections.waiting").set(
+            sum(len(q) for q in self._waiters)
+        )
 
     async def acquire(self, lane: WritePriority = WritePriority.NORMAL) -> None:
         if not self._held and not any(self._waiters):

@@ -244,6 +244,9 @@ class _InterruptWatchdog:
                 self._deadlines.pop(token, None)
                 try:
                     self._conn.interrupt()
+                    from corrosion_tpu.runtime.metrics import METRICS
+
+                    METRICS.counter("corro.sqlite.interrupt").inc()
                 except sqlite3.ProgrammingError:
                     return  # connection closed — watchdog retires
 
@@ -299,6 +302,7 @@ class CrdtStore:
         self._pk_unpack_cache: Dict[bytes, tuple] = {}
         self._read_pool: List[sqlite3.Connection] = []
         self._read_pool_lock = threading.Lock()
+        self._read_out = 0  # checked-out read conns (pool gauges)
         self._closed = False
         # resolve (and on first use, compile) the native merge engine NOW:
         # doing it lazily inside _apply_batch would run a g++ subprocess
@@ -374,7 +378,20 @@ class CrdtStore:
         so a checkout must not block on `self._lock` while a write batch
         holds it across BEGIN IMMEDIATE..COMMIT (the SplitPool read side
         is lock-free with respect to the write side, agent.rs:478-519)."""
+        from corrosion_tpu.runtime.metrics import METRICS
+
         with self._read_pool_lock:
+            if self._closed:
+                raise sqlite3.ProgrammingError(
+                    "cannot acquire read connection: store is closed"
+                )
+            self._read_out += 1
+            METRICS.gauge("corro.sqlite.pool.read.connections").set(
+                self._read_out
+            )
+            METRICS.gauge(
+                "corro.sqlite.pool.read.connections.available"
+            ).set(len(self._read_pool))
             if self._read_pool:
                 return self._read_pool.pop()
         return self.read_conn()
@@ -389,6 +406,13 @@ class CrdtStore:
         half-consumed generator), and a parked open statement pins its
         WAL read snapshot — the next acquirer would read stale data and
         block checkpointing. Discarded conns are closed, not pooled."""
+        from corrosion_tpu.runtime.metrics import METRICS
+
+        with self._read_pool_lock:
+            self._read_out = max(0, self._read_out - 1)
+            METRICS.gauge("corro.sqlite.pool.read.connections").set(
+                self._read_out
+            )
         if not discard:
             with self._read_pool_lock:
                 if (
@@ -396,6 +420,9 @@ class CrdtStore:
                     and len(self._read_pool) < self.READ_POOL_MAX
                 ):
                     self._read_pool.append(conn)
+                    METRICS.gauge(
+                        "corro.sqlite.pool.read.connections.available"
+                    ).set(len(self._read_pool))
                     return
         # discarding, pool full, or the store closed while this conn was
         # checked out — close it instead of parking it open forever
@@ -1020,9 +1047,12 @@ class CrdtStore:
                     # unless an earlier equal-cl win cached it in s["vals"]
                     if ch.cid in s["vals"]:
                         cur = s["vals"][ch.cid]
-                    elif s["disk"] is not None:
-                        cur = s["disk"].get(ch.cid)
+                    elif s["disk"] is not None and ch.cid in s["disk"]:
+                        cur = s["disk"][ch.cid]
                     else:
+                        # tie cids are always in the prefetched union; if
+                        # that invariant ever breaks, degrade to a per-row
+                        # read rather than comparing against a wrong NULL
                         cur = self._current_value(conn, t, ch.pk, ch.cid)
                     if cmp_values(ch.val, cur) <= 0:
                         continue

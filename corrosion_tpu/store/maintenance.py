@@ -18,6 +18,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import time
 from typing import Optional
 
 from corrosion_tpu.runtime.metrics import METRICS
@@ -56,8 +57,14 @@ def truncate_wal_if_needed(
     caller escalates `attempt`)."""
     size = wal_size_bytes(store)
     METRICS.gauge("corro.db.wal_size_bytes").set(size)
+    if not store._is_memory:
+        try:
+            METRICS.gauge("corro.db.size").set(os.path.getsize(store.path))
+        except OSError:
+            pass
     if size <= threshold_bytes:
         return None
+    t_ckpt = time.monotonic()
     timeout_ms = int(calc_busy_timeout_s(attempt) * 1000)
     with store._lock:
         store._conn.execute(f"PRAGMA busy_timeout = {timeout_ms}")
@@ -79,6 +86,9 @@ def truncate_wal_if_needed(
         )
         return False
     METRICS.counter("corro.db.wal_truncate.ok").inc()
+    METRICS.histogram("corro.db.wal.truncate.seconds").observe(
+        time.monotonic() - t_ckpt
+    )
     logger.info("WAL truncated (was %d bytes)", size)
     return True
 
@@ -98,10 +108,15 @@ def incremental_vacuum_if_needed(
     databases created without it this is a no-op (freelist still reported
     but incremental_vacuum reclaims nothing)."""
     reclaimed = 0
+    t_vac = time.monotonic()
     while True:
         free = freelist_pages(store)
         METRICS.gauge("corro.db.freelist_pages").set(free)
         if free < min_freelist_pages:
+            if reclaimed:
+                METRICS.histogram(
+                    "corro.db.incremental.vacuum.seconds"
+                ).observe(time.monotonic() - t_vac)
             return reclaimed
         with store._lock:
             store._conn.execute(f"PRAGMA incremental_vacuum({chunk_pages})")

@@ -73,6 +73,31 @@ class Row:
     def to_json(self) -> str:
         return json.dumps(dict(zip(self._cols, self._vals)))
 
+    def cells(self) -> List["Cell"]:
+        """The row as (name, value) cells — the reference's Cell type
+        with name()/value()/is_null()/to_json()/to_string()
+        (tpl/mod.rs:493-500)."""
+        return [Cell(c, v) for c, v in zip(self._cols, self._vals)]
+
+
+class Cell:
+    """One (column, value) pair (tpl/mod.rs Cell + SqliteValueWrap)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str, value: Any):
+        self.name = name
+        self.value = value
+
+    def is_null(self) -> bool:
+        return self.value is None
+
+    def to_json(self) -> str:
+        return json.dumps(self.value)
+
+    def to_string(self) -> str:
+        return _stringify(self.value)
+
 
 class QueryResponse:
     """Iterable result set with to_json()/to_csv() (tpl/mod.rs:38-98)."""
@@ -231,10 +256,53 @@ class TemplateState:
                 await c.close()
         self.streams = []
 
+    def exec_cmd(self, cmd: str, *args: str, timeout: float = 10.0) -> str:
+        """Run a subprocess from inside a template and return its stdout.
+
+        The upstream templating engine exposes user scripting with
+        command execution; this reference snapshot's rhai engine stops at
+        the write/to_json/to_csv surface (tpl/mod.rs:451-500), so the
+        contract here is the minimal safe form: argv (no shell), bounded
+        by `timeout`, non-zero exit raises. Renders run in a worker
+        thread (render_once), so blocking is fine.
+
+        OFF by default: a template file is data, and silently granting it
+        command execution would widen the agent's attack surface to
+        anything that can write a .tpl. Enable explicitly with
+        CORRO_TPL_ALLOW_EXEC=1 in the agent's environment."""
+        import subprocess
+
+        if os.environ.get("CORRO_TPL_ALLOW_EXEC", "") not in ("1", "true"):
+            raise TemplateError(
+                "exec_cmd is disabled; set CORRO_TPL_ALLOW_EXEC=1 to allow"
+                " templates to run commands"
+            )
+
+        try:
+            res = subprocess.run(
+                [cmd, *args],
+                capture_output=True,
+                text=True,
+                timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            raise TemplateError(
+                f"exec_cmd {cmd!r} timed out after {timeout}s"
+            ) from None
+        except OSError as e:
+            raise TemplateError(f"exec_cmd {cmd!r} failed: {e}") from None
+        if res.returncode != 0:
+            raise TemplateError(
+                f"exec_cmd {cmd!r} exited {res.returncode}:"
+                f" {res.stderr.strip()[:200]}"
+            )
+        return res.stdout
+
     def namespace(self) -> dict:
         return {
             "sql": self.sql,
             "hostname": lambda: socket.gethostname(),
+            "exec_cmd": self.exec_cmd,
         }
 
 

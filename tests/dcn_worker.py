@@ -1,0 +1,73 @@
+"""Worker process for the 2-process DCN mesh test (test_dcn_multiprocess).
+
+Each worker owns 4 virtual CPU devices; jax.distributed stitches the two
+processes into one 8-device job over localhost gRPC — the CI-scale stand-in
+for the reference's multi-process QUIC mesh (one process per node,
+SURVEY §2.6 comm-backend row). The [hosts, members] mesh then spans both
+processes; per-tick cross-shard collectives actually cross the process
+boundary, which is exactly what the degenerate single-process test could
+never exercise.
+
+Prints one JSON line: replicated membership stats + a state fingerprint.
+Bit-parity with the single-process flat-mesh run is asserted by the parent.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from corrosion_tpu.runtime import jaxenv  # noqa: E402
+
+jaxenv.force_cpu_inprocess(n_devices=4)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def main() -> None:
+    coord = sys.argv[1]
+    pid = int(sys.argv[2])
+    nprocs = int(sys.argv[3])
+    n_ticks = int(sys.argv[4])
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=nprocs, process_id=pid
+    )
+    assert len(jax.devices()) == 4 * nprocs, jax.devices()
+
+    from corrosion_tpu.ops import swim
+    from corrosion_tpu.parallel import (
+        multihost_member_mesh,
+        shard_member_state,
+        sharded_tick,
+    )
+
+    mesh = multihost_member_mesh()
+    assert mesh.devices.shape == (nprocs, 4), mesh.devices.shape
+
+    params = swim.SwimParams(n=8 * 4 * nprocs)
+    state = shard_member_state(
+        swim.init_state(params, jax.random.PRNGKey(3)), mesh
+    )
+    tick = sharded_tick(params, mesh)
+    rng = jax.random.PRNGKey(9)
+    for _ in range(n_ticks):
+        rng, key = jax.random.split(rng)
+        state = tick(state, key)
+
+    # replicated reductions: every process computes the same full-cluster
+    # values, so both workers must print identical lines
+    stats = {k: float(v) for k, v in swim.membership_stats(state).items()}
+    fp = int(jnp.sum((state.view.astype(jnp.int32) * 92821) % 1000003))
+    print(
+        json.dumps(
+            {"pid": pid, "fingerprint": fp, "stats": stats}, sort_keys=True
+        ),
+        flush=True,
+    )
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,7 @@ from corrosion_tpu.consul import (
     ConsulClient,
     ConsulSetupError,
     ConsulSync,
+    derive_ttl_status,
     diff_checks,
     diff_services,
     hash_check,
@@ -51,6 +52,7 @@ class FakeConsul:
     def __init__(self):
         self.services = {}
         self.checks = {}
+        self.ttl_updates = []  # (check_id, {"Status":…, "Output":…}) PUTs
         self.runner = None
         self.addr = None
 
@@ -58,6 +60,9 @@ class FakeConsul:
         app = web.Application()
         app.router.add_get("/v1/agent/services", self.h_services)
         app.router.add_get("/v1/agent/checks", self.h_checks)
+        app.router.add_put(
+            "/v1/agent/check/update/{cid}", self.h_check_update
+        )
         self.runner = web.AppRunner(app)
         await self.runner.setup()
         site = web.TCPSite(self.runner, "127.0.0.1", 0)
@@ -74,6 +79,13 @@ class FakeConsul:
 
     async def h_checks(self, _req):
         return web.json_response(self.checks)
+
+    async def h_check_update(self, req):
+        body = await req.json()
+        if body.get("Status") not in ("passing", "warning", "critical"):
+            return web.json_response({"error": "bad status"}, status=400)
+        self.ttl_updates.append((req.match_info["cid"], body))
+        return web.json_response({})
 
 
 def svc_json(sid, name, port=80, tags=(), addr="10.0.0.1"):
@@ -208,6 +220,87 @@ async def test_end_to_end_sync_flow(tmp_path):
         svc_stats, _ = await sync2.tick()
         assert svc_stats.is_zero
         await sync2.consul.close()
+    finally:
+        await consul.close()
+        await api.close()
+        await fake.stop()
+        await api_srv.stop()
+        await shutdown(agent)
+
+
+def test_derive_ttl_status():
+    assert derive_ttl_status([]) == ("critical", "query returned no rows")
+    assert derive_ttl_status([["passing", "all good"]]) == (
+        "passing", "all good",
+    )
+    assert derive_ttl_status([["warning"]]) == ("warning", "")
+    assert derive_ttl_status([[1]]) == ("passing", "")
+    assert derive_ttl_status([[0]])[0] == "critical"
+
+
+async def test_reverse_ttl_sync_flow(tmp_path):
+    """Store state drives TTL check PUTs back into the Consul agent,
+    hash-gated on (status, output) with a forced refresh inside the TTL
+    window."""
+    agent, api_srv = await boot(tmp_path)
+    fake = FakeConsul()
+    await fake.start()
+    api = CorrosionApiClient(api_srv.addrs[0])
+    consul = ConsulClient(fake.addr)
+    try:
+        sync = ConsulSync(
+            consul,
+            api,
+            node="testnode",
+            ttl_checks=[
+                {
+                    "id": "corrosion-live",
+                    "query": (
+                        "SELECT CASE WHEN count(*) > 0 THEN 'passing'"
+                        " ELSE 'critical' END, 'services=' || count(*)"
+                        " FROM consul_services"
+                    ),
+                }
+            ],
+            ttl_refresh=3600.0,
+        )
+        await consul_setup(api)
+        await sync.load_hashes()
+
+        # round 1: empty store → critical PUT back to consul
+        await sync.tick()
+        assert fake.ttl_updates == [
+            ("corrosion-live", {"Status": "critical", "Output": "services=0"})
+        ]
+
+        # round 2: unchanged state inside the refresh window → no new PUT
+        await sync.tick()
+        assert len(fake.ttl_updates) == 1
+
+        # round 3: a service lands in the store → status flips to passing
+        fake.services["s1"] = svc_json("s1", "web")
+        await sync.tick()
+        assert fake.ttl_updates[-1] == (
+            "corrosion-live",
+            {"Status": "passing", "Output": "services=1"},
+        )
+        assert len(fake.ttl_updates) == 2
+
+        # round 4: refresh window elapsed → unchanged status IS re-sent
+        # (Consul lapses a TTL check that is never refreshed)
+        sync.ttl_refresh = 0.0
+        await sync.tick()
+        assert len(fake.ttl_updates) == 3
+        assert fake.ttl_updates[-1][1]["Status"] == "passing"
+
+        # a broken query degrades to a critical PUT, not an exception
+        sync.ttl_checks = [
+            {"id": "corrosion-live", "query": "SELECT * FROM nope"}
+        ]
+        sync.ttl_refresh = 3600.0
+        await sync.tick()
+        assert fake.ttl_updates[-1][1]["Status"] == "critical"
+        assert "query failed" in fake.ttl_updates[-1][1]["Output"]
     finally:
         await consul.close()
         await api.close()

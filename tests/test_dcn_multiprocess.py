@@ -1,0 +1,92 @@
+"""Real multi-process DCN mesh test (VERDICT r3 item 4).
+
+Two OS processes, each with 4 virtual CPU devices, joined by
+jax.distributed over localhost — the smallest genuine instance of the
+multi-host story in `parallel/mesh.py:multihost_member_mesh` (host axis
+outermost, member blocks process-contiguous). Unlike the degenerate
+single-process case, the per-tick gossip collectives here really cross a
+process boundary (gRPC standing in for DCN).
+
+Parity bar: both workers print identical replicated stats/fingerprint
+lines, and those match a single-process flat-mesh run of the same
+computation — the mesh layout and the transport are not allowed to
+change a single bit of protocol state.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from corrosion_tpu.ops import swim
+from corrosion_tpu.parallel import member_mesh, shard_member_state, sharded_tick
+from corrosion_tpu.runtime import jaxenv
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "dcn_worker.py")
+N_TICKS = 5
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_mesh_parity():
+    coord = f"127.0.0.1:{_free_port()}"
+    env = jaxenv.stripped_env(n_devices=4)
+    # each worker builds its own 4-device CPU client; the coordinator
+    # handshake must happen before any backend init, which the worker
+    # script guarantees by initializing distributed first
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-u", WORKER, coord, str(pid), "2",
+             str(N_TICKS)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+
+    # both workers observed the same replicated cluster state
+    a, b = outs
+    assert a["fingerprint"] == b["fingerprint"]
+    assert a["stats"] == b["stats"]
+
+    # ... and it matches the single-process flat-mesh computation
+    n_dev = 8
+    devices = jax.devices()[:n_dev]
+    params = swim.SwimParams(n=8 * n_dev)
+    mesh = member_mesh(devices)
+    state = shard_member_state(
+        swim.init_state(params, jax.random.PRNGKey(3)), mesh
+    )
+    tick = sharded_tick(params, mesh)
+    rng = jax.random.PRNGKey(9)
+    for _ in range(N_TICKS):
+        rng, key = jax.random.split(rng)
+        state = tick(state, key)
+    stats = {k: float(v) for k, v in swim.membership_stats(state).items()}
+    fp = int(jnp.sum((state.view.astype(jnp.int32) * 92821) % 1000003))
+    assert a["fingerprint"] == fp
+    assert a["stats"] == stats

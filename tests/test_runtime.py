@@ -252,3 +252,31 @@ def test_invariants_hold_under_replication_workload(tmp_path):
             os.environ.pop(inv._MODE_ENV, None)
         else:
             os.environ[inv._MODE_ENV] = old
+
+
+def test_loop_lag_monitor():
+    """The tokio-metrics analog publishes lag/task gauges while running
+    and drains promptly when the tripwire fires."""
+    import asyncio
+
+    from corrosion_tpu.runtime import loopmon
+    from corrosion_tpu.runtime.metrics import METRICS
+    from corrosion_tpu.runtime.tripwire import TaskTracker, Tripwire
+
+    old_interval = loopmon.SAMPLE_INTERVAL
+    loopmon.SAMPLE_INTERVAL = 0.02
+    try:
+        async def main():
+            trip = Tripwire()
+            tracker = TaskTracker()
+            loopmon.start(tracker, trip)
+            await asyncio.sleep(0.5)
+            trip.trip()
+            assert await tracker.wait_all(2.0)
+
+        asyncio.run(main())
+    finally:
+        loopmon.SAMPLE_INTERVAL = old_interval
+    reg = METRICS.render_prometheus()
+    assert "corro_runtime_loop_ticks" in reg or "corro.runtime.loop.ticks" in reg
+    assert "loop_lag" in reg.replace(".", "_") or "lag" in reg

@@ -141,6 +141,48 @@ async def test_render_to_json_and_cmd(tmp_path):
         await shutdown(agent)
 
 
+def test_row_cells_helpers():
+    qr = QueryResponse(["id", "name"], [[1, None]])
+    cells = list(qr)[0].cells()
+    assert [(c.name, c.value) for c in cells] == [("id", 1), ("name", None)]
+    assert not cells[0].is_null() and cells[1].is_null()
+    assert cells[0].to_json() == "1"
+    assert cells[1].to_string() == ""
+
+
+async def test_exec_cmd_in_template(tmp_path, monkeypatch):
+    """Templates can shell out via exec_cmd (argv, no shell) and inline
+    the stdout — but only with the explicit CORRO_TPL_ALLOW_EXEC opt-in;
+    failures and timeouts surface as TemplateError."""
+    from corrosion_tpu.tpl import TemplateState
+
+    agent, api = await boot_api(tmp_path)
+    try:
+        # default-off: without the opt-in a template cannot run commands
+        loop0 = asyncio.get_running_loop()
+        locked = TemplateState(api.addrs[0], None, loop0, False)
+        with pytest.raises(TemplateError, match="disabled"):
+            locked.exec_cmd("echo", "hi")
+        monkeypatch.setenv("CORRO_TPL_ALLOW_EXEC", "1")
+        src = tmp_path / "t.tpl"
+        src.write_text("v=<%= exec_cmd('echo', 'hi').strip() %>")
+        dst = tmp_path / "out.txt"
+        await render_once(api.addrs[0], None, str(src), str(dst), None)
+        assert dst.read_text() == "v=hi"
+
+        loop = asyncio.get_running_loop()
+        state = TemplateState(api.addrs[0], None, loop, False)
+        with pytest.raises(TemplateError, match="exited 3"):
+            state.exec_cmd("sh", "-c", "exit 3")
+        with pytest.raises(TemplateError, match="timed out"):
+            state.exec_cmd("sleep", "5", timeout=0.2)
+        with pytest.raises(TemplateError, match="failed"):
+            state.exec_cmd("definitely-not-a-binary")
+    finally:
+        await api.stop()
+        await shutdown(agent)
+
+
 async def test_watch_rerenders_on_data_change(tmp_path):
     from corrosion_tpu.tpl import _watch_one
 
