@@ -143,7 +143,6 @@ async def handle_changes(agent: Agent) -> None:
         if item is not None:
             cv, source = item
             METRICS.counter("corro.agent.changes.recv").inc()
-            METRICS.gauge("corro.agent.changes.in_queue").set(len(buf))
             keys = _seen_key(cv)
             if all(k in seen for k in keys) or _bookie_has(agent, cv):
                 METRICS.counter("corro.agent.changes.skipped").inc()
@@ -172,6 +171,7 @@ async def handle_changes(agent: Agent) -> None:
                         time.monotonic() + perf.apply_queue_timeout_ms / 1000.0
                     )
 
+        METRICS.gauge("corro.agent.changes.in_queue").set(len(buf))
         cost = sum(_cost(cv) for cv, _, _, _ in buf)
         expired = deadline is not None and time.monotonic() >= deadline
         if cost >= perf.apply_queue_len or (expired and buf):

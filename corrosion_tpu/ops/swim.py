@@ -386,6 +386,9 @@ def dispatch_inbox(
         from corrosion_tpu.ops.inbox_pallas import build_inbox_pallas
 
         return build_inbox_pallas(n, slots, dst_g, subj_gm, key_gm, ok_gm)
+    if impl != "sort":
+        # a typo must not silently select the slowest path
+        raise ValueError(f"unknown inbox_impl {impl!r}")
     dst = jnp.where(ok_gm, dst_g[:, None], n).reshape(-1)
     subj = jnp.where(ok_gm, subj_gm, n).reshape(-1)
     key = jnp.where(ok_gm, key_gm, 0).reshape(-1)

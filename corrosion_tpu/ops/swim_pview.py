@@ -642,6 +642,15 @@ def set_alive(state: PViewState, member: int, value: bool) -> PViewState:
     return state._replace(alive=alive, inc=inc)
 
 
+def set_alive_many(state: PViewState, members, value: bool) -> PViewState:
+    """Batch churn injection: one vectorized update instead of one
+    dispatch per member (a 1% churn at n=100k is 1000 members)."""
+    idx = jnp.asarray(members, dtype=jnp.int32)
+    alive = state.alive.at[idx].set(value)
+    inc = state.inc.at[idx].add(1) if value else state.inc
+    return state._replace(alive=alive, inc=inc)
+
+
 def set_partition(state: PViewState, groups) -> PViewState:
     """Partition injection (see swim.set_partition)."""
     return state._replace(partition=jnp.asarray(groups, dtype=jnp.int32))
@@ -675,6 +684,24 @@ def _stats_impl(params: PViewParams, packed, alive, t):
     fp_entries = occupied & (prec >= PREC_SUSPECT) & live_obs & subj_alive
     fp = jnp.sum(fp_entries) / jnp.maximum(jnp.sum(af) * (n_alive - 1), 1.0)
     occ = jnp.sum(occupied & live_obs) / (n_alive * params.slots)
+    # churn detection: a dead member counts as DETECTED when no live
+    # observer still holds an ALIVE entry for it (suspect/down entries and
+    # absence both mean "won't be routed to") — the partial-view analog of
+    # the dense kernel's "dead members marked down" (swim.py stats)
+    stale_alive = (
+        occupied & (prec == PREC_ALIVE) & live_obs & ~subj_alive
+    )
+    stale_per_subject = (
+        jnp.zeros(n, dtype=jnp.int32)
+        .at[jnp.where(stale_alive, subj, 0)]
+        .add(stale_alive.astype(jnp.int32))
+    )
+    n_dead = jnp.sum(~alive)
+    detected = jnp.where(
+        n_dead > 0,
+        jnp.sum((~alive) & (stale_per_subject == 0)) / jnp.maximum(n_dead, 1),
+        1.0,
+    )
     return jnp.stack(
         [
             pv_cov,
@@ -682,6 +709,7 @@ def _stats_impl(params: PViewParams, packed, alive, t):
             min_in.astype(jnp.float32),
             occ,
             fp.astype(jnp.float32),
+            detected.astype(jnp.float32),
         ]
     )
 
@@ -708,6 +736,7 @@ def membership_stats(state: PViewState, params: PViewParams) -> dict:
         "min_in_degree": float(vals[2]),
         "occupancy": float(vals[3]),
         "false_positive": float(vals[4]),
+        "detected": float(vals[5]),
     }
 
 

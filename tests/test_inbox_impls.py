@@ -90,9 +90,8 @@ def test_tick_bit_equal_across_impls(impl):
         assert jnp.array_equal(a, b)
 
 
-def test_dispatch_unknown_impl_falls_back_to_sort():
+def test_dispatch_unknown_impl_raises():
     n, g, m, slots = 16, 32, 4, 8
     dst, subj, key, ok = _random_case(5, n, g, m, 0.7, n)
-    ref = swim.dispatch_inbox("sort", n, slots, dst, subj, key, ok)
-    got = swim.dispatch_inbox("definitely-not", n, slots, dst, subj, key, ok)
-    assert jnp.array_equal(ref[0], got[0]) and jnp.array_equal(ref[1], got[1])
+    with pytest.raises(ValueError, match="inbox_impl"):
+        swim.dispatch_inbox("definitely-not", n, slots, dst, subj, key, ok)
