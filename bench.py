@@ -39,6 +39,7 @@ _CHILD_FLAG = "CORRO_BENCH_CHILD"
 
 def child_main() -> None:
     """The measured simulation; runs under an env chosen by the parent."""
+    jaxenv.enable_compilation_cache()
     import jax
 
     from corrosion_tpu.models.cluster import ClusterSim

@@ -212,3 +212,27 @@ def run_python(
         )
     except subprocess.TimeoutExpired:
         return None
+
+
+def enable_compilation_cache(path: str | None = None) -> str:
+    """Point jax at a persistent on-disk compilation cache.
+
+    VERDICT r3 weak #7: sharded compiles measured 268 s (n=262k) and
+    522 s (n=1M) on the virtual CPU mesh, and every measurement script
+    paid them again. The XLA compilation cache persists compiled
+    executables keyed by HLO fingerprint, so a re-run of the same config
+    (the common case for the scale ladders and the bench) skips straight
+    to execution. Safe to call before or after jax import, but must run
+    before the first compilation. Returns the cache dir.
+    """
+    cache = path or os.environ.get(
+        "CORRO_JAX_CACHE", "/tmp/corrosion_jax_cache"
+    )
+    os.makedirs(cache, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache)
+    # cache everything that took noticeable time, not only >1s programs
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return cache

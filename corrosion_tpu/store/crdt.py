@@ -317,7 +317,9 @@ class CrdtStore:
     def _is_memory(self) -> bool:
         return "mode=memory" in self.path
 
-    def _setup_conn(self, conn: sqlite3.Connection) -> None:
+    def _setup_conn(
+        self, conn: sqlite3.Connection, writer: bool = True
+    ) -> None:
         if not self._is_memory:
             # INCREMENTAL before any table exists so the maintenance
             # loops can reclaim freelist pages (setup.rs:80, the
@@ -329,10 +331,15 @@ class CrdtStore:
         conn.execute("PRAGMA foreign_keys = OFF")
         conn.execute("PRAGMA recursive_triggers = OFF")
         # ingest-path I/O tuning (bench_ingest.py): negative cache_size is
-        # KiB — 64 MiB page cache keeps the clock-table btree hot across
-        # sync-flood batches; temp_store dodges disk spills on the IN(...)
-        # prefetch sorts; mmap reads skip the syscall per page
-        conn.execute("PRAGMA cache_size = -65536")
+        # KiB — a 64 MiB page cache keeps the clock-table btree hot across
+        # sync-flood batches, but ONLY on the single write connection; up
+        # to 20 pooled readers each holding 64 MiB would balloon resident
+        # memory, so readers keep a modest 8 MiB. temp_store dodges disk
+        # spills on the IN(...) prefetch sorts; mmap reads (shared pages)
+        # skip the syscall per page
+        conn.execute(
+            f"PRAGMA cache_size = {-65536 if writer else -8192}"
+        )
         conn.execute("PRAGMA temp_store = MEMORY")
         try:
             conn.execute("PRAGMA mmap_size = 268435456")
@@ -468,6 +475,14 @@ class CrdtStore:
         conn = sqlite3.connect(self.path, check_same_thread=False, uri=True)
         conn.row_factory = sqlite3.Row
         conn.execute("PRAGMA query_only = ON")
+        # modest read-side tuning: 8 MiB cache (20 pooled readers stay
+        # ~160 MiB worst case), shared mmap pages, in-memory sort spills
+        conn.execute("PRAGMA cache_size = -8192")
+        conn.execute("PRAGMA temp_store = MEMORY")
+        try:
+            conn.execute("PRAGMA mmap_size = 268435456")
+        except sqlite3.DatabaseError:
+            pass
         # custom SQL fns must exist on READ connections too — that is
         # where /v1/queries and the pubsub matcher run user SQL
         conn.create_function(

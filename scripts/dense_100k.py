@@ -41,6 +41,8 @@ jaxenv.reexec_under_cpu(
     timeout=float(os.environ.get("DENSE_100K_BUDGET_S", "7000")),
 )
 
+jaxenv.enable_compilation_cache()
+
 import jax  # noqa: E402
 
 from corrosion_tpu.ops import swim  # noqa: E402

@@ -22,6 +22,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from corrosion_tpu.runtime import jaxenv
+
+jaxenv.enable_compilation_cache()
+
 import jax
 import jax.numpy as jnp
 

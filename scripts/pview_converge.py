@@ -35,7 +35,10 @@ from corrosion_tpu.runtime import jaxenv  # noqa: E402
 # --devices N (default 1); argv is NOT mutated — reexec_under_cpu forwards
 # sys.argv[1:] verbatim, so the child must see the same flag
 if "--devices" in sys.argv:
-    DEVICES = int(sys.argv[sys.argv.index("--devices") + 1])
+    _di = sys.argv.index("--devices")
+    if _di + 1 >= len(sys.argv):
+        sys.exit("usage: pview_converge.py [n] [slots] [--devices N]")
+    DEVICES = int(sys.argv[_di + 1])
 else:
     DEVICES = 1
 # re-exec under a stripped CPU env unless already the child — or keep the
@@ -43,6 +46,8 @@ else:
 jaxenv.reexec_under_cpu(
     "PVIEW_CHILD", n_devices=DEVICES, prefer_inherited_probe_s=20.0
 )
+
+jaxenv.enable_compilation_cache()
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
@@ -137,7 +142,10 @@ def main() -> None:
     det_ticks = None
     churn_stats = {}
     n_kill = max(1, n // 100)
-    if converged:
+    skip_churn = os.environ.get("PVIEW_SKIP_CHURN") == "1"
+    if skip_churn:
+        n_kill = 0
+    if converged and not skip_churn:
         kill = np.random.default_rng(7).choice(n, size=n_kill, replace=False)
         state = swim_pview.set_alive_many(state, kill, False)
         t0 = time.monotonic()
@@ -160,7 +168,9 @@ def main() -> None:
         churn_wall = 0.0
 
     rec = {
-        "rung": f"A-convergence-{n}",
+        # churn-skipped runs record under their own rung key so they can
+        # never overwrite a full run's detection evidence
+        "rung": f"A-convergence-{n}" + ("-boot" if skip_churn else ""),
         "n": n,
         "slots": slots,
         "devices": DEVICES,
@@ -181,9 +191,12 @@ def main() -> None:
             "stats": {k: round(v, 6) for k, v in churn_stats.items()},
         },
     }
+    if skip_churn:
+        rec["churn"] = {"skipped": True}
     merge_records(os.path.join(REPO, "PVIEW_SCALE.json"), [rec])
     print(json.dumps(rec), flush=True)
-    sys.exit(0 if (converged and det_ticks is not None) else 1)
+    ok = converged and (skip_churn or det_ticks is not None)
+    sys.exit(0 if ok else 1)
 
 
 if __name__ == "__main__":

@@ -35,6 +35,8 @@ jaxenv.reexec_under_cpu(
     timeout=float(os.environ.get("PVIEW_SCALE_BUDGET_S", "3000")),
 )
 
+jaxenv.enable_compilation_cache()
+
 import jax  # noqa: E402
 
 from corrosion_tpu.ops import swim_pview  # noqa: E402

@@ -38,6 +38,8 @@ jaxenv.reexec_under_cpu(
     prefer_inherited_probe_s=float(os.environ.get("BENCH_PROBE_S", "60")),
 )
 
+jaxenv.enable_compilation_cache()
+
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
