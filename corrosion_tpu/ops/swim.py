@@ -152,7 +152,9 @@ class SwimState(NamedTuple):
     view: jax.Array  # [N, N] VIEW_DTYPE (int16) — key matrix, view[obs, subj]
     buf_subj: jax.Array  # [N, B] int32 — gossip buffer subject (N = empty)
     buf_key: jax.Array  # [N, B] int32
-    buf_sent: jax.Array  # [N, B] int32 — send count (INT32_MAX = empty)
+    buf_sent: jax.Array  # [N, B] int32 — send count (empty slots hold
+    # INT32_MAX at init; merges normalize them to _SENT_CLAMP — detect
+    # empties via subj == n, or sent >= max_transmissions for sendability)
     probe_phase: jax.Array  # [N] int32 — 0 idle / 1 direct / 2 indirect
     probe_subj: jax.Array  # [N] int32
     probe_deadline: jax.Array  # [N] int32
@@ -252,12 +254,23 @@ def _pick_known_alive(view_rows, self_idx, rng, params: SwimParams, tries: int):
     return jnp.where(found, pick, n)
 
 
-def _buffer_merge(params: SwimParams, buf_subj, buf_key, buf_sent,
-                  in_subj, in_key):
-    """Merge incoming updates (send_count 0) into each member's buffer:
-    dedupe by subject keeping the highest key, then keep the
-    `buffer_slots` least-transmitted entries (drop-most-sent overflow,
-    like the reference's queue trim at broadcast/mod.rs:793-812)."""
+# (key, sent) pack into one non-negative int31: keys are capped at
+# make_key(INC_CAP, 3) = 32763 < 2^15 everywhere they are generated (see
+# VIEW_DTYPE note), and real send counts stay ≤ max_transmissions+fanout
+# ≪ 2^15 — the INT32_MAX empty sentinel clamps to _SENT_CLAMP, which
+# still orders after every real count
+_KEY_BITS = 15
+_KEY_MAX = (1 << _KEY_BITS) - 1
+_SENT_CLAMP = (1 << _KEY_BITS) - 1
+
+
+def buffer_merge_lex(params, buf_subj, buf_key, buf_sent,
+                     in_subj, in_key):
+    """Three-operand lexicographic form of the buffer merge — correct
+    for FULL int32 keys. The partial-view kernel must use this one: its
+    refutation incarnations clip to `swim_pview.inc_cap(n)` (up to ~2^21
+    at small n), far above the dense kernel's 15-bit key domain that
+    `_buffer_merge`'s packed sort requires."""
     n = params.n
     subj = jnp.concatenate([buf_subj, in_subj], axis=1)
     key = jnp.concatenate([buf_key, in_key], axis=1)
@@ -282,6 +295,61 @@ def _buffer_merge(params: SwimParams, buf_subj, buf_key, buf_sent,
     )
     b = params.buffer_slots
     return subj_f[:, :b], key_f[:, :b], sent_f[:, :b]
+
+
+def _buffer_merge(params: SwimParams, buf_subj, buf_key, buf_sent,
+                  in_subj, in_key):
+    """Merge incoming updates (send_count 0) into each member's buffer:
+    dedupe by subject keeping the highest key, then keep the
+    `buffer_slots` least-transmitted entries (drop-most-sent overflow,
+    like the reference's queue trim at broadcast/mod.rs:793-812).
+
+    DENSE-KERNEL ONLY: requires keys < 2^15, which the dense kernel
+    guarantees (incarnations cap at INC_CAP, the int16-view invariant).
+    The partial-view kernel's keys can reach inc_cap(n) ≈ 2^21 — it
+    must call `buffer_merge_lex` instead.
+
+    Both row sorts co-sort TWO operands instead of three by packing
+    (key desc, sent asc) — and then (sent asc, key desc) — into one
+    int31 word (~20% off the phase, the tick's hottest after the
+    grouped inbox landed). The pack preserves the exact lexicographic
+    order of the r3 three-operand sort for the dedupe pass; the trim
+    pass additionally becomes DETERMINISTIC on send-count ties (fresher
+    keys first), where the old single-key sort left tie order to XLA.
+    Empty slots come back with sent = _SENT_CLAMP (not INT32_MAX);
+    every consumer only tests `sent < max_transmissions` or ordering."""
+    n = params.n
+    subj = jnp.concatenate([buf_subj, in_subj], axis=1)
+    key = jnp.concatenate([buf_key, in_key], axis=1)
+    sent = jnp.concatenate(
+        [buf_sent, jnp.where(in_subj < n, 0, INT32_MAX)], axis=1
+    )
+    sent_c = jnp.minimum(sent, _SENT_CLAMP)
+    # sort 1: subject asc, then (key desc, sent asc) as one packed word
+    combo = ((_KEY_MAX - key) << _KEY_BITS) | sent_c
+    subj_s, combo_s = jax.lax.sort((subj, combo), dimension=1, num_keys=2)
+    key_s = _KEY_MAX - (combo_s >> _KEY_BITS)
+    sent_s = combo_s & _SENT_CLAMP
+    dup = jnp.concatenate(
+        [jnp.zeros((subj.shape[0], 1), bool), subj_s[:, 1:] == subj_s[:, :-1]],
+        axis=1,
+    )
+    subj_s = jnp.where(dup, n, subj_s)
+    # sort 2: least-sent first (empties/dups sort last), fresher keys
+    # first within a send-count tie
+    combo2 = jnp.where(
+        dup,
+        (_SENT_CLAMP << _KEY_BITS) | _KEY_MAX,
+        (sent_s << _KEY_BITS) | (_KEY_MAX - key_s),
+    )
+    combo2_f, subj_f = jax.lax.sort((combo2, subj_s), dimension=1, num_keys=1)
+    b = params.buffer_slots
+    combo2_f = combo2_f[:, :b]
+    return (
+        subj_f[:, :b],
+        _KEY_MAX - (combo2_f & _KEY_MAX),
+        combo2_f >> _KEY_BITS,
+    )
 
 
 def build_inbox(

@@ -24,8 +24,11 @@ evicted from its own table by a colliding squatter.
 
 Packing bound: key*P + field < 2^31 requires key < 2^31/P (P =
 next_pow2(n)), so refutation incarnations are clipped to `inc_cap(n)`
-(= 524 286 at n=1000, 2046 at n=262144, 510 at n=1M) — far beyond any
-realistic churn (SWIM incarnations in practice stay < 100).
+— AND to the dense kernel's INC_CAP = 8189, whichever is smaller, so
+every key also fits the shared packed buffer merge's 15-bit domain
+(inc_cap alone: 524 286 at n=1000, 2046 at n=262144, 510 at n=1M).
+Either bound is far beyond realistic churn (SWIM incarnations in
+practice stay < 100).
 
 With `identity_hash=True` and `slots == n`, h is the identity, slot `s`
 holds subject `s`, and this kernel is **bit-equivalent to the dense
@@ -66,6 +69,7 @@ from corrosion_tpu.ops.swim import (
     PREC_ALIVE,
     PREC_DOWN,
     PREC_SUSPECT,
+    INC_CAP,
     _buffer_merge,
     dispatch_inbox,
     finger_offsets,
@@ -216,7 +220,7 @@ class PViewState(NamedTuple):
     slot_packed: jax.Array  # [N, K] int32 — key*P + (subj^mask), 0 = empty
     buf_subj: jax.Array  # [N, B] int32 — gossip buffer (N = empty)
     buf_key: jax.Array  # [N, B] int32
-    buf_sent: jax.Array  # [N, B] int32 (INT32_MAX = empty)
+    buf_sent: jax.Array  # [N, B] int32 (empty: INT32_MAX at init; subj==n is the real marker)
     probe_phase: jax.Array  # [N] int32
     probe_subj: jax.Array  # [N] int32
     probe_deadline: jax.Array  # [N] int32
@@ -537,7 +541,10 @@ def tick_impl(
     worst_diag = jnp.where(key_prec(selfk) >= PREC_SUSPECT, key_inc(selfk), -1)
     worst = jnp.maximum(worst_msg, worst_diag)
     refute = alive & (worst >= 0) & (worst >= inc)
-    cap = inc_cap(n)
+    # both bounds bind: the packed-slot word needs key*P < 2^31
+    # (inc_cap(n)), and the shared packed buffer merge needs keys < 2^15
+    # (INC_CAP, the dense kernel's generation cap) — see _buffer_merge
+    cap = min(inc_cap(n), INC_CAP)
     inc = jnp.where(refute, jnp.minimum(worst + 1, cap), inc)
     own_upd_subj = own_upd_subj.at[:, 2].set(jnp.where(refute, idx, n))
     own_upd_key = own_upd_key.at[:, 2].set(
@@ -587,7 +594,9 @@ def tick_impl(
         occupied, _pack(params, s_raw, k_raw, idx[:, None], t + 1), 0
     )
 
-    # _buffer_merge is shape-generic (uses only .n / .buffer_slots):
+    # _buffer_merge is shape-generic (uses only .n / .buffer_slots);
+    # its 15-bit packed key domain holds here because pview incarnations
+    # clip to min(inc_cap(n), INC_CAP) at every generation site:
     # same [N, B] gossip buffers as the dense kernel
     buf_subj, buf_key, buf_sent = _buffer_merge(
         params, buf_subj, buf_key, buf_sent, bin_subj, bin_key
@@ -638,7 +647,11 @@ tick_n_donated = functools.partial(
 def set_alive(state: PViewState, member: int, value: bool) -> PViewState:
     """Churn injection: crash or (re)start a member process."""
     alive = state.alive.at[member].set(value)
-    inc = jnp.where(value, state.inc.at[member].add(1), state.inc)
+    inc = jnp.where(
+        value,
+        jnp.minimum(state.inc.at[member].add(1), INC_CAP),
+        state.inc,
+    )
     return state._replace(alive=alive, inc=inc)
 
 
@@ -647,7 +660,11 @@ def set_alive_many(state: PViewState, members, value: bool) -> PViewState:
     dispatch per member (a 1% churn at n=100k is 1000 members)."""
     idx = jnp.asarray(members, dtype=jnp.int32)
     alive = state.alive.at[idx].set(value)
-    inc = state.inc.at[idx].add(1) if value else state.inc
+    inc = (
+        jnp.minimum(state.inc.at[idx].add(1), INC_CAP)
+        if value
+        else state.inc
+    )
     return state._replace(alive=alive, inc=inc)
 
 

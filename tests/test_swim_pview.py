@@ -237,3 +237,34 @@ def test_fingers_seed_mode_pview():
         swim_pview.init_state(
             params, jax.random.PRNGKey(0), seed_mode="nope"
         )
+
+
+def test_incarnation_generation_sites_respect_packed_key_domain():
+    """Every incarnation generator clips to min(inc_cap(n), INC_CAP):
+    the shared packed buffer merge (_buffer_merge) decodes keys through
+    a 15-bit field, so a generated key may never exceed
+    make_key(INC_CAP, 3) — the regression the r4 review caught when
+    pview briefly generated inc_cap(n)-sized incarnations into it."""
+    import jax.numpy as jnp
+
+    from corrosion_tpu.ops import swim
+
+    n = 64
+    params = swim_pview.PViewParams(n=n, slots=32)
+    state = swim_pview.init_state(params, jax.random.PRNGKey(0))
+    hostile = state._replace(
+        inc=jnp.full((n,), 10**6, dtype=jnp.int32)
+    )
+    bumped = swim_pview.set_alive_many(hostile, jnp.arange(n), True)
+    assert int(jnp.max(bumped.inc)) <= swim.INC_CAP
+    bumped1 = swim_pview.set_alive(hostile, 3, True)
+    assert int(bumped1.inc[3]) <= swim.INC_CAP
+    # dense kernel restart site has the same clamp
+    dparams = swim.SwimParams(n=n)
+    dstate = swim.init_state(dparams, jax.random.PRNGKey(0))
+    dh = dstate._replace(inc=jnp.full((n,), 10**6, dtype=jnp.int32))
+    db = swim.set_alive(dh, 5, True)
+    assert int(db.inc[5]) <= swim.INC_CAP
+    # refutation cap: min(inc_cap, INC_CAP) for every n
+    for nn in (64, 1000, 262144, 1048576):
+        assert min(swim_pview.inc_cap(nn), swim.INC_CAP) * 4 + 7 < 2**15
