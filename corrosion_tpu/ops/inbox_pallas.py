@@ -42,25 +42,37 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-# VMEM budget gate: inputs (4 planes of [G, m] int32) + outputs
-# ([n, slots] * 2) + counts must fit comfortably in ~16 MB VMEM.
+# VMEM budget gate: inputs (3 planes of [G, m] int32: subj/key/pos) +
+# outputs ([n, slots] * 2) must fit comfortably in ~16 MB VMEM.  The
+# control data (dst, cnt, fill counters) lives in SMEM and is gated
+# separately — SMEM is far smaller than VMEM.
 _VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+_SMEM_BUDGET_BYTES = 512 * 1024
 
 
-def _kernel(dst_ref, subj_ref, key_ref, pos_ref, cnt_ref,
+def _kernel(dst_ref, cnt_ref, subj_ref, key_ref, pos_ref,
             out_subj_ref, out_key_ref, counts_ref, *, n, slots, m):
-    g_total = dst_ref.shape[0]
+    g_total = subj_ref.shape[0]
 
-    # init: outputs and counters (allocations arrive uninitialized)
+    # init: outputs (allocations arrive uninitialized).  The fill
+    # counters live in SMEM — Mosaic forbids scalar stores to VMEM
+    # ("Cannot store scalars to VMEM", observed on a real v5e; interpret
+    # mode accepts them, which is why CPU tests alone missed this) — and
+    # SMEM has no vector store, so they are zeroed by a scalar loop.
     out_subj_ref[:] = jnp.full((n, slots), n, dtype=jnp.int32)
     out_key_ref[:] = jnp.zeros((n, slots), dtype=jnp.int32)
-    counts_ref[:] = jnp.zeros((n, 1), dtype=jnp.int32)
+
+    def zero(i, carry):
+        counts_ref[i] = 0
+        return carry
+
+    jax.lax.fori_loop(0, n, zero, 0)
 
     col_iota = jax.lax.broadcasted_iota(jnp.int32, (slots, m), 0)
 
     def body(g, _):
-        d = dst_ref[g, 0]
-        base = counts_ref[d, 0]
+        d = dst_ref[g]
+        base = counts_ref[d]
         subj = subj_ref[g, :]          # [m]
         key = key_ref[g, :]
         pos = pos_ref[g, :]            # exclusive valid-prefix, -1 = masked
@@ -78,7 +90,7 @@ def _kernel(dst_ref, subj_ref, key_ref, pos_ref, cnt_ref,
         cur_key = out_key_ref[d, :]
         out_subj_ref[d, :] = jnp.where(hit, upd_subj, cur_subj)
         out_key_ref[d, :] = jnp.where(hit, upd_key, cur_key)
-        counts_ref[d, 0] = base + cnt_ref[g, 0]
+        counts_ref[d] = base + cnt_ref[g]
         return _
 
     jax.lax.fori_loop(0, g_total, body, 0)
@@ -105,16 +117,26 @@ def build_inbox_pallas(
     pos = jnp.where(ok, jnp.cumsum(oki, axis=1) - oki, -1).astype(jnp.int32)
     cnt = jnp.sum(oki, axis=1, keepdims=True)
 
-    total = 4 * (4 * g * m + 2 * n * slots + n)
-    if total > _VMEM_BUDGET_BYTES:
+    vmem = 4 * (3 * g * m + 2 * n * slots)
+    if vmem > _VMEM_BUDGET_BYTES:
         raise ValueError(
-            f"inbox_pallas: working set {total}B exceeds VMEM budget"
+            f"inbox_pallas: VMEM working set {vmem}B exceeds budget"
             f" (G={g}, m={m}, n={n}); use inbox_impl='gsort'"
+        )
+    smem = 4 * (2 * g + n)  # dst + cnt inputs, counts scratch
+    if smem > _SMEM_BUDGET_BYTES:
+        raise ValueError(
+            f"inbox_pallas: SMEM working set {smem}B exceeds budget"
+            f" (G={g}, n={n}); use inbox_impl='gsort'"
         )
 
     interpret = jax.default_backend() != "tpu"
     kernel = functools.partial(_kernel, n=n, slots=slots, m=m)
     vm = pltpu.VMEM
+    sm = pltpu.SMEM
+    # dst/cnt are control data (dynamic row indices + counter bumps):
+    # they live in SMEM, the only space Mosaic allows scalar loads/stores
+    # on; the message planes stay VMEM and are touched vector-wise only.
     return pl.pallas_call(
         kernel,
         out_shape=(
@@ -122,22 +144,22 @@ def build_inbox_pallas(
             jax.ShapeDtypeStruct((n, slots), jnp.int32),
         ),
         in_specs=[
-            pl.BlockSpec(memory_space=vm),  # dst [G, 1]
+            pl.BlockSpec(memory_space=sm),  # dst [G]
+            pl.BlockSpec(memory_space=sm),  # cnt [G]
             pl.BlockSpec(memory_space=vm),  # subj [G, m]
             pl.BlockSpec(memory_space=vm),  # key [G, m]
             pl.BlockSpec(memory_space=vm),  # pos [G, m]
-            pl.BlockSpec(memory_space=vm),  # cnt [G, 1]
         ],
         out_specs=(
             pl.BlockSpec(memory_space=vm),
             pl.BlockSpec(memory_space=vm),
         ),
-        scratch_shapes=[pltpu.VMEM((n, 1), jnp.int32)],  # fill counters
+        scratch_shapes=[sm((n,), jnp.int32)],  # fill counters
         interpret=interpret,
     )(
-        dst_g.reshape(g, 1).astype(jnp.int32),
+        dst_g.astype(jnp.int32),
+        cnt.reshape(g).astype(jnp.int32),
         subj.astype(jnp.int32),
         key.astype(jnp.int32),
         pos,
-        cnt.astype(jnp.int32),
     )
