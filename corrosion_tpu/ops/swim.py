@@ -89,6 +89,16 @@ class SwimParams(NamedTuple):
     # destination; ~20% faster tick at n=10k on the CPU fallback, default),
     # or "pallas" (sequential grouped scatter kernel, ops/inbox_pallas.py).
     # All three are bit-equal (tests/test_inbox_impls.py).
+    gossip_mode: str = "pick"  # gossip target selection: "pick" (each
+    # member independently picks known-alive targets; delivery needs the
+    # sort-based inbox build above) or "shift" (per-(tick, fanout-slot)
+    # random GLOBAL offsets: member i sends slot j's packet to
+    # (i + off_j) mod n, so delivery is an exact row gather — no sort,
+    # no bounded-inbox drop, and no target-pick view scans.  The same
+    # rotating-permutation idea as the feed windows; per-tick random
+    # offsets keep partner choice decorrelated across ticks.  Targets
+    # are no longer alive-biased: sends to dead members are masked and
+    # wasted, a small overhead at realistic churn).
 
 
 VIEW_DTYPE = jnp.int16
@@ -571,16 +581,25 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
 
     # ---- 3. gossip send --------------------------------------------------
     m, f = params.piggyback, params.fanout
-    # targets: known-alive picks per fanout slot
-    tg = jnp.stack(
-        [
-            _pick_known_alive(
-                view, idx, jax.random.fold_in(r_gossip, j), params, 2
-            )
-            for j in range(f)
-        ],
-        axis=1,
-    )  # [N, f]
+    if params.gossip_mode == "shift":
+        # per-(tick, slot) random global offsets; delivery in step 4 is
+        # then an exact row gather (no sort).  1..n-1 excludes self-send.
+        shift_off = jax.random.randint(
+            jax.random.fold_in(r_gossip, 65537), (f,), 1, n,
+            dtype=jnp.int32,
+        )
+        tg = (idx[:, None] + shift_off[None, :]) % n  # [N, f]
+    else:
+        # targets: known-alive picks per fanout slot
+        tg = jnp.stack(
+            [
+                _pick_known_alive(
+                    view, idx, jax.random.fold_in(r_gossip, j), params, 2
+                )
+                for j in range(f)
+            ],
+            axis=1,
+        )  # [N, f]
     # least-sent m buffer entries are already sorted to the front by merge
     send_subj = buf_subj[:, :m]  # [N, m]
     send_key = buf_key[:, :m]
@@ -637,19 +656,30 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
     msg_ok = msg_ok & ~drop
 
     # ---- 4. inbox: compact messages into bounded per-member inboxes ----
-    # grouped [G, m] form (G = N*fanout packets, equal-dst runs); the
-    # impl choice (flat sort / grouped sort / pallas) is bit-equal
     subj_gm = jnp.broadcast_to(send_subj[:, None, :], msg_ok.shape)
     key_gm = jnp.broadcast_to(send_key[:, None, :], msg_ok.shape)
-    in_subj, in_key = dispatch_inbox(
-        params.inbox_impl,
-        n,
-        params.incoming_slots,
-        tg_safe.reshape(-1),
-        subj_gm.reshape(-1, m),
-        key_gm.reshape(-1, m),
-        msg_ok.reshape(-1, m),
-    )
+    if params.gossip_mode == "shift":
+        # receiver r's slot-j packet comes from sender (r - off_j) mod n:
+        # delivery is an exact [N, f] row gather of the masked send
+        # planes; the inbox is [N, f*m] with no slot cap and no drops
+        src = (idx[:, None] - shift_off[None, :]) % n  # [N, f]
+        sub_m = jnp.where(msg_ok, subj_gm, n)
+        key_m = jnp.where(msg_ok, key_gm, 0)
+        jj = jnp.arange(f, dtype=jnp.int32)[None, :]
+        in_subj = sub_m[src, jj].reshape(n, f * m)
+        in_key = key_m[src, jj].reshape(n, f * m)
+    else:
+        # grouped [G, m] form (G = N*fanout packets, equal-dst runs); the
+        # impl choice (flat sort / grouped sort / pallas) is bit-equal
+        in_subj, in_key = dispatch_inbox(
+            params.inbox_impl,
+            n,
+            params.incoming_slots,
+            tg_safe.reshape(-1),
+            subj_gm.reshape(-1, m),
+            key_gm.reshape(-1, m),
+            msg_ok.reshape(-1, m),
+        )
 
     # ---- 4b. announce/feed exchange --------------------------------------
     # Each member pulls one packet's worth of member records from a random

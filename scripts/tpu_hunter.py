@@ -84,15 +84,20 @@ def run_step(name: str, argv: list[str], env_extra: dict, timeout: float,
         err = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) else (e.stderr or "")
         err += f"\n[tpu_hunter] TIMEOUT after {timeout:.0f}s"
     wall = time.monotonic() - t0
-    dest = outfile if rc == 0 else outfile + ".failed"
+    # every battery step is a TPU measurement: a child that silently
+    # landed on a CPU fallback (half-dead tunnel) must not count as
+    # success nor replace a good on-chip record
+    on_tpu = 'platform=tpu' in out or '"platform": "tpu"' in out
+    ok = rc == 0 and on_tpu
+    dest = outfile if ok else outfile + ".failed"
     path = os.path.join(REPO, dest)
     with open(path + ".part", "w") as f:
         f.write(out)
         if err.strip():
             f.write("\n--- stderr tail ---\n" + err[-4000:])
     os.replace(path + ".part", path)
-    log(f"step {name}: rc={rc} wall={wall:.0f}s -> {dest}")
-    return rc == 0
+    log(f"step {name}: rc={rc} on_tpu={on_tpu} wall={wall:.0f}s -> {dest}")
+    return ok
 
 
 PVIEW_CODE = r"""
@@ -212,6 +217,20 @@ def battery_steps() -> list[tuple[str, list[str], dict, float, str]]:
         ("bench40k_r2",
          [py, "-u", "bench.py"],
          {**bench_env, "BENCH_N": "40000"}, 2400.0, "BENCH_TPU_40k.json"),
+        # the 40k bench ran ~141 ms/tick on chip — ~10x above the
+        # bandwidth-bound estimate; this table shows which phase eats it
+        ("profile40k",
+         [py, "-u", "scripts/profile_swim.py", "40000", "4"],
+         {}, 2400.0, "TPU_PROFILE_40k.txt"),
+        # sortless shift-gossip A/B (on CPU: fewer ticks AND >2x faster)
+        ("bench10k_shift",
+         [py, "-u", "bench.py"],
+         {**bench_env, "BENCH_N": "10000", "BENCH_GOSSIP_MODE": "shift"},
+         1500.0, "BENCH_TPU_10k_shift.json"),
+        ("bench40k_shift",
+         [py, "-u", "bench.py"],
+         {**bench_env, "BENCH_N": "40000", "BENCH_GOSSIP_MODE": "shift"},
+         2400.0, "BENCH_TPU_40k_shift.json"),
     ]
 
 
@@ -224,10 +243,13 @@ def main() -> None:
     steps = battery_steps()
 
     # Redo steps re-measure artifacts recorded by THIS round's earlier
-    # battery under since-fixed code.  From a fresh state the base step
-    # runs with current code, making the redo redundant — drop it from
-    # the battery entirely, judged against the INITIAL done-state (a
-    # base completing later in this run must not un-skip its redo).
+    # battery under since-fixed code.  A redo is needed only when its
+    # base completed under a DIFFERENT measured-code fingerprint than
+    # the current tree (state["done_sha"], recorded per completed step):
+    # a base done under current code — fresh battery, or a mid-round
+    # hunter restart after the base re-ran — makes the redo redundant.
+    from bench import _code_fingerprint
+    cur_sha = _code_fingerprint()
     redo_of = {
         "pallas1k_fix": "smoke",
         "profile10k_r2": "profile10k",
@@ -235,9 +257,14 @@ def main() -> None:
         "bench40k_r2": "bench40k",
     }
     initial_done = set(state["done"])
+    done_sha = state.setdefault("done_sha", {})
     steps = [
         s for s in steps
-        if not (s[0] in redo_of and redo_of[s[0]] not in initial_done)
+        if not (
+            s[0] in redo_of
+            and (redo_of[s[0]] not in initial_done
+                 or done_sha.get(redo_of[s[0]]) == cur_sha)
+        )
     ]
 
     while time.monotonic() - t_start < budget:
@@ -263,6 +290,7 @@ def main() -> None:
             state["attempts"][name] = state["attempts"].get(name, 0) + 1
             if ok:
                 state["done"].append(name)
+                done_sha[name] = cur_sha
                 save_state(state)
                 # brief pause so the tunnel's client slot is fully released
                 time.sleep(10)

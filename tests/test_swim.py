@@ -308,3 +308,33 @@ def test_fingers_bootstrap_converges_faster_than_ring():
     )
     assert t_fingers < t_ring, (t_fingers, t_ring)
     assert t_fingers < 10_000, "fingers boot never converged"
+
+
+def test_shift_gossip_converges_detects_and_refutes():
+    """gossip_mode="shift" (per-tick global-offset fanout, sortless
+    delivery): same protocol guarantees as "pick" — bootstrap
+    convergence, dead-member detection with zero false positives, and
+    clean restart — on the row-gather delivery path."""
+    sim = ClusterSim(48, seed=4, gossip_mode="shift")
+    assert sim.run_until_stable(coverage_target=0.999, max_ticks=120)
+    s = sim.stats()
+    assert s["false_positive"] == 0.0
+    for m in (7, 23):
+        sim.crash(m)
+    took = sim.run_until_detected(detect_target=1.0, max_extra_ticks=120)
+    assert took is not None, f"failures not detected: {sim.stats()}"
+    s = sim.stats()
+    assert s["false_positive"] == 0.0
+    assert took <= 60
+    sim.restart(7)
+    sim.step(80)
+    s = sim.stats()
+    assert s["coverage"] >= 0.999, s
+    assert s["false_positive"] == 0.0, s
+
+
+def test_shift_gossip_message_loss_tolerated():
+    sim = ClusterSim(32, seed=6, gossip_mode="shift", loss=0.2)
+    stable = sim.run_until_stable(coverage_target=0.999, max_ticks=300)
+    assert stable is not None, f"no convergence under loss: {sim.stats()}"
+    assert sim.stats()["false_positive"] == 0.0
