@@ -39,14 +39,16 @@ from corrosion_tpu.types.base import Timestamp
 
 
 @pytest.mark.parametrize("ticks", [1, 4])
-def test_sharded_tick_matches_unsharded(ticks):
+@pytest.mark.parametrize("gossip_mode", ["pick", "shift"])
+def test_sharded_tick_matches_unsharded(ticks, gossip_mode):
     """The sharded kernel is the SAME integer computation with layout
     constraints, so its output must be bit-identical to the single-device
-    kernel under the same rng sequence."""
+    kernel under the same rng sequence — in both gossip modes (shift's
+    offset row-gather crosses shard boundaries via XLA collectives)."""
     n_dev = 8
     devices = jax.devices()
     assert len(devices) >= n_dev, "conftest forces an 8-device CPU mesh"
-    params = swim.SwimParams(n=8 * n_dev)
+    params = swim.SwimParams(n=8 * n_dev, gossip_mode=gossip_mode)
 
     state_a = swim.init_state(params, jax.random.PRNGKey(3))
     mesh = member_mesh(devices[:n_dev])
