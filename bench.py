@@ -117,10 +117,21 @@ def child_main() -> None:
     # 25-tick cadence fits the ~70-tick finger-bootstrap convergence
     # (worst-case overshoot 24 ticks; stats are ~1 s each on CPU)
     record_every = int(os.environ.get("BENCH_RECORD_EVERY", "25"))
+    # device-resident convergence loop (lax.while_loop of tick scans
+    # with an on-device coverage predicate): zero host round-trips in
+    # the measured window — each host-side stats check costs a full
+    # tunnel RTT (~85 ms measured), comparable to ~10 ticks at n=10k
+    device_loop = os.environ.get("BENCH_DEVICE_LOOP", "1") != "0"
+    check_every = max(1, int(os.environ.get("BENCH_CHECK_EVERY", "5")))
+    max_ticks = 5000
     # compile warm-up on a THROWAWAY sim (same shapes/static args), so the
     # measured cluster starts cold at tick 0 — warming up the real state
     # would advance convergence before the clock starts
     warm = ClusterSim(n, seed=1, seed_mode=seed_mode, **params)
+    if device_loop:
+        # must precede step(): the loop's tick-limit static arg is
+        # ticks+max_ticks and has to match the measured call's
+        warm.warm_device_loop(target, max_ticks, check_every)
     warm.step(record_every)
     warm.step(10)  # the fine-phase chunk compiles too
     warm.stats()
@@ -130,12 +141,19 @@ def child_main() -> None:
     jax.block_until_ready(sim.state.view)
 
     t0 = time.monotonic()
-    stable_tick = sim.run_until_stable(
-        coverage_target=target,
-        max_ticks=5000,
-        record_every=record_every,
-        fine_every=10,
-    )
+    if device_loop:
+        stable_tick = sim.run_until_stable_device(
+            coverage_target=target,
+            max_ticks=max_ticks,
+            check_every=check_every,
+        )
+    else:
+        stable_tick = sim.run_until_stable(
+            coverage_target=target,
+            max_ticks=max_ticks,
+            record_every=record_every,
+            fine_every=10,
+        )
     elapsed = time.monotonic() - t0
     stats = sim.stats()
 
@@ -159,6 +177,8 @@ def child_main() -> None:
                     "coverage_target": target,
                     "inbox_impl": sim.params.inbox_impl,
                     "gossip_mode": sim.params.gossip_mode,
+                    "device_loop": device_loop,
+                    "check_every": check_every if device_loop else None,
                     "code_sha": _code_fingerprint(),
                     "measured_at": time.strftime(
                         "%Y-%m-%d %H:%M:%S", time.gmtime()

@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from corrosion_tpu.ops import swim
@@ -110,6 +111,56 @@ class ClusterSim:
             if fine_every is not None and s["coverage"] >= fine_threshold:
                 step_size = fine_every
         return None
+
+    def run_until_stable_device(
+        self,
+        coverage_target: float = 0.999,
+        max_ticks: int = 10_000,
+        check_every: int = 5,
+    ) -> Optional[int]:
+        """`run_until_stable` with the tick/check loop resident ON
+        DEVICE (swim.run_to_coverage): one dispatch, zero host
+        round-trips until convergence.  No per-check history is recorded
+        (the loop never surfaces intermediate state); returns the
+        absolute tick at stability rounded up to ``check_every``, or
+        None.  A tight ``check_every`` (default 5) costs ~5% extra
+        bandwidth but cuts the average overshoot a coarse host cadence
+        pays at the end."""
+        if check_every < 1:
+            raise ValueError("check_every must be >= 1 (0 would make the"
+                             " on-device while_loop spin forever)")
+        self._rng, key = jax.random.split(self._rng)
+        limit = self.ticks + max_ticks
+        self.state, cov = swim.run_to_coverage(
+            self.state, key, self.params,
+            float(coverage_target), int(check_every), int(limit),
+        )
+        self.ticks = int(self.state.t)
+        # verdict must use the same precision the on-device predicate
+        # compared at (f32), else a loop-satisfied coverage in
+        # [f32(target), f64(target)) reads as a false non-convergence
+        return self.ticks if float(cov) >= np.float32(coverage_target) else None
+
+    def warm_device_loop(
+        self,
+        coverage_target: float = 0.999,
+        max_ticks: int = 10_000,
+        check_every: int = 5,
+    ) -> None:
+        """Compile the device loop without advancing a tick: the static
+        args MUST equal a subsequent run_until_stable_device call's (the
+        executable is keyed on them), and run_to_coverage's cond sees
+        t >= the tick limit so it exits before the first body.  The
+        donated-then-returned state is reassigned with its real t."""
+        if check_every < 1:
+            raise ValueError("check_every must be >= 1")
+        limit = self.ticks + max_ticks
+        capped = self.state._replace(t=jnp.int32(limit))
+        out, _ = swim.run_to_coverage(
+            capped, jax.random.PRNGKey(0), self.params,
+            float(coverage_target), int(check_every), int(limit),
+        )
+        self.state = out._replace(t=jnp.int32(self.ticks))
 
     def run_until_detected(
         self, detect_target: float = 1.0, max_extra_ticks: int = 200

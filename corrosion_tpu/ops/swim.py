@@ -661,7 +661,9 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
     if params.gossip_mode == "shift":
         # receiver r's slot-j packet comes from sender (r - off_j) mod n:
         # delivery is an exact [N, f] row gather of the masked send
-        # planes; the inbox is [N, f*m] with no slot cap and no drops
+        # planes into an [N, f*m] plane, row-compacted below to the
+        # incoming_slots cap when it exceeds it (bounded-mailbox drops,
+        # same contract as the pick path)
         src = (idx[:, None] - shift_off[None, :]) % n  # [N, f]
         sub_m = jnp.where(msg_ok, subj_gm, n)
         key_m = jnp.where(msg_ok, key_gm, 0)
@@ -898,12 +900,9 @@ def _stats_impl(view, alive):
     a live member's self entry is always an alive-precedence key."""
     n = view.shape[0]
     af = alive.astype(jnp.float32)  # [N]
-    n_alive = jnp.sum(af)
+    cov_num, n_alive = _coverage_rows(view, alive)
     prec = key_prec(view)
     known = key_known(view)
-    row_ka = jnp.sum(  # alive-known subjects that ARE alive, per observer
-        jnp.where(known & (prec == PREC_ALIVE), af[None, :], 0.0), axis=1
-    )
     # down-marked subjects that ARE dead, per observer. The whole-cluster-
     # alive case (every bootstrap run) short-circuits: with no dead
     # members the sum is identically zero, and lax.cond executes only one
@@ -922,7 +921,6 @@ def _stats_impl(view, alive):
     row_fp = jnp.sum(  # suspected/downed subjects that ARE alive
         jnp.where(known & (prec >= PREC_SUSPECT), af[None, :], 0.0), axis=1
     )
-    cov_num = jnp.sum(row_ka * af) - n_alive  # minus the alive diagonal
     det_num = jnp.sum(row_td * af)  # diag: live self never dead-subject
     fp_num = jnp.sum(row_fp * af)  # diag: live self never suspect
     n_alive_pairs = jnp.maximum(n_alive * (n_alive - 1.0), 1.0)
@@ -930,6 +928,66 @@ def _stats_impl(view, alive):
     return jnp.stack(
         [cov_num / n_alive_pairs, det_num / n_dead_pairs, fp_num / n_alive_pairs]
     )
+
+
+def _coverage_rows(view, alive):
+    """Shared coverage reduction (device-loop predicate AND stats):
+    (numerator, n_alive) of the live-knows-live ratio, ONE streaming
+    pass over the [N, N] view, diagonal subtracted in closed form."""
+    af = alive.astype(jnp.float32)
+    n_alive = jnp.sum(af)
+    prec = key_prec(view)
+    known = key_known(view)
+    row_ka = jnp.sum(
+        jnp.where(known & (prec == PREC_ALIVE), af[None, :], 0.0), axis=1
+    )
+    num = jnp.sum(row_ka * af) - n_alive  # minus the alive diagonal
+    return num, n_alive
+
+
+def _coverage_impl(view, alive):
+    num, n_alive = _coverage_rows(view, alive)
+    return num / jnp.maximum(n_alive * (n_alive - 1.0), 1.0)
+
+
+def _run_to_coverage_impl(state, rng, params, target, check_every, max_ticks):
+    """Tick until live-member coverage reaches ``target``, ENTIRELY on
+    device: a lax.while_loop of check_every-tick scans with the coverage
+    reduction as its predicate.  No host round-trip happens between
+    dispatch and convergence — on a tunneled chip every host-side stats
+    check costs a full RTT (~85 ms measured), which at single-digit-ms
+    ticks is the dominant cost of the host-driven loop.
+
+    Returns (state, coverage); state.t carries the absolute tick at
+    exit.  ``max_ticks`` is a hard budget: only whole check_every-tick
+    chunks that FIT the budget run (the host loop clamps its final
+    partial batch instead; the device loop cannot vary chunk size).
+    cond is evaluated before body, so a caller passing a state with
+    t + check_every > max_ticks compiles the whole program without
+    running a tick — the bench warm-up uses this.
+    """
+
+    def cond(carry):
+        st, _, cov = carry
+        return (cov < target) & (st.t + check_every <= max_ticks)
+
+    def body(carry):
+        st, rng, _ = carry
+        rng, key = jax.random.split(rng)
+        st = _tick_n_impl(st, key, params, check_every)
+        return st, rng, _coverage_impl(st.view, st.alive)
+
+    state, _, cov = jax.lax.while_loop(
+        cond, body, (state, rng, jnp.float32(-1.0))
+    )
+    return state, cov
+
+
+run_to_coverage = functools.partial(
+    jax.jit,
+    static_argnames=("params", "target", "check_every", "max_ticks"),
+    donate_argnums=(0,),
+)(_run_to_coverage_impl)
 
 
 def membership_stats(state: SwimState) -> dict:

@@ -338,3 +338,33 @@ def test_shift_gossip_message_loss_tolerated():
     stable = sim.run_until_stable(coverage_target=0.999, max_ticks=300)
     assert stable is not None, f"no convergence under loss: {sim.stats()}"
     assert sim.stats()["false_positive"] == 0.0
+
+
+def test_device_loop_matches_host_loop_convergence():
+    """run_until_stable_device (on-device while_loop) must reach the
+    same convergence verdict as the host-driven loop, with its tick
+    count aligned to check_every granularity and zero false positives."""
+    a = ClusterSim(64, seed=11)
+    b = ClusterSim(64, seed=11)
+    ta = a.run_until_stable(coverage_target=0.999, max_ticks=200)
+    b.warm_device_loop(0.999, 200, 5)
+    tb = b.run_until_stable_device(
+        coverage_target=0.999, max_ticks=200, check_every=5
+    )
+    assert ta is not None and tb is not None
+    sa, sb = a.stats(), b.stats()
+    assert sb["coverage"] >= 0.999
+    assert sb["false_positive"] == 0.0
+    # same kernel, same seed: device loop may exit a few ticks off the
+    # host cadence but must land in the same convergence regime
+    assert abs(ta - tb) <= 25, (ta, tb)
+    assert int(b.state.t) == tb
+
+
+def test_device_loop_nonconvergence_returns_none():
+    sim = ClusterSim(64, seed=12)
+    out = sim.run_until_stable_device(
+        coverage_target=1.0, max_ticks=5, check_every=5
+    )
+    assert out is None
+    assert sim.ticks == 5
