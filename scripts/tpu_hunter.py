@@ -175,41 +175,25 @@ def battery_steps() -> list[tuple[str, list[str], dict, float, str]]:
         ("bench40k",
          [py, "-u", "bench.py"],
          {**bench_env, "BENCH_N": "40000"}, 2400.0, "BENCH_TPU_40k.json"),
-        # int16 view: [80k,80k] = 12.8 GB, fits one 16 GB v5e chip donated
-        ("bench80k",
-         [py, "-u", "bench.py"],
-         {**bench_env, "BENCH_N": "80000"}, 3000.0, "BENCH_TPU_80k.json"),
-        ("pview100k",
-         [py, "-u", "-c", PVIEW_CODE.format(repo=REPO)],
-         {"PVIEW_N": "100000", "PVIEW_K": "2048"}, 2400.0,
-         "TPU_PVIEW_100k.json"),
-        # --- r4 additions (run after the original battery drains) ---
+        # --- r4 additions ----------------------------------------------
+        # Ordered CHEAP-WINS-FIRST: tunnel windows have died 10-45 min
+        # in, so short steps bank results before the long gambles.
         # pallas kernel re-profile after the SMEM scalar fix (the first
         # on-chip run failed with "Cannot store scalars to VMEM")
         ("pallas1k_fix",
          [py, "-u", "scripts/profile_swim.py", "1024", "4"],
          {}, 900.0, "TPU_PROFILE_1k_pallasfix.txt"),
-        # VERDICT r3 item 2 quality bar on chip: pv_coverage >= 0.99 then
-        # 1% churn -> cluster-wide detection with FP 0, at 100k and 262k
-        ("pview100k_conv",
-         [py, "-u", "scripts/pview_converge.py", "100000", "2048"],
-         {}, 3000.0, "TPU_PVIEW_CONV_100k.txt"),
-        ("pview262k_conv",
-         [py, "-u", "scripts/pview_converge.py", "262144", "2048"],
-         {}, 3600.0, "TPU_PVIEW_CONV_262k.txt"),
-        # re-profile the 10k phase table with the fixed pallas kernel
-        # and per-iteration input variation (the first table's repeated
-        # identical dispatches returned impossibly fast — see
-        # profile_swim.timeit)
-        ("profile10k_r2",
-         [py, "-u", "scripts/profile_swim.py", "10000"],
-         {}, 1800.0, "TPU_PROFILE_10k_r2.txt"),
         # fingerprinted bench re-runs (records carry code_sha + config so
-        # a round-end replay is verifiable), plus the sort-impl A/B the
-        # phase table motivated
+        # a round-end replay is verifiable; device-resident convergence
+        # loop), the sort-impl A/B the phase table motivated, and the
+        # sortless shift-gossip A/B (on CPU: fewer ticks to converge)
         ("bench10k_r2",
          [py, "-u", "bench.py"],
          {**bench_env, "BENCH_N": "10000"}, 1500.0, "BENCH_TPU_10k.json"),
+        ("bench10k_shift",
+         [py, "-u", "bench.py"],
+         {**bench_env, "BENCH_N": "10000", "BENCH_GOSSIP_MODE": "shift"},
+         1500.0, "BENCH_TPU_10k_shift.json"),
         ("bench10k_sort",
          [py, "-u", "bench.py"],
          {**bench_env, "BENCH_N": "10000", "BENCH_INBOX_IMPL": "sort"},
@@ -217,20 +201,38 @@ def battery_steps() -> list[tuple[str, list[str], dict, float, str]]:
         ("bench40k_r2",
          [py, "-u", "bench.py"],
          {**bench_env, "BENCH_N": "40000"}, 2400.0, "BENCH_TPU_40k.json"),
-        # the 40k bench ran ~141 ms/tick on chip — ~10x above the
-        # bandwidth-bound estimate; this table shows which phase eats it
-        ("profile40k",
-         [py, "-u", "scripts/profile_swim.py", "40000", "4"],
-         {}, 2400.0, "TPU_PROFILE_40k.txt"),
-        # sortless shift-gossip A/B (on CPU: fewer ticks AND >2x faster)
-        ("bench10k_shift",
-         [py, "-u", "bench.py"],
-         {**bench_env, "BENCH_N": "10000", "BENCH_GOSSIP_MODE": "shift"},
-         1500.0, "BENCH_TPU_10k_shift.json"),
         ("bench40k_shift",
          [py, "-u", "bench.py"],
          {**bench_env, "BENCH_N": "40000", "BENCH_GOSSIP_MODE": "shift"},
          2400.0, "BENCH_TPU_40k_shift.json"),
+        # re-profile phase tables with the fixed pallas kernel and
+        # per-iteration input variation (the first table's repeated
+        # identical dispatches returned impossibly fast — see
+        # profile_swim.timeit); 40k shows where its 141 ms/tick goes
+        ("profile10k_r2",
+         [py, "-u", "scripts/profile_swim.py", "10000"],
+         {}, 1800.0, "TPU_PROFILE_10k_r2.txt"),
+        ("profile40k",
+         [py, "-u", "scripts/profile_swim.py", "40000", "4"],
+         {}, 2400.0, "TPU_PROFILE_40k.txt"),
+        # VERDICT r3 item 2 quality bar on chip: pv_coverage >= 0.99 then
+        # 1% churn -> cluster-wide detection with FP 0, at 100k and 262k
+        ("pview100k_conv",
+         [py, "-u", "scripts/pview_converge.py", "100000", "2048"],
+         {}, 3000.0, "TPU_PVIEW_CONV_100k.txt"),
+        # the long gambles last: a mid-step tunnel death costs the
+        # whole remaining window
+        # int16 view: [80k,80k] = 12.8 GB, fits one 16 GB v5e chip donated
+        ("bench80k",
+         [py, "-u", "bench.py"],
+         {**bench_env, "BENCH_N": "80000"}, 3000.0, "BENCH_TPU_80k.json"),
+        ("pview262k_conv",
+         [py, "-u", "scripts/pview_converge.py", "262144", "2048"],
+         {}, 3600.0, "TPU_PVIEW_CONV_262k.txt"),
+        ("pview100k",
+         [py, "-u", "-c", PVIEW_CODE.format(repo=REPO)],
+         {"PVIEW_N": "100000", "PVIEW_K": "2048"}, 2400.0,
+         "TPU_PVIEW_100k.json"),
     ]
 
 
