@@ -27,9 +27,11 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import sys
 from typing import List, Optional
 
+from corrosion_tpu.runtime import otel
 from corrosion_tpu.runtime.config import Config, load_config
 
 
@@ -208,6 +210,17 @@ async def _cmd_agent(cfg: Config) -> int:
     if cfg.telemetry.prometheus_bind_addr:
         prom_runner = await serve_prometheus(cfg.telemetry.prometheus_bind_addr)
 
+    # OTLP span export (main.rs:68-118): config endpoint, or the standard
+    # env var so deployments can enable tracing without editing TOML
+    otlp_endpoint = cfg.telemetry.open_telemetry_endpoint or os.environ.get(
+        "OTEL_EXPORTER_OTLP_ENDPOINT"
+    )
+    if otlp_endpoint:
+        otel.configure(
+            otlp_endpoint,
+            resource_attrs={"corrosion.actor_id": str(agent.actor_id)},
+        )
+
     consul_task = None
     if cfg.consul.enabled:
         from corrosion_tpu.consul import consul_sync_loop
@@ -216,17 +229,23 @@ async def _cmd_agent(cfg: Config) -> int:
             consul_sync_loop(agent, cfg.consul, tripwire)
         )
 
-    print(f"agent {agent.actor_id} up; gossip {agent.actor.addr}")
-    await tripwire.wait()
-    print("shutting down…")
-    if consul_task is not None:
-        consul_task.cancel()
-    if prom_runner is not None:
-        await prom_runner.cleanup()
-    await admin.stop()
-    await api.stop()
-    await shutdown(agent)
-    await agent.tracker.wait_all(60.0)
+    try:
+        print(f"agent {agent.actor_id} up; gossip {agent.actor.addr}")
+        await tripwire.wait()
+        print("shutting down…")
+        if consul_task is not None:
+            consul_task.cancel()
+        if prom_runner is not None:
+            await prom_runner.cleanup()
+        await admin.stop()
+        await api.stop()
+        await shutdown(agent)
+        await agent.tracker.wait_all(60.0)
+    finally:
+        # even a failing shutdown path must flush queued spans — those are
+        # exactly the spans that explain the failure
+        if otlp_endpoint:
+            otel.configure(None)  # shutdown + final flush
     return 0
 
 

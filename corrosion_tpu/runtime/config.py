@@ -117,6 +117,11 @@ class ConsulConfig:
 @dataclass
 class TelemetryConfig:
     prometheus_bind_addr: Optional[str] = None
+    # OTLP/HTTP collector base URL (e.g. "http://127.0.0.1:4318") — the
+    # reference's `telemetry.open-telemetry` exporter config
+    # (`klukai/src/main.rs:68-76`).  Env fallback: the standard
+    # OTEL_EXPORTER_OTLP_ENDPOINT, honored at agent startup (cli.py).
+    open_telemetry_endpoint: Optional[str] = None
 
 
 @dataclass

@@ -6,11 +6,12 @@ propagated across the sync protocol inside `SyncTraceContextV1`
 (`klukai-types/src/sync.rs:33-67`, injected `peer/mod.rs:1098-1101`,
 extracted `peer/mod.rs:1494-1496`).
 
-This image ships only the opentelemetry API shim (no SDK/exporter), so
-spans here are self-contained: contextvar-scoped, duration-histogrammed
-into the metrics registry, and logged at DEBUG. The wire format is real
-W3C traceparent, so traces stitch across nodes — and across to any
-OTLP-speaking reimplementation later.
+Spans are contextvar-scoped, duration-histogrammed into the metrics
+registry, and logged at DEBUG. The wire format is real W3C traceparent,
+so traces stitch across nodes. When an OTLP endpoint is configured
+(`runtime/otel.py` — a dependency-free OTLP/HTTP JSON exporter, since the
+image ships no OTel SDK), finished spans are batch-exported to it the way
+the reference's BatchSpanProcessor does.
 """
 
 from __future__ import annotations
@@ -23,6 +24,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
+from corrosion_tpu.runtime import otel
 from corrosion_tpu.runtime.metrics import METRICS
 
 log = logging.getLogger(__name__)
@@ -98,6 +100,7 @@ class Span:
     def __enter__(self) -> "Span":
         self._token = _current.set(self.ctx)
         self._start = time.monotonic()
+        self._start_ns = time.time_ns()
         return self
 
     def __exit__(self, et, e, tb) -> None:
@@ -105,6 +108,17 @@ class Span:
         if self._token is not None:
             _current.reset(self._token)
         METRICS.histogram("corro_span_seconds", span=self.name).observe(elapsed)
+        if otel.exporter() is not None and self.ctx.sampled:
+            otel.record_span(
+                self.name,
+                self.ctx.trace_id,
+                self.ctx.span_id,
+                self.parent.span_id if self.parent is not None else None,
+                self._start_ns,
+                self._start_ns + int(elapsed * 1e9),
+                self.attrs,
+                error=et is not None,
+            )
         log.debug(
             "span %s trace=%s span=%s %.6fs%s %s",
             self.name,

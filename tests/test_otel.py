@@ -1,0 +1,147 @@
+"""OTLP/HTTP span export against a fake collector.
+
+Reference behavior: `klukai/src/main.rs:68-118` — OTLP exporter + batch
+span processor behind `config.telemetry.open-telemetry`, resource attrs
+service.name / service.version / host.name.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from corrosion_tpu.runtime import otel, trace
+from corrosion_tpu.runtime.metrics import METRICS
+
+
+class _Collector(BaseHTTPRequestHandler):
+    bodies: list  # set per-server
+
+    def do_POST(self):  # noqa: N802
+        length = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(length))
+        self.server.bodies.append((self.path, body))
+        self.send_response(200)
+        self.send_header("Content-Length", "2")
+        self.end_headers()
+        self.wfile.write(b"{}")
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+@pytest.fixture
+def collector():
+    srv = HTTPServer(("127.0.0.1", 0), _Collector)
+    srv.bodies = []
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+    otel.configure(None)
+
+
+def _all_spans(srv):
+    spans = []
+    for _path, body in srv.bodies:
+        for rs in body["resourceSpans"]:
+            for ss in rs["scopeSpans"]:
+                spans.extend(ss["spans"])
+    return spans
+
+
+def test_span_export_parent_linkage_and_resource(collector):
+    port = collector.server_address[1]
+    otel.configure(
+        f"http://127.0.0.1:{port}",
+        resource_attrs={"corrosion.actor_id": "deadbeef"},
+        flush_interval_s=60.0,  # flush manually; no timing dependence
+    )
+    with trace.span("sync.serve", peer="a1") as parent:
+        with trace.span("sync.send_chunk") as child:
+            pass
+    otel.exporter().flush()
+
+    path, body = collector.bodies[0]
+    assert path == "/v1/traces"
+    res_attrs = {
+        a["key"]: a["value"] for a in body["resourceSpans"][0]["resource"]["attributes"]
+    }
+    assert res_attrs["service.name"]["stringValue"] == "corrosion-tpu"
+    assert res_attrs["corrosion.actor_id"]["stringValue"] == "deadbeef"
+    assert "host.name" in res_attrs
+
+    spans = _all_spans(collector)
+    by_name = {s["name"]: s for s in spans}
+    assert set(by_name) == {"sync.serve", "sync.send_chunk"}
+    p, c = by_name["sync.serve"], by_name["sync.send_chunk"]
+    # same trace, child points at parent (hex ids per OTLP/JSON mapping)
+    assert p["traceId"] == c["traceId"] == parent.ctx.trace_id
+    assert c["parentSpanId"] == p["spanId"] == parent.ctx.span_id
+    assert c["spanId"] == child.ctx.span_id
+    assert "parentSpanId" not in p
+    # nanosecond decimal-string timestamps, start <= end
+    assert int(p["startTimeUnixNano"]) <= int(p["endTimeUnixNano"])
+    # child attrs carried
+    attrs = {a["key"]: a["value"] for a in p["attributes"]}
+    assert attrs["peer"]["stringValue"] == "a1"
+
+
+def test_error_status_and_continue_from(collector):
+    port = collector.server_address[1]
+    otel.configure(f"http://127.0.0.1:{port}", flush_interval_s=60.0)
+    tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    with pytest.raises(RuntimeError):
+        with trace.continue_from(tp, "ingest.apply"):
+            raise RuntimeError("boom")
+    otel.exporter().flush()
+    (s,) = _all_spans(collector)
+    assert s["traceId"] == "ab" * 16  # adopted the wire trace id
+    assert s["parentSpanId"] == "cd" * 8
+    assert s["status"] == {"code": 2}
+
+
+def test_unsampled_spans_not_exported(collector):
+    port = collector.server_address[1]
+    otel.configure(f"http://127.0.0.1:{port}", flush_interval_s=60.0)
+    tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-00"  # flags 00: unsampled
+    with trace.continue_from(tp, "quiet"):
+        pass
+    otel.exporter().flush()
+    assert _all_spans(collector) == []
+
+
+def test_queue_drop_oldest_accounting(collector):
+    port = collector.server_address[1]
+    exp = otel.configure(
+        f"http://127.0.0.1:{port}", queue_max=4, flush_interval_s=60.0
+    )
+    dropped0 = METRICS.counter("corro_otel_spans_dropped_total").value
+    for i in range(7):
+        exp.record({"name": f"s{i}", "traceId": "00", "spanId": "00"})
+    exp.flush()
+    spans = _all_spans(collector)
+    assert [s["name"] for s in spans] == ["s3", "s4", "s5", "s6"]
+    assert METRICS.counter("corro_otel_spans_dropped_total").value - dropped0 == 3
+
+
+def test_unconfigured_is_noop():
+    otel.configure(None)
+    with trace.span("free"):
+        pass  # must not raise, must not export
+    assert otel.exporter() is None
+
+
+def test_export_failure_counted():
+    # unreachable collector: failures counted, no exception escapes
+    exp = otel.configure(
+        "http://127.0.0.1:1", flush_interval_s=60.0, timeout_s=0.5
+    )
+    fail0 = METRICS.counter("corro_otel_export_failures_total").value
+    with trace.span("doomed"):
+        pass
+    exp.flush()
+    assert METRICS.counter("corro_otel_export_failures_total").value == fail0 + 1
+    otel.configure(None)
