@@ -1,0 +1,181 @@
+"""Dual-protocol API front-end: HTTP/2 + HTTP/1.1 on one port.
+
+The reference API port speaks both protocols — hyper's auto-mode server
+sniffs the 24-byte h2c client preface and its client is HTTP/2-only
+(`klukai-client/src/lib.rs:33-47`).  This front-end reproduces that on
+asyncio:
+
+- each accepted connection is sniffed byte-by-byte against the preface:
+  the instant the buffer diverges it is an HTTP/1.1 connection and the
+  bytes are replayed into a raw TCP proxy to the internal aiohttp
+  listener; a full preface match terminates HTTP/2 here
+  (`net/h2.py`) and forwards each multiplexed stream as an HTTP/1.1
+  request to the same internal listener;
+- forwarding preserves the whole aiohttp route surface (authz, limits,
+  metrics, NDJSON streaming) with no duplicated handler logic — response
+  bodies stream frame-by-frame, so one h2 connection can carry live
+  subscriptions next to queries, like the reference's multiplexed h2.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import List, Optional
+
+import aiohttp
+
+from corrosion_tpu.net.h2 import CANCEL, PREFACE, H2Request, H2Server
+
+log = logging.getLogger(__name__)
+
+# hop-by-hop headers that must not cross the h1→h2 boundary (RFC 9113 §8.2.2)
+_HOP_BY_HOP = {
+    "connection", "keep-alive", "proxy-connection", "transfer-encoding",
+    "upgrade", "te",
+}
+
+
+class ApiFrontend:
+    """One public listener routing h2c and h1.1 to the internal listener."""
+
+    def __init__(self, upstream_host: str, upstream_port: int,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.upstream_host = upstream_host
+        self.upstream_port = upstream_port
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._h2 = H2Server(self._forward)  # handle_connection only
+        self._proxy_tasks: set = set()
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(limit=0, keepalive_timeout=30.0)
+        )
+        self._server = await asyncio.start_server(
+            self._on_conn, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    @property
+    def addrs(self) -> List[str]:
+        if self._server is None:
+            return []
+        return [
+            f"{s.getsockname()[0]}:{s.getsockname()[1]}"
+            for s in self._server.sockets
+        ]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        await self._h2.stop()
+        for t in list(self._proxy_tasks):
+            t.cancel()
+        if self._session is not None:
+            await self._session.close()
+
+    async def _on_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            buf = b""
+            while len(buf) < len(PREFACE) and PREFACE.startswith(buf):
+                chunk = await asyncio.wait_for(
+                    reader.read(len(PREFACE) - len(buf)), 30.0
+                )
+                if not chunk:
+                    writer.close()
+                    return
+                buf += chunk
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            writer.close()
+            return
+        if buf == PREFACE:
+            await self._h2.handle_connection(reader, writer, preface_consumed=True)
+        else:
+            await self._proxy_h1(buf, reader, writer)
+
+    # -- h1 pass-through ---------------------------------------------------
+
+    async def _proxy_h1(
+        self, head: bytes,
+        reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+    ) -> None:
+        """Raw byte pump: the sniffed prefix is replayed, then both
+        directions stream until either side closes."""
+        try:
+            up_r, up_w = await asyncio.open_connection(
+                self.upstream_host, self.upstream_port
+            )
+        except (ConnectionError, OSError):
+            writer.close()
+            return
+        up_w.write(head)
+
+        async def pump(src: asyncio.StreamReader, dst: asyncio.StreamWriter):
+            try:
+                while True:
+                    data = await src.read(65536)
+                    if not data:
+                        break
+                    dst.write(data)
+                    await dst.drain()
+            except (ConnectionError, OSError, asyncio.CancelledError):
+                pass
+            finally:
+                try:
+                    dst.close()
+                except (ConnectionError, OSError):
+                    pass
+
+        t1 = asyncio.ensure_future(pump(reader, up_w))
+        t2 = asyncio.ensure_future(pump(up_r, writer))
+        self._proxy_tasks.update((t1, t2))
+        try:
+            await asyncio.gather(t1, t2, return_exceptions=True)
+        finally:
+            self._proxy_tasks.difference_update((t1, t2))
+
+    # -- h2 stream forwarding ----------------------------------------------
+
+    async def _forward(self, req: H2Request) -> None:
+        """One h2 stream -> one upstream h1 request, streaming the
+        response back as DATA frames (NDJSON streams stay live)."""
+        assert self._session is not None
+        body = await req.read_body()
+        headers = {
+            k: v for k, v in req.headers.items()
+            if k not in _HOP_BY_HOP and k != "content-length"
+        }
+        url = (
+            f"http://{self.upstream_host}:{self.upstream_port}{req.path}"
+        )
+        try:
+            async with self._session.request(
+                req.method, url, data=body if body else None,
+                headers=headers,
+                timeout=aiohttp.ClientTimeout(total=None, connect=10.0),
+            ) as resp:
+                out_headers = {
+                    k.lower(): v for k, v in resp.headers.items()
+                    if k.lower() not in _HOP_BY_HOP
+                }
+                await req.send_headers(resp.status, out_headers)
+                async for chunk in resp.content.iter_any():
+                    if chunk:
+                        await req.send_data(chunk)
+                await req.send_data(b"", end_stream=True)
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            log.debug("h2 forward failed %s %s: %s", req.method, req.path, e)
+            if not req._sent_headers:
+                await req.respond(502, b"upstream unavailable")
+            else:
+                # upstream died mid-stream: RST so the client's body
+                # iterator errors and its reconnect logic kicks in —
+                # never leave the stream open with no END_STREAM
+                await req._conn.send_rst(req._stream.sid, CANCEL)
+                req._stream.fail(CANCEL)

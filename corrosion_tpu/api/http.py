@@ -61,6 +61,7 @@ class ApiServer:
         self._slow_limit = _Limit(4)
         self._runner: Optional[web.AppRunner] = None
         self.addrs: List[str] = []
+        self._fronts: list = []
 
     def build_app(self) -> web.Application:
         app = web.Application(middlewares=[self._metrics_mw, self._authz])
@@ -78,14 +79,24 @@ class ApiServer:
         # hold the runner open indefinitely on cleanup
         self._runner = web.AppRunner(self.build_app(), shutdown_timeout=2.0)
         await self._runner.setup()
+        # the aiohttp app binds one internal loopback port; every public
+        # bind addr gets a dual-protocol front-end (api/h2front.py) that
+        # terminates HTTP/2 and passes HTTP/1.1 bytes through — the
+        # reference's hyper auto-mode server on the same port
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        internal_port = site._server.sockets[0].getsockname()[1]
+        from corrosion_tpu.api.h2front import ApiFrontend
+
         for bind in self.agent.config.api.bind_addr:
             host, _, port = bind.rpartition(":")
-            site = web.TCPSite(self._runner, host or "127.0.0.1", int(port))
-            await site.start()
-            srv = site._server
-            for sock in getattr(srv, "sockets", []) or []:
-                name = sock.getsockname()
-                self.addrs.append(f"{name[0]}:{name[1]}")
+            front = ApiFrontend(
+                "127.0.0.1", internal_port,
+                host=host or "127.0.0.1", port=int(port),
+            )
+            await front.start()
+            self._fronts.append(front)
+            self.addrs.extend(front.addrs)
 
     async def stop(self) -> None:
         # end live subscription/update streams first (their handlers block
@@ -94,6 +105,9 @@ class ApiServer:
             await self.subs.stop_all()
         if self.updates is not None:
             await self.updates.stop_all()
+        for front in self._fronts:
+            await front.stop()
+        self._fronts.clear()
         if self._runner is not None:
             await self._runner.cleanup()
 

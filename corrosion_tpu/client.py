@@ -7,13 +7,12 @@ last observed ChangeId and transparently reconnects + resubscribes from
 it on gap or disconnect (`sub.rs:328-388`).
 
 Protocol note: the reference client is HTTP/2-only (`lib.rs:33-47`,
-hyper with `http2_only(true)`). This image ships no h2 stack (`h2` and
-`hypercorn` are absent; httpx is present but its HTTP/2 mode requires
-the `h2` package), so both this client and the aiohttp server speak
-HTTP/1.1 with identical paths, headers, and NDJSON framing — an
-environment constraint, recorded the same way `runtime/trace.py` records
-the missing OTLP SDK. Streaming multiplexing loss is mitigated by
-per-stream connections (aiohttp pools keep-alive conns).
+hyper with `http2_only(true)`, keep-alive PINGs every 10 s). This client
+matches it: by default requests ride one multiplexed h2c connection
+(`net/h2.py` — the in-repo HTTP/2 implementation; the server front-end
+`api/h2front.py` speaks both protocols on the API port). `http2=False`
+falls back to aiohttp HTTP/1.1 with per-stream keep-alive connections —
+identical paths, headers, and NDJSON framing either way.
 """
 
 from __future__ import annotations
@@ -23,22 +22,129 @@ import contextlib
 import json
 import sqlite3
 import threading
+import urllib.parse
 from typing import Any, AsyncIterator, Dict, List, Optional
 
 import aiohttp
 
+from corrosion_tpu.net.h2 import H2Client, StreamReset
+
+
+class _H2Resp:
+    """Duck-typed slice of aiohttp.ClientResponse the client uses:
+    .status, .headers.get, .text(), .content.iter_any()."""
+
+    def __init__(self, resp):
+        self._resp = resp
+        self.status = resp.status
+        self.headers = resp.headers
+
+    async def text(self) -> str:
+        return (await self._resp.read()).decode()
+
+    async def json(self) -> Any:
+        return json.loads(await self._resp.read())
+
+    @property
+    def content(self) -> "_H2Resp":
+        return self
+
+    def iter_any(self) -> AsyncIterator[bytes]:
+        return self._resp.body()
+
+
+class _H2Ctx:
+    def __init__(self, session: "_H2Session", method: str, url: str,
+                 json_body: Any, params: Optional[Dict[str, str]]):
+        self._session = session
+        self._method = method
+        self._url = url
+        self._json = json_body
+        self._params = params
+        self._resp = None
+
+    async def __aenter__(self) -> _H2Resp:
+        split = urllib.parse.urlsplit(self._url)
+        path = split.path or "/"
+        qs = split.query
+        if self._params:
+            extra = urllib.parse.urlencode(self._params)
+            qs = f"{qs}&{extra}" if qs else extra
+        if qs:
+            path = f"{path}?{qs}"
+        body = b""
+        if self._json is not None:
+            body = json.dumps(self._json).encode()
+        try:
+            # bound connect+send+response-headers like the h1 session's
+            # total timeout did — a wedged server must not hang callers
+            # forever just because its TCP + PINGs stay healthy. (Body
+            # streaming is deliberately unbounded: subscriptions are
+            # infinite by design and reconnect on transport errors.)
+            self._resp = await asyncio.wait_for(
+                self._session.h2.request(
+                    self._method, path,
+                    headers=self._session.headers, body=body,
+                ),
+                self._session.request_timeout,
+            )
+        except (StreamReset, ConnectionError, OSError, asyncio.TimeoutError) as e:
+            # surface transport failures as the retry-able client error
+            # type the reconnect loops already handle
+            raise aiohttp.ClientConnectionError(str(e)) from e
+        return _H2Resp(self._resp)
+
+    async def __aexit__(self, *exc) -> None:
+        if self._resp is not None:
+            await self._resp.aclose()
+
+
+class _H2Session:
+    """aiohttp.ClientSession-shaped facade over one multiplexed H2Client."""
+
+    def __init__(self, host: str, port: int, headers: Dict[str, str],
+                 request_timeout: float = 300.0):
+        self.h2 = H2Client(host, port)
+        self.headers = headers
+        self.request_timeout = request_timeout
+        self.closed = False
+
+    def post(self, url: str, json: Any = None,
+             params: Optional[Dict[str, str]] = None) -> _H2Ctx:
+        return _H2Ctx(self, "POST", url, json, params)
+
+    def get(self, url: str,
+            params: Optional[Dict[str, str]] = None) -> _H2Ctx:
+        return _H2Ctx(self, "GET", url, None, params)
+
+    async def close(self) -> None:
+        self.closed = True
+        await self.h2.close()
+
 
 class CorrosionApiClient:
-    def __init__(self, addr: str, token: Optional[str] = None):
+    def __init__(self, addr: str, token: Optional[str] = None,
+                 http2: bool = True):
         self.base = f"http://{addr}"
+        self.http2 = http2
+        host, sep, port = addr.rpartition(":")
+        if sep and port.isdigit():
+            self._host, self._port = host or "127.0.0.1", int(port)
+        else:  # bare hostname: default http port, as the h1 path resolves it
+            self._host, self._port = addr, 80
         self._headers = {"content-type": "application/json"}
         if token:
             self._headers["authorization"] = f"Bearer {token}"
-        self._session: Optional[aiohttp.ClientSession] = None
+        self._session = None
 
-    async def _ensure(self) -> aiohttp.ClientSession:
+    async def _ensure(self):
         if self._session is None or self._session.closed:
-            self._session = aiohttp.ClientSession(headers=self._headers)
+            if self.http2:
+                self._session = _H2Session(
+                    self._host, self._port, self._headers
+                )
+            else:
+                self._session = aiohttp.ClientSession(headers=self._headers)
         return self._session
 
     async def close(self) -> None:
@@ -163,7 +269,8 @@ class SubscriptionStream:
                     retries = 0
                     yield ev
                 return  # server ended the stream cleanly
-            except (aiohttp.ClientError, asyncio.TimeoutError, ClientError):
+            except (aiohttp.ClientError, asyncio.TimeoutError, ClientError,
+                    StreamReset, ConnectionError):
                 retries += 1
                 if self.query_id is None or retries > self._max_retries:
                     raise

@@ -1,0 +1,308 @@
+"""HTTP/2 transport: HPACK, flow control, multiplexing, curl interop,
+and the dual-protocol API front-end.
+
+Reference parity: the client is HTTP/2-only (`klukai-client/src/lib.rs:33-47`)
+and the hyper server auto-negotiates h2c/h1.1 on the API port. The curl
+tests exercise our server against nghttp2 — a real, independent h2 peer.
+"""
+
+import asyncio
+import json
+import shutil
+
+import pytest
+
+from corrosion_tpu.net import hpack
+from corrosion_tpu.net.h2 import (
+    DEFAULT_WINDOW,
+    H2Client,
+    H2Server,
+    StreamReset,
+)
+
+HEADERS = [
+    (b":method", b"POST"),
+    (b":path", b"/v1/transactions"),
+    (b":scheme", b"http"),
+    (b":authority", b"127.0.0.1:8080"),
+    (b"content-type", b"application/json"),
+    (b"authorization", b"Bearer sekrit"),
+]
+
+
+# -- hpack ------------------------------------------------------------------
+
+
+def test_hpack_nghttp2_roundtrip_with_dynamic_table():
+    assert hpack.nghttp2_available()
+    d, i = hpack.NgDeflater(), hpack.NgInflater()
+    first = d.encode(HEADERS)
+    assert i.decode(first) == HEADERS
+    second = d.encode(HEADERS)  # dynamic-table hits shrink the block
+    assert len(second) < len(first)
+    assert i.decode(second) == HEADERS
+
+
+def test_hpack_python_encode_decodable_by_both():
+    enc = hpack.PyDeflater().encode(HEADERS)
+    assert hpack.PyInflater().decode(enc) == HEADERS
+    assert hpack.NgInflater().decode(enc) == HEADERS  # always-legal encoding
+
+
+def test_hpack_integer_boundaries():
+    # RFC 7541 §5.1: values straddling the prefix limit
+    for value in (0, 1, 30, 31, 32, 126, 127, 128, 255, 16383, 2**20):
+        enc = hpack._int_encode(value, 5, 0x20)
+        got, pos = hpack._int_decode(enc, 0, 5)
+        assert got == value and pos == len(enc)
+
+
+# -- server/client over real sockets ---------------------------------------
+
+
+@pytest.fixture
+def h2_pair():
+    loop = asyncio.new_event_loop()
+
+    async def handler(req):
+        if req.path.startswith("/echo"):
+            body = await req.read_body()
+            await req.respond(
+                200, b"echo:" + body, {"x-method": req.method}
+            )
+        elif req.path == "/big":
+            # response larger than both flow-control windows
+            await req.send_headers(200)
+            await req.send_data(b"z" * (DEFAULT_WINDOW * 2 + 123), end_stream=True)
+        elif req.path == "/stream":
+            await req.send_headers(200)
+            for i in range(10):
+                await req.send_data(json.dumps({"n": i}).encode() + b"\n")
+                await asyncio.sleep(0.01)
+            await req.send_data(b"", end_stream=True)
+        elif req.path == "/forever":
+            await req.send_headers(200)
+            while True:
+                await req.send_data(b"tick\n")
+                await asyncio.sleep(0.01)
+        else:
+            await req.respond(404, b"nope")
+
+    srv = H2Server(handler)
+    loop.run_until_complete(srv.start())
+    client = H2Client("127.0.0.1", srv.port)
+    yield loop, srv, client
+    loop.run_until_complete(client.close())
+    loop.run_until_complete(srv.stop())
+    loop.close()
+
+
+def test_h2_echo_roundtrip(h2_pair):
+    loop, _srv, client = h2_pair
+
+    async def go():
+        resp = await client.request("POST", "/echo", body=b"x" * 1000)
+        assert resp.status == 200
+        assert resp.headers["x-method"] == "POST"
+        return await resp.read()
+
+    assert loop.run_until_complete(go()) == b"echo:" + b"x" * 1000
+
+
+def test_h2_flow_control_large_bodies_both_directions(h2_pair):
+    loop, _srv, client = h2_pair
+    big = bytes(range(256)) * 1024  # 256 KiB > 64 KiB initial window
+
+    async def go():
+        resp = await client.request("POST", "/echo", body=big)
+        got = await resp.read()
+        assert got == b"echo:" + big
+        resp = await client.request("GET", "/big")
+        body = await resp.read()
+        assert len(body) == DEFAULT_WINDOW * 2 + 123
+        assert set(body) == {ord("z")}
+
+    loop.run_until_complete(go())
+
+
+def test_h2_multiplexed_streams_interleave(h2_pair):
+    loop, _srv, client = h2_pair
+
+    async def go():
+        async def echo(i):
+            r = await client.request("POST", "/echo", body=f"m{i}".encode())
+            return (await r.read()).decode()
+
+        async def stream():
+            r = await client.request("GET", "/stream")
+            return [json.loads(ln) async for ln in _lines(r)]
+
+        a, b, events, c = await asyncio.gather(
+            echo(1), echo(2), stream(), echo(3)
+        )
+        assert (a, b, c) == ("echo:m1", "echo:m2", "echo:m3")
+        assert [e["n"] for e in events] == list(range(10))
+
+    async def _lines(resp):
+        buf = b""
+        async for chunk in resp.body():
+            buf += chunk
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                if line.strip():
+                    yield line
+
+    loop.run_until_complete(go())
+
+
+def test_h2_aclose_rst_stops_infinite_stream(h2_pair):
+    loop, srv, client = h2_pair
+
+    async def go():
+        resp = await client.request("GET", "/forever")
+        it = resp.body()
+        assert (await it.__anext__()).startswith(b"tick")
+        await resp.aclose()
+        # consuming after cancel terminates cleanly instead of hanging
+        rest = [c async for c in it]
+        assert b"".join(rest) is not None
+        # server drops the stream promptly after the RST
+        for _ in range(100):
+            if not any(s for c in [*srv._conns] for s in c.streams):
+                break
+            await asyncio.sleep(0.02)
+
+    loop.run_until_complete(asyncio.wait_for(go(), 10))
+
+
+def test_h2_ping_keepalive(h2_pair):
+    loop, _srv, client = h2_pair
+
+    async def go():
+        conn = await client._ensure()
+        assert await conn.ping(2.0)
+
+    loop.run_until_complete(go())
+
+
+def test_h2_handler_error_maps_to_500():
+    loop = asyncio.new_event_loop()
+
+    async def handler(req):
+        raise RuntimeError("boom")
+
+    srv = H2Server(handler)
+    loop.run_until_complete(srv.start())
+    client = H2Client("127.0.0.1", srv.port)
+
+    async def go():
+        resp = await client.request("GET", "/")
+        assert resp.status == 500
+        await client.close()
+        await srv.stop()
+
+    loop.run_until_complete(go())
+    loop.close()
+
+
+# -- curl (nghttp2) interop -------------------------------------------------
+
+
+@pytest.mark.skipif(shutil.which("curl") is None, reason="no curl")
+def test_curl_http2_prior_knowledge_interop():
+    loop = asyncio.new_event_loop()
+
+    async def handler(req):
+        body = await req.read_body()
+        await req.respond(
+            200,
+            json.dumps(
+                {"method": req.method, "path": req.path, "len": len(body)}
+            ).encode(),
+            {"content-type": "application/json"},
+        )
+
+    srv = H2Server(handler)
+    loop.run_until_complete(srv.start())
+
+    async def run_curl():
+        # async subprocess: the server must keep serving while curl runs
+        proc = await asyncio.create_subprocess_exec(
+            "curl", "-s", "--http2-prior-knowledge",
+            "-X", "POST", "--data-binary", "@-",
+            "-w", "\n%{http_version}",
+            f"http://127.0.0.1:{srv.port}/v1/transactions",
+            stdin=asyncio.subprocess.PIPE,
+            stdout=asyncio.subprocess.PIPE,
+        )
+        out, _ = await asyncio.wait_for(
+            # > one flow-control window: exercises WINDOW_UPDATEs
+            proc.communicate(b"q" * 100_000), 30,
+        )
+        return out
+
+    try:
+        out = loop.run_until_complete(run_curl())
+        body, version = out.rsplit(b"\n", 1)
+        assert version.strip() == b"2"
+        parsed = json.loads(body)
+        assert parsed == {
+            "method": "POST", "path": "/v1/transactions", "len": 100_000
+        }
+    finally:
+        loop.run_until_complete(srv.stop())
+        loop.close()
+
+
+# -- dual-protocol API front-end -------------------------------------------
+
+
+def test_api_port_serves_h2_and_h1_together():
+    """One agent API port: curl over h2c, our client over h2, and an
+    HTTP/1.1 aiohttp client — all against the same listener
+    (hyper auto-mode parity, `klukai-agent/src/agent/util.rs:181-351`)."""
+    from tests.test_http_api import boot_with_api
+    from corrosion_tpu.client import CorrosionApiClient
+    from corrosion_tpu.net.mem import MemNetwork
+
+    async def main():
+        net = MemNetwork(seed=77)
+        a, api, client = await boot_with_api(net, "agent-h2")
+        addr = api.addrs[0]
+        try:
+            # h2 client (the default): write + read
+            res = await client.execute(
+                [["INSERT INTO tests (id, text) VALUES (?, ?)", [1, "h2"]]]
+            )
+            assert res["results"][0]["rows_affected"] == 1
+            assert isinstance(client._session.h2, H2Client)  # really h2
+
+            # h1 client on the same port
+            h1 = CorrosionApiClient(addr, http2=False)
+            rows = await h1.query_rows(["SELECT text FROM tests", []])
+            assert rows == [["h2"]]
+            await h1.close()
+
+            # curl h2c prior knowledge on the same port
+            proc = await asyncio.create_subprocess_exec(
+                "curl", "-s", "--http2-prior-knowledge",
+                "-X", "POST", "-H", "content-type: application/json",
+                "-d", json.dumps(["SELECT id, text FROM tests"]),
+                "-w", "\n%{http_version}",
+                f"http://{addr}/v1/queries",
+                stdout=asyncio.subprocess.PIPE,
+            )
+            out, _ = await asyncio.wait_for(proc.communicate(), 30)
+            body, version = out.rsplit(b"\n", 1)
+            assert version.strip() == b"2"
+            lines = [json.loads(x) for x in body.splitlines() if x.strip()]
+            assert lines[0] == {"columns": ["id", "text"]}
+            assert {"row": [1, [1, "h2"]]} in lines
+        finally:
+            await client.close()
+            await api.stop()
+            from corrosion_tpu.agent.run import shutdown
+
+            await shutdown(a)
+
+    asyncio.run(main())
