@@ -84,7 +84,10 @@ async def setup(
         listener = await TcpListener.bind(
             host or "127.0.0.1", int(port), ssl_context=server_ctx
         )
-        transport = TcpTransport(listener, ssl_context=client_ctx)
+        transport = TcpTransport(
+            listener, ssl_context=client_ctx,
+            idle_timeout=float(config.gossip.idle_timeout_secs),
+        )
 
     gossip_addr = config.gossip.external_addr or listener.addr
     actor = Actor(

@@ -14,6 +14,7 @@ Counterpart of the axum router in `klukai-agent/src/agent/util.rs:181-351`:
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import sqlite3
 import time
 from typing import Any, List, Optional
@@ -152,9 +153,23 @@ class ApiServer:
 
     # -- handlers ----------------------------------------------------------
 
+    @staticmethod
+    def _timeout_param(request: web.Request) -> Optional[float]:
+        """`?timeout=<seconds>` (TimeoutParams, api/public/mod.rs:203,525):
+        bounds statement runtime; overruns are interrupted server-side."""
+        raw = request.query.get("timeout")
+        if raw is None:
+            return None
+        try:
+            t = float(raw)
+        except ValueError:
+            return None
+        return t if t > 0 else None
+
     async def h_transactions(self, request: web.Request) -> web.Response:
         async with self._tx_limit:
             start = time.monotonic()
+            timeout = self._timeout_param(request)
             try:
                 body = await request.json()
                 stmts = [parse_statement(s) for s in body]
@@ -167,16 +182,25 @@ class ApiServer:
             results: List[dict] = []
 
             def apply(tx) -> List[Any]:
+                # overrunning statements are interrupted via the store
+                # watchdog (InterruptibleTransaction analog) and surface
+                # as an 'interrupted' sqlite error → 400
+                guard = (
+                    self.agent.store.interrupt_after(timeout)
+                    if timeout
+                    else contextlib.nullcontext()
+                )
                 out = []
-                for stmt in stmts:
-                    t0 = time.monotonic()
-                    n = _execute_stmt(tx, stmt)
-                    out.append(
-                        {
-                            "rows_affected": n,
-                            "time": time.monotonic() - t0,
-                        }
-                    )
+                with guard:
+                    for stmt in stmts:
+                        t0 = time.monotonic()
+                        n = _execute_stmt(tx, stmt)
+                        out.append(
+                            {
+                                "rows_affected": n,
+                                "time": time.monotonic() - t0,
+                            }
+                        )
                 return out
 
             try:
@@ -198,6 +222,7 @@ class ApiServer:
 
     async def h_queries(self, request: web.Request) -> web.StreamResponse:
         async with self._query_limit:
+            timeout = self._timeout_param(request)
             try:
                 stmt = parse_statement(await request.json())
             except (ValueError, TypeError) as e:
@@ -211,20 +236,42 @@ class ApiServer:
             loop = asyncio.get_running_loop()
 
             def run_query():
+                import threading
+
                 from corrosion_tpu.runtime.trace import timed_query
 
                 with self.agent.store.pooled_read() as conn:
-                    with timed_query(stmt.query):
-                        cur = conn.execute(
-                            stmt.query, _bind_params(stmt)
+                    # ?timeout= interrupt (mod.rs:336: "sql call took more
+                    # than {timeout}, interrupting"). disarm-before-fire
+                    # is lock-checked so a timer firing as the query
+                    # finishes can never interrupt the pool's NEXT user.
+                    lk, live = threading.Lock(), [True]
+                    timer = None
+                    if timeout:
+                        def fire():
+                            with lk:
+                                if live[0]:
+                                    conn.interrupt()
+                        timer = threading.Timer(timeout, fire)
+                        timer.daemon = True
+                        timer.start()
+                    try:
+                        with timed_query(stmt.query):
+                            cur = conn.execute(
+                                stmt.query, _bind_params(stmt)
+                            )
+                        cols = (
+                            [d[0] for d in cur.description]
+                            if cur.description
+                            else []
                         )
-                    cols = (
-                        [d[0] for d in cur.description]
-                        if cur.description
-                        else []
-                    )
-                    rows = cur.fetchall()
-                    return cols, rows
+                        rows = cur.fetchall()
+                        return cols, rows
+                    finally:
+                        with lk:
+                            live[0] = False
+                        if timer is not None:
+                            timer.cancel()
 
             try:
                 cols, rows = await loop.run_in_executor(None, run_query)

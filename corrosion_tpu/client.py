@@ -160,10 +160,19 @@ class CorrosionApiClient:
 
     # -- one-shot calls ----------------------------------------------------
 
-    async def execute(self, statements: List[Any]) -> Dict[str, Any]:
+    @staticmethod
+    def _timeout_params(timeout: Optional[float]) -> Optional[Dict[str, str]]:
+        # the reference client threads ?timeout= through query_typed /
+        # execute (lib.rs:53-58); the server interrupts overruns
+        return {"timeout": str(timeout)} if timeout else None
+
+    async def execute(
+        self, statements: List[Any], timeout: Optional[float] = None
+    ) -> Dict[str, Any]:
         s = await self._ensure()
         async with s.post(
-            f"{self.base}/v1/transactions", json=statements
+            f"{self.base}/v1/transactions", json=statements,
+            params=self._timeout_params(timeout),
         ) as resp:
             body = await _body_json(resp)
             if resp.status >= 400:
@@ -196,11 +205,14 @@ class CorrosionApiClient:
         ) as resp:
             return await resp.json()
 
-    async def query(self, statement: Any) -> AsyncIterator[Dict[str, Any]]:
+    async def query(
+        self, statement: Any, timeout: Optional[float] = None
+    ) -> AsyncIterator[Dict[str, Any]]:
         """Stream QueryEvents for one statement."""
         s = await self._ensure()
         async with s.post(
-            f"{self.base}/v1/queries", json=statement
+            f"{self.base}/v1/queries", json=statement,
+            params=self._timeout_params(timeout),
         ) as resp:
             if resp.status >= 400:
                 raise ClientError(resp.status, await _body_json(resp))

@@ -172,8 +172,22 @@ class H2Connection:
         # hpack encoder state is connection-ordered: serialize encode+send
         async with self._hpack_lock:
             block = self.deflater.encode(headers)
-            flags = FLAG_END_HEADERS | (FLAG_END_STREAM if end_stream else 0)
-            await self._send(_frame(HEADERS, flags, sid, block))
+            limit = self.peer_max_frame
+            # a block over the peer's MAX_FRAME_SIZE must be split into
+            # CONTINUATIONs (RFC 9113 §4.2: oversized = connection error)
+            first, rest = block[:limit], block[limit:]
+            flags = FLAG_END_STREAM if end_stream else 0
+            if not rest:
+                flags |= FLAG_END_HEADERS
+            raw = _frame(HEADERS, flags, sid, first)
+            while rest:
+                chunk, rest = rest[:limit], rest[limit:]
+                raw += _frame(
+                    CONTINUATION,
+                    FLAG_END_HEADERS if not rest else 0,
+                    sid, chunk,
+                )
+            await self._send(raw)
 
     async def send_data(self, sid: int, data: bytes, end_stream: bool) -> None:
         """Send respecting both windows and the peer's max frame size."""
@@ -276,7 +290,12 @@ class H2Connection:
     async def read_header_block(
         self, flags: int, payload: bytes
     ) -> Tuple[bytes, int]:
-        """Strip padding/priority; append CONTINUATIONs until END_HEADERS."""
+        """Strip padding/priority; append CONTINUATIONs until END_HEADERS.
+
+        Returns the block plus the effective flags: END_STREAM can only
+        appear on the initial HEADERS frame, so it is preserved across
+        CONTINUATIONs (whose own flag bits carry only END_HEADERS)."""
+        end_stream = flags & FLAG_END_STREAM
         if flags & FLAG_PADDED:
             pad = payload[0]
             payload = payload[1:]
@@ -291,7 +310,7 @@ class H2Connection:
             if ftype != CONTINUATION:
                 raise H2Error(PROTOCOL_ERROR, "expected CONTINUATION")
             block += cont
-        return block, flags
+        return block, flags | end_stream
 
     def _strip_data_padding(self, flags: int, payload: bytes) -> bytes:
         if flags & FLAG_PADDED:
@@ -458,6 +477,7 @@ class H2Server:
         conn = H2Connection(reader, writer, is_server=True)
         self._conns.add(conn)
         tasks: Dict[int, asyncio.Task] = {}
+        last_sid = 0  # client stream ids are strictly increasing (§5.1.1)
         try:
             if not preface_consumed:
                 preface = await asyncio.wait_for(
@@ -471,15 +491,23 @@ class H2Server:
                 if ftype == HEADERS:
                     block, flags = await conn.read_header_block(flags, payload)
                     existing = conn.streams.get(sid)
-                    if existing is not None:
-                        # trailers on an open stream: decode (HPACK state
-                        # is connection-ordered), never a second request
+                    if existing is not None or sid <= last_sid:
+                        # trailers — on an open stream, or late ones for a
+                        # stream whose handler already finished (sid can
+                        # never be a NEW request: ids increase). Decode
+                        # either way: HPACK state is connection-ordered.
                         async with conn._hpack_lock:
-                            existing.trailers = conn.inflater.decode(block)
-                        if flags & FLAG_END_STREAM and not existing.recv_closed:
-                            existing.recv_closed = True
-                            existing.body.put_nowait(None)
+                            trailers = conn.inflater.decode(block)
+                        if existing is not None:
+                            existing.trailers = trailers
+                            if (
+                                flags & FLAG_END_STREAM
+                                and not existing.recv_closed
+                            ):
+                                existing.recv_closed = True
+                                existing.body.put_nowait(None)
                         continue
+                    last_sid = sid
                     stream = _Stream(sid, conn.peer_initial_window)
                     async with conn._hpack_lock:
                         stream.headers = conn.inflater.decode(block)
@@ -488,8 +516,14 @@ class H2Server:
                         stream.recv_closed = True
                         stream.body.put_nowait(None)
                     req = H2Request(conn, stream)
-                    tasks[sid] = asyncio.ensure_future(
+                    task = asyncio.ensure_future(
                         self._run_stream(conn, req, stream)
+                    )
+                    tasks[sid] = task
+                    # prune on completion: one long-lived multiplexed
+                    # connection must not accumulate finished tasks
+                    task.add_done_callback(
+                        lambda _t, s=sid: tasks.pop(s, None)
                     )
                 elif ftype == DATA:
                     stream = conn.streams.get(sid)
@@ -556,6 +590,14 @@ class H2Server:
                 await conn.send_rst(stream.sid, CANCEL)
         finally:
             conn.streams.pop(stream.sid, None)
+            if (
+                not stream.recv_closed
+                and stream.reset_code is None
+                and not conn.closed
+            ):
+                # response finished before the request did: RST with
+                # NO_ERROR so the peer stops sending (RFC 9113 §8.1)
+                asyncio.ensure_future(conn.send_rst(stream.sid, NO_ERROR))
 
 
 # -- client -----------------------------------------------------------------
@@ -720,6 +762,13 @@ class H2Client:
         except (StreamReset, ConnectionError, OSError) as e:
             conn.streams.pop(sid, None)
             raise StreamReset(str(e)) from e
+        except asyncio.CancelledError:
+            # caller timed out / was cancelled: deregister and RST so the
+            # server stops and late frames aren't queued into an orphan
+            conn.streams.pop(sid, None)
+            if not conn.closed:
+                asyncio.ensure_future(conn.send_rst(sid, CANCEL))
+            raise
         if stream.reset_code is not None:
             conn.streams.pop(sid, None)
             raise StreamReset(f"stream reset: {stream.reset_code}")

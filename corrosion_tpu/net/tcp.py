@@ -238,11 +238,37 @@ class TcpTransport(Transport):
     sends across extra sockets would buy nothing at this layer while
     complicating addr-based peer bookkeeping."""
 
-    def __init__(self, listener: TcpListener, ssl_context=None):
+    def __init__(self, listener: TcpListener, ssl_context=None,
+                 idle_timeout: float = 30.0):
         self._listener = listener
         self._ssl = ssl_context
+        # gossip.idle_timeout_secs (peer/mod.rs:125-127 max_idle_timeout):
+        # cached lane connections unused this long are reaped, so dead
+        # peers don't pin sockets the way an expired QUIC path wouldn't
+        self.idle_timeout = idle_timeout
         self._conns: Dict[Tuple[str, bytes], asyncio.StreamWriter] = {}
         self._locks: Dict[Tuple[str, bytes], asyncio.Lock] = {}
+        self._last_use: Dict[Tuple[str, bytes], float] = {}
+
+    def reap_idle(self, now: Optional[float] = None) -> int:
+        """Close cached lane connections idle longer than idle_timeout.
+        Runs opportunistically on every cached send; callable directly.
+        Keys whose lane lock is held are in active use and skipped."""
+        now = time.monotonic() if now is None else now
+        reaped = 0
+        for key in list(self._conns):
+            lock = self._locks.get(key)
+            if lock is not None and lock.locked():
+                continue
+            if now - self._last_use.get(key, now) > self.idle_timeout:
+                writer = self._conns.pop(key)
+                self._last_use.pop(key, None)
+                writer.close()
+                reaped += 1
+        if reaped:
+            METRICS.counter("corro.transport.conns.idle_closed").inc(reaped)
+            METRICS.gauge("corro.transport.conns.cached").set(len(self._conns))
+        return reaped
 
     async def send_datagram(self, addr: str, data: bytes) -> None:
         if self._ssl is not None:
@@ -306,6 +332,7 @@ class TcpTransport(Transport):
         """Send one frame on the cached per-(peer, lane) connection with
         one reconnect retry, like transport.rs:108-139."""
         conn_key = (addr, lane)
+        self.reap_idle()
         lock = self._locks.setdefault(conn_key, asyncio.Lock())
         async with lock:
             for attempt in (0, 1):
@@ -313,6 +340,7 @@ class TcpTransport(Transport):
                 if writer is None or writer.is_closing():
                     _, writer = await self._connect(addr, lane)
                     self._conns[conn_key] = writer
+                self._last_use[conn_key] = time.monotonic()
                 try:
                     await _write_frame(writer, payload)
                     METRICS.counter(

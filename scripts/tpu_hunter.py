@@ -166,60 +166,43 @@ def battery_steps() -> list[tuple[str, list[str], dict, float, str]]:
         ("smoke",
          [py, "-u", "scripts/profile_swim.py", "1024", "4"],
          {}, 900.0, "TPU_PROFILE_1k.txt"),
-        ("profile10k",
-         [py, "-u", "scripts/profile_swim.py", "10000"],
-         {}, 1800.0, "TPU_PROFILE_10k.txt"),
+        # HEADLINE BENCHES FIRST (r4 lesson: profile10k burned a 30-min
+        # timeout on a window that wedged 15 s in; the benches are what
+        # BENCH_r{N} replays, so they bank before anything else)
         ("bench10k",
          [py, "-u", "bench.py"],
          {**bench_env, "BENCH_N": "10000"}, 1500.0, "BENCH_TPU_10k.json"),
         ("bench40k",
          [py, "-u", "bench.py"],
          {**bench_env, "BENCH_N": "40000"}, 2400.0, "BENCH_TPU_40k.json"),
-        # --- r4 additions ----------------------------------------------
-        # Ordered CHEAP-WINS-FIRST: tunnel windows have died 10-45 min
-        # in, so short steps bank results before the long gambles.
-        # pallas kernel re-profile after the SMEM scalar fix (the first
-        # on-chip run failed with "Cannot store scalars to VMEM")
-        ("pallas1k_fix",
-         [py, "-u", "scripts/profile_swim.py", "1024", "4"],
-         {}, 900.0, "TPU_PROFILE_1k_pallasfix.txt"),
-        # fingerprinted bench re-runs (records carry code_sha + config so
-        # a round-end replay is verifiable; device-resident convergence
-        # loop), the sort-impl A/B the phase table motivated, and the
-        # sortless shift-gossip A/B (on CPU: fewer ticks to converge)
-        ("bench10k_r2",
-         [py, "-u", "bench.py"],
-         {**bench_env, "BENCH_N": "10000"}, 1500.0, "BENCH_TPU_10k.json"),
+        # the sortless shift-gossip A/B (on CPU: fewer ticks to converge
+        # AND a cheaper tick) — if it wins on chip it becomes the default
         ("bench10k_shift",
          [py, "-u", "bench.py"],
          {**bench_env, "BENCH_N": "10000", "BENCH_GOSSIP_MODE": "shift"},
          1500.0, "BENCH_TPU_10k_shift.json"),
-        ("bench10k_sort",
-         [py, "-u", "bench.py"],
-         {**bench_env, "BENCH_N": "10000", "BENCH_INBOX_IMPL": "sort"},
-         1500.0, "BENCH_TPU_10k_sort.json"),
-        ("bench40k_r2",
-         [py, "-u", "bench.py"],
-         {**bench_env, "BENCH_N": "40000"}, 2400.0, "BENCH_TPU_40k.json"),
         ("bench40k_shift",
          [py, "-u", "bench.py"],
          {**bench_env, "BENCH_N": "40000", "BENCH_GOSSIP_MODE": "shift"},
          2400.0, "BENCH_TPU_40k_shift.json"),
-        # re-profile phase tables with the fixed pallas kernel and
-        # per-iteration input variation (the first table's repeated
-        # identical dispatches returned impossibly fast — see
-        # profile_swim.timeit); 40k shows where its 141 ms/tick goes
-        ("profile10k_r2",
-         [py, "-u", "scripts/profile_swim.py", "10000"],
-         {}, 1800.0, "TPU_PROFILE_10k_r2.txt"),
-        ("profile40k",
-         [py, "-u", "scripts/profile_swim.py", "40000", "4"],
-         {}, 2400.0, "TPU_PROFILE_40k.txt"),
         # VERDICT r3 item 2 quality bar on chip: pv_coverage >= 0.99 then
         # 1% churn -> cluster-wide detection with FP 0, at 100k and 262k
         ("pview100k_conv",
          [py, "-u", "scripts/pview_converge.py", "100000", "2048"],
          {}, 3000.0, "TPU_PVIEW_CONV_100k.txt"),
+        # phase tables with the fixed pallas kernel and per-iteration
+        # input variation; 40k shows where its per-tick time goes
+        ("profile10k",
+         [py, "-u", "scripts/profile_swim.py", "10000"],
+         {}, 1200.0, "TPU_PROFILE_10k.txt"),
+        ("profile40k",
+         [py, "-u", "scripts/profile_swim.py", "40000", "4"],
+         {}, 1800.0, "TPU_PROFILE_40k.txt"),
+        # the sort-impl A/B the r3 phase table motivated
+        ("bench10k_sort",
+         [py, "-u", "bench.py"],
+         {**bench_env, "BENCH_N": "10000", "BENCH_INBOX_IMPL": "sort"},
+         1500.0, "BENCH_TPU_10k_sort.json"),
         # the long gambles last: a mid-step tunnel death costs the
         # whole remaining window
         # int16 view: [80k,80k] = 12.8 GB, fits one 16 GB v5e chip donated
@@ -244,30 +227,12 @@ def main() -> None:
     state = load_state()
     steps = battery_steps()
 
-    # Redo steps re-measure artifacts recorded by THIS round's earlier
-    # battery under since-fixed code.  A redo is needed only when its
-    # base completed under a DIFFERENT measured-code fingerprint than
-    # the current tree (state["done_sha"], recorded per completed step):
-    # a base done under current code — fresh battery, or a mid-round
-    # hunter restart after the base re-ran — makes the redo redundant.
+    # Completed steps record the measured-code fingerprint so a later
+    # session can tell whether an artifact matches the tree (bench.py
+    # replay re-checks it independently).
     from bench import _code_fingerprint
     cur_sha = _code_fingerprint()
-    redo_of = {
-        "pallas1k_fix": "smoke",
-        "profile10k_r2": "profile10k",
-        "bench10k_r2": "bench10k",
-        "bench40k_r2": "bench40k",
-    }
-    initial_done = set(state["done"])
     done_sha = state.setdefault("done_sha", {})
-    steps = [
-        s for s in steps
-        if not (
-            s[0] in redo_of
-            and (redo_of[s[0]] not in initial_done
-                 or done_sha.get(redo_of[s[0]]) == cur_sha)
-        )
-    ]
 
     while time.monotonic() - t_start < budget:
         pending = [s for s in steps if s[0] not in state["done"]]

@@ -85,6 +85,8 @@ def h2_pair():
             while True:
                 await req.send_data(b"tick\n")
                 await asyncio.sleep(0.01)
+        elif req.path == "/forever-noheaders":
+            await asyncio.sleep(3600)  # wedged server: no response at all
         else:
             await req.respond(404, b"nope")
 
@@ -306,3 +308,44 @@ def test_api_port_serves_h2_and_h1_together():
             await shutdown(a)
 
     asyncio.run(main())
+
+
+def test_h2_continuation_split_preserves_end_stream(h2_pair):
+    """A >MAX_FRAME_SIZE header block must ride CONTINUATION frames
+    (RFC 9113 §4.2), and END_STREAM from the initial HEADERS must
+    survive reassembly — a bodyless request with huge headers would
+    otherwise hang the handler's read_body() forever."""
+    loop, _srv, client = h2_pair
+
+    async def go():
+        # ~3 x 16384 of incompressible header data on a bodyless GET
+        big = {f"x-pad-{i}": "v" * 800 for i in range(60)}
+        resp = await asyncio.wait_for(
+            client.request("GET", "/echo", headers=big), 10
+        )
+        assert resp.status == 200
+        assert (await resp.read()) == b"echo:"  # END_STREAM was seen
+
+    loop.run_until_complete(go())
+
+
+def test_h2_request_timeout_cancel_does_not_leak_stream(h2_pair):
+    loop, _srv, client = h2_pair
+
+    async def go():
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(
+                client.request("GET", "/forever-noheaders"), 0.3
+            )
+        conn = await client._ensure()
+        # cancelled request must deregister its stream (no orphan queue)
+        for _ in range(50):
+            if not conn.streams:
+                break
+            await asyncio.sleep(0.02)
+        assert conn.streams == {}
+        # connection still serves new requests afterwards
+        r = await client.request("POST", "/echo", body=b"after")
+        assert (await r.read()) == b"echo:after"
+
+    loop.run_until_complete(go())

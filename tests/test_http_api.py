@@ -159,3 +159,44 @@ def test_http_write_gossips_to_peer():
                 await shutdown(ag)
 
     asyncio.run(main())
+
+
+def test_query_timeout_param_interrupts():
+    """?timeout= on /v1/queries interrupts overrunning statements
+    (TimeoutParams, api/public/mod.rs:525, mod.rs:336) — surfaced as an
+    NDJSON error event; the read conn stays usable for the next query."""
+
+    async def main():
+        net = MemNetwork(seed=31)
+        a, api_a, client = await boot_with_api(net, "agent-q")
+        try:
+            await client.execute(
+                [["INSERT INTO tests (id, text) VALUES (1, 'x')"]]
+            )
+            # a recursive CTE that spins far longer than the timeout
+            slow = (
+                "WITH RECURSIVE c(x) AS "
+                "(SELECT 1 UNION ALL SELECT x+1 FROM c WHERE x < 300000000) "
+                "SELECT count(*) FROM c"
+            )
+            events = [e async for e in client.query(slow, timeout=0.3)]
+            assert any("error" in e for e in events), events
+            err = next(e for e in events if "error" in e)
+            assert "interrupt" in err["error"].lower()
+            # pool conn survives the interrupt: a normal query works
+            rows = await client.query_rows(["SELECT id FROM tests", []])
+            assert rows == [[1]]
+            # an execute within budget is unaffected by the param
+            res = await client.execute(
+                [["INSERT INTO tests (id, text) VALUES (2, 'y')"]],
+                timeout=5.0,
+            )
+            assert res["results"][0]["rows_affected"] == 1
+        finally:
+            await client.close()
+            await api_a.stop()
+            from corrosion_tpu.agent.run import shutdown
+
+            await shutdown(a)
+
+    asyncio.run(main())

@@ -176,3 +176,32 @@ def test_tcp_transport_three_lanes():
         await client_listener.close()
 
     asyncio.run(main())
+
+
+def test_tcp_transport_idle_reaper():
+    """gossip.idle_timeout_secs: cached lane conns unused past the
+    timeout are reaped on the next cached send (peer/mod.rs:125-127
+    max_idle_timeout analog)."""
+
+    async def main():
+        async def on_uni(src, data):
+            pass
+
+        server = await TcpListener.bind()
+        server.serve(lambda s, d: None, on_uni, lambda st: None)
+        t = TcpTransport(await TcpListener.bind(), idle_timeout=0.2)
+
+        await t.send_uni(server.addr, b"one")
+        assert len(t._conns) == 1
+        # not yet idle: opportunistic reap keeps it
+        assert t.reap_idle() == 0
+        await asyncio.sleep(0.35)
+        assert t.reap_idle() == 1
+        assert t._conns == {}
+        # next send transparently reconnects
+        await t.send_uni(server.addr, b"two")
+        assert len(t._conns) == 1
+        await t.close()
+        await server.close()
+
+    asyncio.run(main())
