@@ -70,6 +70,12 @@ STREAM_CLOSED = 0x5
 FRAME_SIZE_ERROR = 0x6
 REFUSED_STREAM = 0x7
 CANCEL = 0x8
+COMPRESSION_ERROR = 0x9
+
+# an assembled header block (HEADERS + CONTINUATIONs) larger than this is
+# a hostile peer, not a real request (nghttp2's default header-list cap
+# is 64 KiB; 1 MiB leaves generous headroom)
+MAX_HEADER_BLOCK = 1 << 20
 
 PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
 DEFAULT_WINDOW = 65535
@@ -288,15 +294,19 @@ class H2Connection:
         return ftype, flags, sid, payload
 
     async def read_header_block(
-        self, flags: int, payload: bytes
+        self, flags: int, payload: bytes, sid: int
     ) -> Tuple[bytes, int]:
         """Strip padding/priority; append CONTINUATIONs until END_HEADERS.
 
         Returns the block plus the effective flags: END_STREAM can only
         appear on the initial HEADERS frame, so it is preserved across
-        CONTINUATIONs (whose own flag bits carry only END_HEADERS)."""
+        CONTINUATIONs (whose own flag bits carry only END_HEADERS).
+        CONTINUATIONs must stay on the same stream, and the assembled
+        block is size-capped — an endless-CONTINUATION peer is a DoS."""
         end_stream = flags & FLAG_END_STREAM
         if flags & FLAG_PADDED:
+            if not payload:
+                raise H2Error(PROTOCOL_ERROR, "bad padding")
             pad = payload[0]
             payload = payload[1:]
             if pad > len(payload):
@@ -306,14 +316,18 @@ class H2Connection:
             payload = payload[5:]
         block = payload
         while not flags & FLAG_END_HEADERS:
-            ftype, flags, _sid, cont = await self.read_frame()
-            if ftype != CONTINUATION:
+            ftype, flags, csid, cont = await self.read_frame()
+            if ftype != CONTINUATION or csid != sid:
                 raise H2Error(PROTOCOL_ERROR, "expected CONTINUATION")
             block += cont
+            if len(block) > MAX_HEADER_BLOCK:
+                raise H2Error(FRAME_SIZE_ERROR, "header block too large")
         return block, flags | end_stream
 
     def _strip_data_padding(self, flags: int, payload: bytes) -> bytes:
         if flags & FLAG_PADDED:
+            if not payload:
+                raise H2Error(PROTOCOL_ERROR, "bad padding")
             pad = payload[0]
             payload = payload[1:]
             if pad > len(payload):
@@ -489,7 +503,7 @@ class H2Server:
             while True:
                 ftype, flags, sid, payload = await conn.read_frame()
                 if ftype == HEADERS:
-                    block, flags = await conn.read_header_block(flags, payload)
+                    block, flags = await conn.read_header_block(flags, payload, sid)
                     existing = conn.streams.get(sid)
                     if existing is not None or sid <= last_sid:
                         # trailers — on an open stream, or late ones for a
@@ -559,6 +573,11 @@ class H2Server:
             ConnectionError, OSError,
         ):
             pass
+        except ValueError as e:
+            # undecodable HPACK block: RFC 9113 §4.3 — GOAWAY, not an
+            # abrupt close with an unretrieved task exception
+            log.debug("h2 compression error: %s", e)
+            await conn.send_goaway(COMPRESSION_ERROR)
         except H2Error as e:
             log.debug("h2 connection error: %s", e)
             await conn.send_goaway(e.code)
@@ -673,7 +692,7 @@ class H2Client:
             while True:
                 ftype, flags, sid, payload = await conn.read_frame()
                 if ftype == HEADERS:
-                    block, flags = await conn.read_header_block(flags, payload)
+                    block, flags = await conn.read_header_block(flags, payload, sid)
                     stream = conn.streams.get(sid)
                     async with conn._hpack_lock:
                         decoded = conn.inflater.decode(block)
@@ -717,7 +736,7 @@ class H2Client:
                     return
         except (
             asyncio.IncompleteReadError, ConnectionError, OSError, H2Error,
-            asyncio.CancelledError,
+            ValueError, asyncio.CancelledError,
         ):
             pass
         finally:

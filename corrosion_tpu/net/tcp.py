@@ -263,6 +263,8 @@ class TcpTransport(Transport):
             if now - self._last_use.get(key, now) > self.idle_timeout:
                 writer = self._conns.pop(key)
                 self._last_use.pop(key, None)
+                self._locks.pop(key, None)  # unheld (checked above): a
+                # dead peer must not pin a Lock per (addr, lane) forever
                 writer.close()
                 reaped += 1
         if reaped:
@@ -355,6 +357,7 @@ class TcpTransport(Transport):
                     return
                 except (TransportError, ConnectionError, RuntimeError):
                     self._conns.pop(conn_key, None)
+                    self._last_use.pop(conn_key, None)
                     writer.close()
                     METRICS.counter(
                         "corro.transport.send.retried", lane=lane.decode()
@@ -374,3 +377,5 @@ class TcpTransport(Transport):
         for writer in self._conns.values():
             writer.close()
         self._conns.clear()
+        self._last_use.clear()
+        self._locks.clear()

@@ -349,3 +349,69 @@ def test_h2_request_timeout_cancel_does_not_leak_stream(h2_pair):
         assert (await r.read()) == b"echo:after"
 
     loop.run_until_complete(go())
+
+
+def test_h2_server_robust_to_malformed_input():
+    """Hostile/garbage input: bad preface, truncated frames, unknown
+    frame types, HEADERS with undecodable HPACK, frames on stream 0 —
+    the server must close or ignore, never hang or crash, and keep
+    serving healthy connections."""
+    loop = asyncio.new_event_loop()
+
+    async def handler(req):
+        await req.respond(200, b"ok")
+
+    srv = H2Server(handler)
+    loop.run_until_complete(srv.start())
+
+    import random
+    import struct as _struct
+    from corrosion_tpu.net.h2 import PREFACE
+
+    rnd = random.Random(1234)
+
+    def frame(ftype, flags, sid, payload):
+        return (
+            _struct.pack(">I", len(payload))[1:]
+            + bytes([ftype, flags])
+            + _struct.pack(">I", sid)
+            + payload
+        )
+
+    async def attempt(raw: bytes):
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", srv.port)
+            writer.write(raw)
+            await writer.drain()
+            # server either answers or closes; must not hang
+            await asyncio.wait_for(reader.read(65536), 5)
+            writer.close()
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            pass
+
+    async def go():
+        cases = [
+            b"GET / HTTP/1.0\r\n\r\n",                      # not h2 at all
+            PREFACE[:10],                                   # truncated preface
+            PREFACE + frame(0x1, 0x4, 3, b"\xff\xff\xff"),  # bad hpack block
+            PREFACE + frame(0x0, 0x0, 0, b"data-on-zero"),  # DATA on stream 0
+            PREFACE + frame(0xEE, 0x0, 1, b"unknown"),      # unknown type
+            PREFACE + frame(0x4, 0x0, 0, b"12345"),         # bad SETTINGS len
+            PREFACE + frame(0x8, 0x0, 0, b"\x00\x00"),      # bad WINDOW_UPDATE
+            PREFACE + b"\xff" * 200,                        # garbage frames
+        ]
+        for raw in cases:
+            await asyncio.wait_for(attempt(raw), 8)
+        for _ in range(3):
+            await attempt(PREFACE + bytes(rnd.randbytes(rnd.randint(9, 400))))
+        # a healthy client still gets served afterwards
+        client = H2Client("127.0.0.1", srv.port)
+        resp = await asyncio.wait_for(client.request("GET", "/"), 10)
+        assert resp.status == 200 and (await resp.read()) == b"ok"
+        await client.close()
+
+    try:
+        loop.run_until_complete(asyncio.wait_for(go(), 60))
+    finally:
+        loop.run_until_complete(srv.stop())
+        loop.close()
