@@ -288,7 +288,11 @@ class H2Connection:
         length = int.from_bytes(header[:3], "big")
         ftype, flags = header[3], header[4]
         sid = int.from_bytes(header[5:9], "big") & 0x7FFFFFFF
-        if length > 2 ** 24 - 1:
+        # we never raise SETTINGS_MAX_FRAME_SIZE, so the peer must stay
+        # within the default; enforcing it here (RFC 9113 §4.2) is also
+        # what stops a single 16 MiB HEADERS/DATA frame from being
+        # buffered wholesale — the frame-level DoS bound
+        if length > MAX_FRAME_SIZE_DEFAULT:
             raise H2Error(FRAME_SIZE_ERROR, "oversized frame")
         payload = await self.reader.readexactly(length) if length else b""
         return ftype, flags, sid, payload
@@ -555,6 +559,8 @@ class H2Server:
                 elif ftype == WINDOW_UPDATE:
                     conn.handle_window_update(sid, payload)
                 elif ftype == RST_STREAM:
+                    if len(payload) != 4:
+                        raise H2Error(FRAME_SIZE_ERROR, "bad RST_STREAM")
                     stream = conn.streams.get(sid)
                     if stream is not None:
                         stream.fail(struct.unpack(">I", payload)[0])
@@ -722,6 +728,8 @@ class H2Client:
                 elif ftype == WINDOW_UPDATE:
                     conn.handle_window_update(sid, payload)
                 elif ftype == RST_STREAM:
+                    if len(payload) != 4:
+                        raise H2Error(FRAME_SIZE_ERROR, "bad RST_STREAM")
                     stream = conn.streams.get(sid)
                     if stream is not None:
                         stream.fail(struct.unpack(">I", payload)[0])

@@ -20,7 +20,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from corrosion_tpu.runtime import jaxenv  # noqa: E402
 
-jaxenv.force_cpu_inprocess(n_devices=4)
+# argv[5] (optional) = local virtual devices per process; the 2-proc test
+# uses 4, the 4-proc variant 2 — same 8-device job, wider host axis
+N_LOCAL = int(sys.argv[5]) if len(sys.argv) > 5 else 4
+
+jaxenv.force_cpu_inprocess(n_devices=N_LOCAL)
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
@@ -34,7 +38,7 @@ def main() -> None:
     jax.distributed.initialize(
         coordinator_address=coord, num_processes=nprocs, process_id=pid
     )
-    assert len(jax.devices()) == 4 * nprocs, jax.devices()
+    assert len(jax.devices()) == N_LOCAL * nprocs, jax.devices()
 
     from corrosion_tpu.ops import swim
     from corrosion_tpu.parallel import (
@@ -44,9 +48,9 @@ def main() -> None:
     )
 
     mesh = multihost_member_mesh()
-    assert mesh.devices.shape == (nprocs, 4), mesh.devices.shape
+    assert mesh.devices.shape == (nprocs, N_LOCAL), mesh.devices.shape
 
-    params = swim.SwimParams(n=8 * 4 * nprocs)
+    params = swim.SwimParams(n=8 * N_LOCAL * nprocs)
     state = shard_member_state(
         swim.init_state(params, jax.random.PRNGKey(3)), mesh
     )

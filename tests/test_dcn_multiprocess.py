@@ -40,22 +40,22 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_mesh_parity():
+def _run_workers(nprocs: int, local_devices: int) -> list:
     coord = f"127.0.0.1:{_free_port()}"
-    env = jaxenv.stripped_env(n_devices=4)
-    # each worker builds its own 4-device CPU client; the coordinator
-    # handshake must happen before any backend init, which the worker
-    # script guarantees by initializing distributed first
+    env = jaxenv.stripped_env(n_devices=local_devices)
+    # each worker builds its own CPU client; the coordinator handshake
+    # must happen before any backend init, which the worker script
+    # guarantees by initializing distributed first
     procs = [
         subprocess.Popen(
-            [sys.executable, "-u", WORKER, coord, str(pid), "2",
-             str(N_TICKS)],
+            [sys.executable, "-u", WORKER, coord, str(pid), str(nprocs),
+             str(N_TICKS), str(local_devices)],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             text=True,
         )
-        for pid in range(2)
+        for pid in range(nprocs)
     ]
     outs = []
     for p in procs:
@@ -67,6 +67,11 @@ def test_two_process_mesh_parity():
             raise
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
         outs.append(json.loads(out.strip().splitlines()[-1]))
+    return outs
+
+
+def test_two_process_mesh_parity():
+    outs = _run_workers(nprocs=2, local_devices=4)
 
     # both workers observed the same replicated cluster state
     a, b = outs
@@ -74,7 +79,13 @@ def test_two_process_mesh_parity():
     assert a["stats"] == b["stats"]
 
     # ... and it matches the single-process flat-mesh computation
-    n_dev = 8
+    fp, stats = _flat_reference(n_dev=8)
+    assert a["fingerprint"] == fp
+    assert a["stats"] == stats
+
+
+def _flat_reference(n_dev: int):
+    """The single-process flat-mesh run every decomposition must match."""
     devices = jax.devices()[:n_dev]
     params = swim.SwimParams(n=8 * n_dev)
     mesh = member_mesh(devices)
@@ -88,5 +99,19 @@ def test_two_process_mesh_parity():
         state = tick(state, key)
     stats = {k: float(v) for k, v in swim.membership_stats(state).items()}
     fp = int(jnp.sum((state.view.astype(jnp.int32) * 92821) % 1000003))
-    assert a["fingerprint"] == fp
-    assert a["stats"] == stats
+    return fp, stats
+
+
+@pytest.mark.slow
+def test_four_process_mesh_parity():
+    """Wider host axis: 4 processes x 2 devices — the same 8-device,
+    64-member job as the 2x4 case, so the [hosts, members] layout must
+    reproduce the identical fingerprint across a different process
+    decomposition (mesh layout never changes protocol state)."""
+    outs = _run_workers(nprocs=4, local_devices=2)
+    fps = {o["fingerprint"] for o in outs}
+    assert len(fps) == 1
+    assert all(o["stats"] == outs[0]["stats"] for o in outs)
+    fp, stats = _flat_reference(n_dev=8)
+    assert outs[0]["fingerprint"] == fp
+    assert outs[0]["stats"] == stats

@@ -378,29 +378,43 @@ def test_h2_server_robust_to_malformed_input():
             + payload
         )
 
-    async def attempt(raw: bytes):
+    async def attempt(raw: bytes, expect_response: bool = False):
+        """expect_response: the server MUST answer (e.g. GOAWAY) or close
+        within the bound — a silent open connection is a regression."""
         try:
             reader, writer = await asyncio.open_connection("127.0.0.1", srv.port)
             writer.write(raw)
             await writer.drain()
-            # server either answers or closes; must not hang
-            await asyncio.wait_for(reader.read(65536), 5)
+            try:
+                await asyncio.wait_for(reader.read(65536), 5)
+            except asyncio.TimeoutError:
+                assert not expect_response, (
+                    f"server sat silent on {raw[:40]!r}…"
+                )
             writer.close()
-        except (ConnectionError, OSError, asyncio.TimeoutError):
+        except (ConnectionError, OSError):
             pass
 
     async def go():
-        cases = [
-            b"GET / HTTP/1.0\r\n\r\n",                      # not h2 at all
-            PREFACE[:10],                                   # truncated preface
+        # deterministic protocol violations after a full preface: the
+        # server must answer (GOAWAY / settings then close) — never hang
+        strict_cases = [
             PREFACE + frame(0x1, 0x4, 3, b"\xff\xff\xff"),  # bad hpack block
-            PREFACE + frame(0x0, 0x0, 0, b"data-on-zero"),  # DATA on stream 0
-            PREFACE + frame(0xEE, 0x0, 1, b"unknown"),      # unknown type
             PREFACE + frame(0x4, 0x0, 0, b"12345"),         # bad SETTINGS len
             PREFACE + frame(0x8, 0x0, 0, b"\x00\x00"),      # bad WINDOW_UPDATE
-            PREFACE + b"\xff" * 200,                        # garbage frames
+            PREFACE + frame(0x3, 0x0, 1, b"\x00"),          # bad RST len
+            PREFACE + b"\xff" * 200,                        # oversized frame hdr
         ]
-        for raw in cases:
+        # these legitimately wait for more input; bounded-close is enough
+        lenient_cases = [
+            b"GET / HTTP/1.0\r\n\r\n",                      # not h2 at all
+            PREFACE[:10],                                   # truncated preface
+            PREFACE + frame(0x0, 0x0, 0, b"data-on-zero"),  # DATA on stream 0
+            PREFACE + frame(0xEE, 0x0, 1, b"unknown"),      # unknown type
+        ]
+        for raw in strict_cases:
+            await asyncio.wait_for(attempt(raw, expect_response=True), 8)
+        for raw in lenient_cases:
             await asyncio.wait_for(attempt(raw), 8)
         for _ in range(3):
             await attempt(PREFACE + bytes(rnd.randbytes(rnd.randint(9, 400))))
