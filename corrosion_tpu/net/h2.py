@@ -358,6 +358,16 @@ class H2Connection:
                     if s.send_window > 0:
                         s.window_evt.set()
 
+    def handle_rst_stream(self, sid: int, payload: bytes) -> None:
+        """Validate + apply a peer RST_STREAM (RFC 9113 §6.4)."""
+        if len(payload) != 4:
+            raise H2Error(FRAME_SIZE_ERROR, "bad RST_STREAM")
+        if sid == 0:
+            raise H2Error(PROTOCOL_ERROR, "RST_STREAM on stream 0")
+        stream = self.streams.get(sid)
+        if stream is not None:
+            stream.fail(struct.unpack(">I", payload)[0])
+
     def handle_window_update(self, sid: int, payload: bytes) -> None:
         if len(payload) != 4:
             raise H2Error(FRAME_SIZE_ERROR, "bad WINDOW_UPDATE")
@@ -559,11 +569,7 @@ class H2Server:
                 elif ftype == WINDOW_UPDATE:
                     conn.handle_window_update(sid, payload)
                 elif ftype == RST_STREAM:
-                    if len(payload) != 4:
-                        raise H2Error(FRAME_SIZE_ERROR, "bad RST_STREAM")
-                    stream = conn.streams.get(sid)
-                    if stream is not None:
-                        stream.fail(struct.unpack(">I", payload)[0])
+                    conn.handle_rst_stream(sid, payload)
                     t = tasks.pop(sid, None)
                     if t is not None:
                         t.cancel()
@@ -728,11 +734,7 @@ class H2Client:
                 elif ftype == WINDOW_UPDATE:
                     conn.handle_window_update(sid, payload)
                 elif ftype == RST_STREAM:
-                    if len(payload) != 4:
-                        raise H2Error(FRAME_SIZE_ERROR, "bad RST_STREAM")
-                    stream = conn.streams.get(sid)
-                    if stream is not None:
-                        stream.fail(struct.unpack(">I", payload)[0])
+                    conn.handle_rst_stream(sid, payload)
                 elif ftype == PING:
                     if flags & FLAG_ACK:
                         evt = conn._ping_waiters.get(payload)

@@ -1,0 +1,128 @@
+"""Churn-detection parameter sweep for the partial-view kernel.
+
+Measures ticks-to-cluster-wide-detection (detected == 1.0, FP 0) after
+1% churn at a fixed n, across dissemination knobs: antientropy entries,
+piggyback buffer width, and max_transmissions. The winner must earn its
+keep in WALL time, not just tick count — wider message volume makes each
+tick more expensive — so both are recorded.
+
+Usage: python scripts/churn_sweep.py [n] [slots]   (defaults 8192 512)
+Writes CHURN_SWEEP.json (merge_records).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from corrosion_tpu.runtime import jaxenv  # noqa: E402
+
+# CPU-only parameter exploration: never touch the (possibly wedged)
+# tunnel backend — JAX_PLATFORMS=cpu alone still loads the axon plugin
+jaxenv.force_cpu_inprocess()
+jaxenv.enable_compilation_cache()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from corrosion_tpu.ops import swim_pview  # noqa: E402
+from corrosion_tpu.runtime.records import merge_records  # noqa: E402
+
+CHUNK = 10
+QUORUM = 8
+
+
+def run_config(label: str, n: int, slots: int, **overrides) -> dict:
+    params = swim_pview.PViewParams(
+        n=n, slots=slots, feeds_per_tick=8,
+        feed_entries=max(16, slots // 16), tie_epoch=512, **overrides
+    )
+    state = swim_pview.init_state(
+        params, jax.random.PRNGKey(0), seed_mode="fingers"
+    )
+    rng = jax.random.PRNGKey(1)
+
+    def advance(s, key):
+        return swim_pview.tick_n_donated(s, key, params, CHUNK)
+
+    # bootstrap to convergence
+    ticks = 0
+    converged = False
+    while ticks < 1500:
+        rng, key = jax.random.split(rng)
+        state = advance(state, key)
+        ticks += CHUNK
+        st = swim_pview.membership_stats(state, params)
+        converged = (
+            st["pv_coverage"] >= 0.99
+            and st["min_in_degree"] >= QUORUM
+            and st["false_positive"] == 0.0
+        )
+        if converged:
+            break
+    if not converged:
+        return {"label": label, "error": "no bootstrap convergence",
+                "boot_ticks": ticks}
+
+    # 1% churn -> detect-all
+    kill = np.random.default_rng(7).choice(n, size=n // 100, replace=False)
+    state = swim_pview.set_alive_many(state, kill, False)
+    t0 = time.monotonic()
+    det_ticks = 0
+    detected = False
+    while det_ticks < 3000:
+        rng, key = jax.random.split(rng)
+        state = advance(state, key)
+        det_ticks += CHUNK
+        st = swim_pview.membership_stats(state, params)
+        if st["false_positive"] > 0:
+            return {"label": label, "error": "false positive under churn",
+                    "stats": {k: round(v, 5) for k, v in st.items()}}
+        if st["detected"] >= 1.0:
+            detected = True
+            break
+    wall = time.monotonic() - t0
+    rec = {
+        "rung": f"{label}-{n}",
+        "label": label,
+        "n": n, "slots": slots,
+        "overrides": overrides,
+        "boot_ticks": ticks,
+        "detect_all_ticks": det_ticks if detected else None,
+        "churn_wall_s": round(wall, 1),
+        "s_per_tick": round(wall / max(1, det_ticks), 4),
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    slots = int(sys.argv[2]) if len(sys.argv) > 2 else 512
+    configs = [
+        ("baseline", {}),
+        ("ae8", {"antientropy": 8}),
+        ("pb16", {"piggyback": 16}),
+        ("mt20", {"max_transmissions": 20}),
+        ("pb16-mt20", {"piggyback": 16, "max_transmissions": 20}),
+        ("ae8-pb16-mt20", {"antientropy": 8, "piggyback": 16,
+                           "max_transmissions": 20}),
+    ]
+    out = []
+    for label, ov in configs:
+        print(f"--- {label} ---", flush=True)
+        out.append(run_config(label, n, slots, **ov))
+    for r in out:
+        r.setdefault("rung", f"{r['label']}-{n}")
+    merge_records(os.path.join(REPO, "CHURN_SWEEP.json"), out)
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

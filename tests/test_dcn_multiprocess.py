@@ -58,15 +58,17 @@ def _run_workers(nprocs: int, local_devices: int) -> list:
         for pid in range(nprocs)
     ]
     outs = []
-    for p in procs:
-        try:
+    try:
+        for p in procs:
             out, err = p.communicate(timeout=240)
-        except subprocess.TimeoutExpired:
-            for q in procs:
+            assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        # one worker failing must not leak siblings blocked in
+        # jax.distributed collectives for the rest of the pytest run
+        for q in procs:
+            if q.poll() is None:
                 q.kill()
-            raise
-        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
-        outs.append(json.loads(out.strip().splitlines()[-1]))
     return outs
 
 

@@ -1,0 +1,91 @@
+"""Raw-socket fake peer against a live agent — wire-level parity proof.
+
+The reference tests broadcast ordering with a raw quinn endpoint acting
+as a fake peer (`broadcast/mod.rs:1104-1199`): bytes assembled outside
+the agent stack, pushed at a real gossip listener, asserted to land in
+SQLite. Mirrored here: a plain TCP socket (no framework client code on
+the sending side beyond the byte codec itself) opens the uni lane to a
+real agent's gossip port and pushes a speedy-layout BroadcastV1::Change;
+the row must appear in the agent's database via the full ingestion path
+(handle_changes → bookkeeping → CRDT apply), and the foreign actor must
+be booked.
+"""
+
+import asyncio
+import struct
+
+from corrosion_tpu.devcluster import DevCluster, Topology
+from corrosion_tpu.types.actor import ActorId
+from corrosion_tpu.types.base import Timestamp
+from corrosion_tpu.types.change import Change
+from corrosion_tpu.types.codec import (
+    ChangesetFull,
+    ChangeV1,
+    ClusterId,
+    encode_uni_payload,
+)
+from corrosion_tpu.types.pack import pack_columns
+
+from tests.test_agent import TEST_SCHEMA, wait_until
+
+FOREIGN = b"\x5a" * 16  # an actor the agent has never heard of
+
+
+def _wire_change(version: int, row_id: int, text: str) -> bytes:
+    cv = ChangeV1(
+        actor_id=ActorId(FOREIGN),
+        changeset=ChangesetFull(
+            version=version,
+            changes=(
+                Change(
+                    table="tests",
+                    pk=pack_columns([row_id]),
+                    cid="text",
+                    val=text,
+                    col_version=1,
+                    db_version=version,
+                    seq=0,
+                    site_id=FOREIGN,
+                    cl=1,
+                    ts=Timestamp(42),
+                ),
+            ),
+            seqs=(0, 0),
+            last_seq=0,
+            ts=Timestamp(42),
+        ),
+    )
+    return encode_uni_payload(cv, ClusterId(0))
+
+
+def test_raw_socket_peer_change_lands_in_sqlite():
+    async def main():
+        cluster = DevCluster(Topology.parse("a -> a"), schema_sql=TEST_SCHEMA)
+        # single node: "a -> a" gives node a with no foreign bootstrap
+        await cluster.start()
+        agent = cluster.agents["a"]
+        try:
+            host, port = agent.actor.addr.rsplit(":", 1)
+            reader, writer = await asyncio.open_connection(host, int(port))
+            payload = _wire_change(1, 7, "from-the-wire")
+            # uni lane: lane byte then u32-BE length-delimited frame
+            writer.write(b"U" + struct.pack(">I", len(payload)) + payload)
+            await writer.drain()
+
+            def row_present() -> bool:
+                with agent.store.pooled_read() as conn:
+                    rows = conn.execute(
+                        "SELECT text FROM tests WHERE id = 7"
+                    ).fetchall()
+                return bool(rows) and rows[0][0] == "from-the-wire"
+
+            assert await wait_until(row_present, timeout=15.0)
+            # the foreign actor is booked with its version applied
+            booked = agent.bookie.ensure(ActorId(FOREIGN))
+            with booked.read() as bv:
+                assert bv.contains_version(1)
+            writer.close()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(main())
