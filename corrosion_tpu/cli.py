@@ -72,9 +72,12 @@ def _build_parser() -> argparse.ArgumentParser:
     q.add_argument("--columns", action="store_true")
     q.add_argument("--timer", action="store_true")
     q.add_argument("--param", action="append", default=[])
+    # server-side statement interrupt in seconds (main.rs:672 Query.timeout)
+    q.add_argument("--timeout", type=float, default=None)
 
     e = sub.add_parser("exec")
     e.add_argument("sql", nargs="+")
+    e.add_argument("--timeout", type=float, default=None)
 
     sub.add_parser("reload", help="re-apply schema files")
 
@@ -249,10 +252,22 @@ async def _cmd_agent(cfg: Config) -> int:
     return 0
 
 
+def _client_error_text(body) -> str:
+    """Dig the sqlite/API error line out of a ClientError body (the 400
+    shape is {"results": [{"error": ...}], ...})."""
+    if isinstance(body, dict):
+        for r in body.get("results") or []:
+            if isinstance(r, dict) and "error" in r:
+                return str(r["error"])
+        if "error" in body:
+            return str(body["error"])
+    return str(body)
+
+
 async def _cmd_query(cfg: Config, args) -> int:
     import time as _time
 
-    from corrosion_tpu.client import CorrosionApiClient
+    from corrosion_tpu.client import ClientError, CorrosionApiClient
 
     stmt: object = (
         [args.sql, list(args.param)] if args.param else args.sql
@@ -261,15 +276,21 @@ async def _cmd_query(cfg: Config, args) -> int:
     async with CorrosionApiClient(
         _api_addr(cfg), token=cfg.api.authz_bearer
     ) as c:
-        async for ev in c.query(stmt):
-            if "columns" in ev and args.columns:
-                print("|".join(ev["columns"]))
-            elif "row" in ev:
-                _rowid, vals = ev["row"]
-                print("|".join(_render(v) for v in vals))
-            elif "error" in ev:
-                print(f"error: {ev['error']}", file=sys.stderr)
-                return 1
+        try:
+            async for ev in c.query(stmt, timeout=args.timeout):
+                if "columns" in ev and args.columns:
+                    print("|".join(ev["columns"]))
+                elif "row" in ev:
+                    _rowid, vals = ev["row"]
+                    print("|".join(_render(v) for v in vals))
+                elif "error" in ev:
+                    print(f"error: {ev['error']}", file=sys.stderr)
+                    return 1
+        except ClientError as e:
+            # HTTP-level failure before the stream starts (401, parse
+            # 400, …): same clean error line as the exec path
+            print(f"error: {_client_error_text(e.body)}", file=sys.stderr)
+            return 1
     if args.timer:
         print(f"time: {_time.monotonic() - t0:.6f}s", file=sys.stderr)
     return 0
@@ -282,12 +303,18 @@ def _render(v) -> str:
 
 
 async def _cmd_exec(cfg: Config, args) -> int:
-    from corrosion_tpu.client import CorrosionApiClient
+    from corrosion_tpu.client import ClientError, CorrosionApiClient
 
     async with CorrosionApiClient(
         _api_addr(cfg), token=cfg.api.authz_bearer
     ) as c:
-        resp = await c.execute(list(args.sql))
+        try:
+            resp = await c.execute(list(args.sql), timeout=args.timeout)
+        except ClientError as e:
+            # e.g. a --timeout interrupt comes back as HTTP 400 with the
+            # sqlite error in the body — print it, don't traceback
+            print(f"error: {_client_error_text(e.body)}", file=sys.stderr)
+            return 1
     print(json.dumps(resp, indent=2))
     return 0 if "results" in resp else 1
 

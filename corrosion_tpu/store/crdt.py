@@ -139,6 +139,26 @@ def _corro_json_contains(selector, obj) -> bool:
         raise sqlite3.OperationalError("corro_json_contains: invalid JSON")
 
 
+def _safe_rollback(conn: sqlite3.Connection) -> None:
+    """Best-effort ROLLBACK for exception paths on the write conn.
+
+    An interrupted statement (interrupt_after watchdog / ?timeout=) has
+    already rolled the transaction back; a bare ROLLBACK then raises
+    'cannot rollback - no transaction is active' and REPLACES the real
+    error mid-unwind. Guard on in_transaction and swallow the benign
+    race where the interrupt lands between check and rollback."""
+    try:
+        if conn.in_transaction:
+            conn.execute("ROLLBACK")
+    except sqlite3.OperationalError as e:
+        if conn.in_transaction:
+            # a REAL rollback failure (e.g. I/O error): the tx is still
+            # open — surfacing beats a mystery 'cannot start a
+            # transaction within a transaction' on the next writer
+            raise
+        log.debug("rollback raced with auto-rollback: %s", e)
+
+
 def _clock_table(t: str) -> str:
     return f"{t}__crdt_clock"
 
@@ -576,7 +596,7 @@ class CrdtStore:
                         )
                 self._conn.execute("COMMIT")
             except BaseException:
-                self._conn.execute("ROLLBACK")
+                _safe_rollback(self._conn)
                 raise
         self.schema = new_schema
         return new_schema
@@ -895,8 +915,25 @@ class CrdtStore:
                 self._conn.execute("UPDATE __crdt_ctx SET capture = 1 WHERE id = 1")
                 self._conn.execute("COMMIT")
             except BaseException:
-                self._conn.execute("ROLLBACK")
-                self._conn.execute("UPDATE __crdt_ctx SET capture = 1 WHERE id = 1")
+                _safe_rollback(self._conn)
+                # the watchdog can still be armed here: an interrupt
+                # landing on THIS statement must not leave capture=0 on
+                # the persistent write conn (every later local write
+                # would silently skip CRDT capture). The interrupt flag
+                # is momentary — one retry suffices; failure is loud.
+                for attempt in (0, 1):
+                    try:
+                        self._conn.execute(
+                            "UPDATE __crdt_ctx SET capture = 1 WHERE id = 1"
+                        )
+                        break
+                    except sqlite3.OperationalError:
+                        if attempt:
+                            log.critical(
+                                "could not restore CRDT capture flag; "
+                                "local writes will not replicate"
+                            )
+                            raise
                 raise
         return AppliedChanges(impactful, changed_tables)
 
@@ -1553,7 +1590,7 @@ class CrdtStore:
                     )
                 self._conn.execute("COMMIT")
             except BaseException:
-                self._conn.execute("ROLLBACK")
+                _safe_rollback(self._conn)
                 raise
 
     def take_buffered_version(
@@ -1691,7 +1728,7 @@ class CrdtStore:
                     )
                 self._conn.execute("COMMIT")
             except BaseException:
-                self._conn.execute("ROLLBACK")
+                _safe_rollback(self._conn)
                 raise
 
     def member_state_rows(self) -> List[str]:
@@ -1785,13 +1822,13 @@ class WriteTx:
                 return changes, db_version, changes[-1].seq
             return [], 0, 0
         except BaseException:
-            conn.execute("ROLLBACK")
+            _safe_rollback(conn)
             self._done = True
             raise
 
     def rollback(self) -> None:
         if not self._done:
-            self.conn.execute("ROLLBACK")
+            _safe_rollback(self.conn)
             self._done = True
 
     def __exit__(self, exc_type, exc, tb) -> bool:

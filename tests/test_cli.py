@@ -136,6 +136,28 @@ def test_exec_and_query(live_agent):
     assert r.returncode == 0, r.stderr
     assert r.stdout.splitlines() == ["text", "hello"]
 
+    # --timeout threads through to the server-side statement interrupt
+    # (main.rs:672 Query.timeout); an overrunning query exits 1 with the
+    # interrupt error instead of running to completion
+    slow = (
+        "WITH RECURSIVE c(x) AS "
+        "(SELECT 1 UNION ALL SELECT x+1 FROM c WHERE x < 300000000) "
+        "SELECT count(*) FROM c"
+    )
+    r = run_cli(["-c", cfg, "query", slow, "--timeout", "0.3"])
+    assert r.returncode == 1
+    assert "interrupt" in r.stderr.lower()
+
+    # exec --timeout: the interrupted write surfaces as a clean error
+    # line (HTTP 400 -> exit 1), never a traceback
+    r = run_cli(
+        ["-c", cfg, "exec", f"INSERT INTO tests (id, text) {slow.replace('SELECT count(*)', 'SELECT 99, count(*)')}",
+         "--timeout", "0.3"]
+    )
+    assert r.returncode == 1
+    assert "interrupt" in r.stderr.lower()
+    assert "Traceback" not in r.stderr
+
 
 def test_admin_over_cli(live_agent):
     cfg = live_agent["cfg"]
