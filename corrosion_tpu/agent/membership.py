@@ -411,13 +411,7 @@ class Membership:
             if m.actor.id != joiner.id
         ]
         self.rng.shuffle(sample)
-        budget = MAX_PACKET - 64 - actor_wire_size(self.identity)
-        for u in sample:
-            size = update_wire_size(u)
-            if budget - size < 0:
-                break
-            feed.updates.append(u)
-            budget -= size
+        fill_updates(feed, sample)
         await self.transport.send_datagram(joiner.addr, encode_swim(feed))
 
     def _on_ack(self, probe_no: int, from_actor: Actor) -> None:

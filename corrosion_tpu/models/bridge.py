@@ -1,0 +1,252 @@
+"""Kernel-peer bridge: batched-kernel members as virtual SWIM peers.
+
+SURVEY §2.6's TPU-native equivalence says the `Transport` seam lets "a
+tpu-sim transport implement delivery as gather/scatter into per-member
+inboxes" while real agents keep speaking the wire protocol. This module
+makes that literal: a `KernelPeerBridge` registers every member of a
+batched-kernel cluster (`models/cluster.ClusterSim` over `ops/swim.py`)
+as a virtual peer address on a `MemNetwork`, and answers real agents'
+SWIM datagrams (`net/gossip_codec.py`) straight from the kernel's array
+state:
+
+- PING → ACK iff the kernel's ground-truth `alive[j]` says so — a
+  crashed simulated member goes silent exactly like a crashed process,
+  so the REAL agent's own probe/suspicion pipeline detects it;
+- ANNOUNCE → FEED with a packet-budgeted sample of virtual members
+  (the reference's join snapshot, `broadcast/mod.rs` announce path);
+- every reply piggybacks a random sample of virtual-member updates, so
+  a real agent's member table epidemically absorbs a 10^3–10^5-member
+  simulated population through nothing but the normal SWIM channel;
+- PING_REQ / INDIRECT_PING for virtual targets are answered through the
+  same lookup (the indirect-probe path works against simulated peers).
+
+The kernel side needs no per-packet device work: replies are served from
+a host snapshot of the ground-truth arrays (`refresh()` re-pulls after
+`sim.step()` / crashes — two [N] transfers), which is what keeps one
+bridge cheap enough to front hundreds of thousands of simulated members.
+
+Membership is one-directional by design: real agents track the simulated
+population; the fixed-shape kernel does not grow rows for real agents
+(dynamic membership of the array world is `init_state`-time — see
+`ops/swim.py`). That is the devcluster use case: scale the OBSERVED
+cluster far past what real processes could provide
+(`klukai-devcluster/src/main.rs:107-232` lineage).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from corrosion_tpu.net.gossip_codec import (
+    MemberState,
+    MemberUpdate,
+    MsgKind,
+    SwimMessage,
+    decode_swim,
+    encode_swim,
+    fill_updates,
+)
+from corrosion_tpu.net.mem import MemNetwork
+from corrosion_tpu.types.actor import Actor, ActorId, ClusterId
+from corrosion_tpu.types.base import Timestamp
+
+
+def sim_actor_id(j: int) -> ActorId:
+    """Deterministic 16-byte id for virtual member j."""
+    return ActorId(b"SIM" + j.to_bytes(13, "big"))
+
+
+class KernelPeerBridge:
+    """Registers kernel members as `sim:<j>` peers on a MemNetwork."""
+
+    def __init__(
+        self,
+        net: MemNetwork,
+        sim,
+        cluster_id: int = 0,
+        piggyback: int = 24,
+        addr_prefix: str = "sim",
+        seed: int = 0,
+        gossip_down: bool = True,
+    ):
+        # gossip_down=False keeps the bridge silent about dead members
+        # (like peers that haven't detected yet): the real agent must
+        # then find them with its OWN probe/suspicion pipeline
+        self.net = net
+        self.sim = sim
+        self.gossip_down = gossip_down
+        self.n = sim.params.n
+        self.cluster_id = ClusterId(cluster_id)
+        self.piggyback = piggyback
+        self.prefix = addr_prefix
+        self._rng = np.random.default_rng(seed)
+        self._alive = np.ones(self.n, dtype=bool)
+        self._inc = np.zeros(self.n, dtype=np.int32)
+        self._listeners: List = []
+        self._actors: Dict[int, Actor] = {}
+        self.refresh()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Register every virtual member's address on the network."""
+        for j in range(self.n):
+            listener = self.net.listener(self.addr(j))
+
+            async def on_datagram(src: str, data: bytes, j=j) -> None:
+                await self._handle(j, src, data)
+
+            async def on_uni(src: str, data: bytes) -> None:
+                pass  # virtual members don't ingest broadcasts
+
+            async def on_bi(stream) -> None:
+                stream.close()
+
+            listener.serve(on_datagram, on_uni, on_bi)
+            self._listeners.append(listener)
+
+    async def stop(self) -> None:
+        for listener in self._listeners:
+            await listener.close()
+        self._listeners.clear()
+
+    def refresh(self) -> None:
+        """Re-snapshot ground truth from the kernel arrays (call after
+        sim.step() / crash / restart)."""
+        state = self.sim.state
+        self._alive = np.asarray(state.alive).astype(bool)
+        self._inc = np.asarray(state.inc, dtype=np.int32)
+
+    def crash(self, j: int) -> None:
+        self.sim.crash(j)
+        self.refresh()
+
+    def restart(self, j: int) -> None:
+        self.sim.restart(j)
+        self.refresh()
+
+    # -- identity ----------------------------------------------------------
+
+    def addr(self, j: int) -> str:
+        return f"{self.prefix}:{j}"
+
+    def actor(self, j: int) -> Actor:
+        a = self._actors.get(j)
+        if a is None:
+            a = Actor(
+                id=sim_actor_id(j),
+                addr=self.addr(j),
+                ts=Timestamp(0),
+                cluster_id=self.cluster_id,
+                bump=0,
+            )
+            self._actors[j] = a
+        return a
+
+    # -- wire handling -------------------------------------------------------
+
+    def _sample_updates(self, exclude: int) -> List[MemberUpdate]:
+        """Random piggyback sample of virtual members (size-capped by
+        fill_updates at send time)."""
+        out: List[MemberUpdate] = []
+        count = min(self.piggyback * 2, self.n)
+        for j in self._rng.choice(self.n, size=count, replace=False):
+            j = int(j)
+            if j == exclude:
+                continue
+            if not self._alive[j] and not self.gossip_down:
+                continue
+            out.append(
+                MemberUpdate(
+                    self.actor(j),
+                    int(self._inc[j]),
+                    MemberState.ALIVE if self._alive[j] else MemberState.DOWN,
+                )
+            )
+            if len(out) >= self.piggyback:
+                break
+        return out
+
+    async def _reply(self, j: int, dst: str, msg: SwimMessage) -> None:
+        # exact packet budgeting (incl. target/origin actors) is shared
+        # with the agent's announce path: gossip_codec.fill_updates
+        fill_updates(msg, self._sample_updates(j))
+        transport = self.net.transport(self.addr(j))
+        await transport.send_datagram(dst, encode_swim(msg))
+
+    async def _handle(self, j: int, src: str, data: bytes) -> None:
+        if not self._alive[j]:
+            return  # crashed members are silent
+        try:
+            msg = decode_swim(data)
+        except (ValueError, struct.error):
+            return
+        me = self.actor(j)
+        k = msg.kind
+        if k == MsgKind.PING:
+            await self._reply(
+                j, msg.sender.addr,
+                SwimMessage(MsgKind.ACK, msg.probe_no, me),
+            )
+        elif k == MsgKind.ANNOUNCE:
+            await self._reply(
+                j, msg.sender.addr,
+                SwimMessage(MsgKind.FEED, 0, me),
+            )
+        elif k == MsgKind.PING_REQ and msg.target is not None:
+            # asked to indirect-probe `target` for `sender`: if the target
+            # is one of ours, answer from the arrays; else forward a real
+            # INDIRECT_PING so mixed topologies keep working
+            tj = self._index_of(msg.target.addr)
+            if tj is not None:
+                if self._alive[tj]:
+                    await self._reply(
+                        j, msg.sender.addr,
+                        SwimMessage(
+                            MsgKind.FORWARDED_ACK, msg.probe_no,
+                            self.actor(tj), origin=msg.sender,
+                        ),
+                    )
+            else:
+                await self._reply(
+                    j, msg.target.addr,
+                    SwimMessage(
+                        MsgKind.INDIRECT_PING, msg.probe_no, me,
+                        target=msg.target, origin=msg.sender,
+                    ),
+                )
+        elif k == MsgKind.INDIRECT_PING and msg.origin is not None:
+            await self._reply(
+                j, msg.sender.addr,
+                SwimMessage(
+                    MsgKind.INDIRECT_ACK, msg.probe_no, me,
+                    origin=msg.origin,
+                ),
+            )
+        elif k == MsgKind.INDIRECT_ACK and msg.origin is not None:
+            # the relay leg back: a REAL target we indirect-probed on a
+            # real agent's behalf answered — forward like membership.py's
+            # helper path does (membership.py:384-393), else the origin
+            # falsely suspects a live peer
+            await self._reply(
+                j, msg.origin.addr,
+                SwimMessage(
+                    MsgKind.FORWARDED_ACK, msg.probe_no, me,
+                    target=msg.sender,
+                ),
+            )
+        # ACK / FEED / LEAVE / FORWARDED_ACK aimed at a virtual member
+        # need no reaction: the kernel's own protocol state advances in
+        # sim.step(), not per packet
+
+    def _index_of(self, addr: str) -> Optional[int]:
+        if not addr.startswith(self.prefix + ":"):
+            return None
+        try:
+            j = int(addr.rsplit(":", 1)[1])
+        except ValueError:
+            return None
+        return j if 0 <= j < self.n else None

@@ -84,6 +84,20 @@ def update_wire_size(u: MemberUpdate) -> int:
     return actor_wire_size(u.actor) + 4 + 1
 
 
+def fill_updates(msg: SwimMessage, sample) -> None:
+    """Append piggybacked updates from `sample` while the ENCODED packet
+    stays under MAX_PACKET. Budgeting off the actual encoded size keeps
+    the arithmetic exact for every message shape (target/origin actors
+    included) and in one audited place."""
+    budget = MAX_PACKET - len(encode_swim(msg)) - 8
+    for u in sample:
+        size = update_wire_size(u)
+        if budget - size < 0:
+            break
+        msg.updates.append(u)
+        budget -= size
+
+
 def encode_swim(msg: SwimMessage) -> bytes:
     w = Writer()
     w.u8(int(msg.kind))

@@ -6,7 +6,9 @@ kernel from the loop entirely: a `MemNetwork` routes datagrams/streams
 between registered nodes with optional per-link latency, loss and
 partitions — the fault-injection surface the reference delegates to
 Antithesis. The same network object is the seam where TPU-simulated member
-blocks (corrosion_tpu.models.cluster) can be bridged in as virtual peers.
+blocks (corrosion_tpu.models.cluster) ARE bridged in as virtual peers:
+`models/bridge.KernelPeerBridge` (tests/test_bridge.py runs a real agent
+against a kernel-simulated population end-to-end).
 """
 
 from __future__ import annotations

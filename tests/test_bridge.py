@@ -1,0 +1,82 @@
+"""Real agent ↔ kernel-peer bridge: the §2.6 seam end-to-end.
+
+A full event-driven agent (`agent/membership.py`, the production SWIM
+path) gossips over a MemNetwork with a population that exists only as
+the batched kernel's arrays (`ops/swim.py` via `models/cluster.py`,
+fronted by `models/bridge.KernelPeerBridge`). The agent must:
+
+- absorb the whole simulated population through normal SWIM channels
+  (FEED on announce + piggyback on ACKs),
+- detect kernel-side crashes with its OWN probe/suspicion pipeline —
+  crashed virtual members simply go silent, like crashed processes.
+"""
+
+import asyncio
+
+from corrosion_tpu.models.bridge import KernelPeerBridge, sim_actor_id
+from corrosion_tpu.models.cluster import ClusterSim
+from corrosion_tpu.net.gossip_codec import MemberState
+from corrosion_tpu.net.mem import MemNetwork
+
+from tests.test_agent import boot, wait_until
+
+N_SIM = 192
+
+
+def test_agent_absorbs_kernel_population_and_detects_crashes():
+    async def main():
+        net = MemNetwork(seed=11)
+        sim = ClusterSim(N_SIM, seed=3)
+        # gossip_down=False: crashed virtual members are only SILENT —
+        # the agent has to detect them with its own probe pipeline
+        bridge = KernelPeerBridge(net, sim, seed=5, gossip_down=False)
+        bridge.start()
+
+        agent = await boot(net, "agent-real")
+        ms = agent.membership
+        try:
+            # join via one virtual member; the FEED + ACK piggyback
+            # epidemic must teach the agent the whole population
+            await ms.announce(bridge.addr(0))
+            assert await wait_until(
+                lambda: ms.cluster_size >= N_SIM + 1, timeout=60.0
+            ), f"only {ms.cluster_size} of {N_SIM + 1} members learned"
+
+            # the kernel keeps running underneath
+            sim.step(5)
+            bridge.refresh()
+
+            # crash three simulated members: silence, not notification
+            dead = [7, 63, 150]
+            for j in dead:
+                bridge.crash(j)
+
+            dead_ids = {sim_actor_id(j) for j in dead}
+
+            def all_detected() -> bool:
+                # the agent's own pipeline ends in eviction: DOWN members
+                # move from `members` into `downed`
+                return all(
+                    i in ms.downed
+                    or (
+                        i in ms.members
+                        and ms.members[i].state == MemberState.SUSPECT
+                    )
+                    for i in dead_ids
+                )
+
+            assert await wait_until(all_detected, timeout=60.0)
+            # ... and fully evicted shortly after suspicion expires
+            assert await wait_until(
+                lambda: dead_ids <= set(ms.downed), timeout=60.0
+            )
+
+            # zero false positives: nothing else was downed
+            assert set(ms.downed) == dead_ids
+        finally:
+            from corrosion_tpu.agent.run import shutdown
+
+            await shutdown(agent)
+            await bridge.stop()
+
+    asyncio.run(main())
