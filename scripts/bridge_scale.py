@@ -1,0 +1,95 @@
+"""Bridge scale rung: ONE real agent tracking a kernel-simulated
+population via normal SWIM channels (models/bridge.py).
+
+The devcluster lineage (`klukai-devcluster/src/main.rs:107-232`) tops
+out at a handful of real processes; the kernel-peer bridge replaces the
+population with array state, so a single real agent exercises its
+production membership pipeline against thousands of peers. Records
+absorption time (announce → full member table) and silent-crash
+detection latency at the configured scale into BRIDGE_SCALE.json.
+
+Usage: python scripts/bridge_scale.py [n_sim] [n_crash]   (default 10000 20)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from corrosion_tpu.runtime import jaxenv  # noqa: E402
+
+jaxenv.force_cpu_inprocess()
+jaxenv.enable_compilation_cache()
+
+from corrosion_tpu.models.bridge import KernelPeerBridge, sim_actor_id  # noqa: E402
+from corrosion_tpu.models.cluster import ClusterSim  # noqa: E402
+from corrosion_tpu.net.mem import MemNetwork  # noqa: E402
+from corrosion_tpu.runtime.records import merge_records  # noqa: E402
+
+from tests.test_agent import boot, wait_until  # noqa: E402
+
+
+async def main(n_sim: int, n_crash: int) -> dict:
+    net = MemNetwork(seed=11)
+    sim = ClusterSim(n_sim, seed=3)
+    bridge = KernelPeerBridge(net, sim, seed=5, gossip_down=False)
+    bridge.start()
+    agent = await boot(net, "agent-real")
+    ms = agent.membership
+    try:
+        t0 = time.monotonic()
+        await ms.announce(bridge.addr(0))
+        absorbed = await wait_until(
+            lambda: ms.cluster_size >= n_sim + 1, timeout=600.0, step=0.25
+        )
+        absorb_s = time.monotonic() - t0
+        print(f"absorbed={absorbed} size={ms.cluster_size} "
+              f"in {absorb_s:.1f}s", flush=True)
+
+        dead = list(range(0, n_sim, max(1, n_sim // n_crash)))[:n_crash]
+        dead_ids = {sim_actor_id(j) for j in dead}
+        for j in dead:
+            bridge.crash(j)
+        # one real prober sweeps the ring at ~probe_period per member:
+        # worst-case detection of the LAST crash ≈ a full cycle + the
+        # suspicion window — give it two cycles of headroom
+        detect_budget = max(600.0, n_sim * 0.05 * 2 + 120.0)
+        t0 = time.monotonic()
+        detected = await wait_until(
+            lambda: dead_ids <= set(ms.downed), timeout=detect_budget,
+            step=0.25,
+        )
+        detect_s = time.monotonic() - t0
+        fp = sorted(str(i) for i in set(ms.downed) - dead_ids)
+        print(f"detected={detected} in {detect_s:.1f}s fp={len(fp)}",
+              flush=True)
+        return {
+            "rung": f"bridge-{n_sim}",
+            "n_sim": n_sim,
+            "n_crash": len(dead),
+            "absorbed": absorbed,
+            "absorb_s": round(absorb_s, 1),
+            "detected": detected,
+            "detect_all_s": round(detect_s, 1),
+            "false_positives": len(fp),
+            "cluster_size": ms.cluster_size,
+        }
+    finally:
+        from corrosion_tpu.agent.run import shutdown
+
+        await shutdown(agent)
+        await bridge.stop()
+
+
+if __name__ == "__main__":
+    n_sim = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
+    n_crash = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    rec = asyncio.run(main(n_sim, n_crash))
+    merge_records(os.path.join(REPO, "BRIDGE_SCALE.json"), [rec])
+    print(json.dumps(rec))
