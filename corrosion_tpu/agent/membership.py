@@ -41,6 +41,7 @@ from corrosion_tpu.net.gossip_codec import (
     actor_wire_size,
     decode_swim,
     encode_swim,
+    fill_updates,
     update_wire_size,
 )
 from corrosion_tpu.net.transport import Transport, TransportError

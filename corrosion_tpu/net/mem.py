@@ -14,6 +14,7 @@ against a kernel-simulated population end-to-end).
 from __future__ import annotations
 
 import asyncio
+import logging
 import random
 import time
 from dataclasses import dataclass, field
@@ -29,7 +30,23 @@ from corrosion_tpu.net.transport import (
     UniHandler,
 )
 
+log = logging.getLogger(__name__)
+
 MAX_DATAGRAM = 1452  # quinn datagram ceiling on typical MTU
+
+
+def _spawn_logged(coro, what: str, src: str, dst: str) -> None:
+    """Detached handler delivery that is LOUD on failure: a silent 'Task
+    exception was never retrieved' once hid a broken FEED path as a 4x
+    convergence slowdown. Shared by all three lanes."""
+
+    async def run():
+        try:
+            await coro
+        except Exception:  # noqa: BLE001
+            log.exception("%s handler failed (%s -> %s)", what, src, dst)
+
+    asyncio.ensure_future(run())
 
 
 @dataclass
@@ -174,7 +191,7 @@ class MemTransport(Transport):
 
         # detached delivery like real UDP: the sender never blocks on the
         # receiver's handler (RTT is observed by the SWIM ack path instead)
-        asyncio.ensure_future(deliver())
+        _spawn_logged(deliver(), "datagram", self._src, addr)
 
     async def send_uni(self, addr: str, payload: bytes) -> None:
         net = self._net
@@ -184,7 +201,7 @@ class MemTransport(Transport):
         start = time.monotonic()
         await net._delay()
         # deliver as an independent task, like a uni-stream read loop
-        asyncio.ensure_future(node.on_uni(self._src, payload))
+        _spawn_logged(node.on_uni(self._src, payload), "uni", self._src, addr)
         self.observe_rtt(addr, 2 * (time.monotonic() - start))
 
     async def open_bi(self, addr: str) -> BiStream:
@@ -196,5 +213,5 @@ class MemTransport(Transport):
         remote = _MemBiStream(self._src, net)
         local.other, remote.other = remote, local
         await net._delay()
-        asyncio.ensure_future(node.on_bi(remote))
+        _spawn_logged(node.on_bi(remote), "bi", self._src, addr)
         return local

@@ -1,4 +1,4 @@
-"""Compact binary primary-key encoding, byte-compatible with cr-sqlite.
+"""Compact binary primary-key encoding in the cr-sqlite wire format.
 
 Format (reference `klukai-types/src/pubsub.rs:2257-2410`):
     [num_columns:u8, ...per value: (intlen<<3 | type):u8,
@@ -7,6 +7,20 @@ Format (reference `klukai-types/src/pubsub.rs:2257-2410`):
 Floats are always 8 big-endian IEEE bytes with intlen 0. NULL has no payload.
 Type tags are the ColumnType values in `values.py` (Integer=1, Float=2,
 Text=3, Blob=4, Null=5).
+
+Compatibility contract: the DECODER reads any reference-encoded bytes to
+exactly the values the reference itself would read (including its
+sign-extension of 1-byte 0x80..0xFF). The ENCODER deviates on one point:
+positive values whose top encoded bit would be set get one extra byte
+(see `_num_bytes_needed`), because the reference's unsigned-width encode
+plus sign-extending decode never round-trips such values — upstream,
+integer pks in 128..255 (each sign-boundary band) and 128..255-byte
+text/blob pks are silently dropped by the subscription matcher. The
+consequence: OUR packed bytes for those values differ from the
+reference's, and since packed pk bytes are the CRDT row identity, a
+mixed old/new-encoder cluster would see such rows as distinct. All nodes
+of a cluster must run the same encoder (wire-level interop with
+reference nodes already requires QUIC, which this build does not speak).
 """
 
 from __future__ import annotations
@@ -26,13 +40,23 @@ from corrosion_tpu.types.values import (
 
 
 def _num_bytes_needed(val: int) -> int:
-    """Bytes needed for a big-endian signed int, matching the reference's
-    byte-mask probing (pubsub.rs:2315-2340). Note the reference checks raw
-    byte occupancy of the two's-complement u64 pattern, so negatives always
-    take 8 bytes and 0 takes 0 bytes."""
+    """Bytes for a big-endian signed int — the reference's byte-mask
+    probing (pubsub.rs:2315-2340: negatives always take 8 bytes, 0 takes
+    0 bytes) PLUS one audited deviation: a positive value whose top
+    encoded bit would be set gets one extra byte. The reference's
+    encoder/decoder pair is asymmetric there — `put_int(128, 1)` emits
+    0x80 which sign-extending `get_int` reads back as -128 — so integer
+    pks in 128..255 (and each higher sign-boundary band) and text/blob
+    pks 128..255 bytes long do not round-trip upstream (their matcher
+    temp-table path drops such rows). Widening the encode keeps every
+    value bijective while the decoder stays bug-compatible: any byte
+    string a reference node could emit still decodes to exactly what the
+    reference itself would decode."""
     u = val & 0xFFFFFFFFFFFFFFFF
     for n in range(8, 0, -1):
         if u >> ((n - 1) * 8) & 0xFF:
+            if val > 0 and n < 8 and (u >> ((n - 1) * 8)) & 0x80:
+                return n + 1  # top bit would sign-flip on decode
             return n
     return 0
 

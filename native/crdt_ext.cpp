@@ -35,11 +35,17 @@ constexpr uint8_t TYPE_NULL = 5;
 
 // Bytes occupied by the two's-complement u64 pattern, matching the
 // reference's byte-mask probing (pubsub.rs:2315-2340): negatives take 8,
-// zero takes 0.
+// zero takes 0 — plus the sign-boundary widening deviation (see
+// types/pack.py _num_bytes_needed): a positive value whose top encoded
+// bit would be set gets one extra byte so sign-extending decode
+// round-trips (the reference drops 128..255-band integer/length pks).
 int num_bytes_needed(int64_t val) {
   uint64_t u = static_cast<uint64_t>(val);
   for (int n = 8; n >= 1; --n) {
-    if ((u >> ((n - 1) * 8)) & 0xFF) return n;
+    if ((u >> ((n - 1) * 8)) & 0xFF) {
+      if (val > 0 && n < 8 && ((u >> ((n - 1) * 8)) & 0x80)) return n + 1;
+      return n;
+    }
   }
   return 0;
 }

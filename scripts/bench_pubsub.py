@@ -1,0 +1,103 @@
+"""Pubsub engine throughput: sustained write → subscription-event rate.
+
+Reference analog: the matcher's cmd_loop batches candidates for 600 ms /
+1000 entries and diffs per-table rewritten queries
+(`klukai-types/src/pubsub.rs:1062-1226`). This measures the end-to-end
+event rate a live NDJSON subscription sustains while a writer hammers
+/v1/transactions on the same agent — matcher, per-sub sqlite db, HTTP
+streaming and the h2 front-end all in the path.
+
+Writes INSERT ... ON CONFLICT upserts in batches; the subscriber counts
+row-change events until the writer stops and the stream drains. Records
+into PUBSUB_BENCH.json.
+
+Usage: python scripts/bench_pubsub.py [n_rows] [batch]   (default 20000 50)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from corrosion_tpu.runtime import jaxenv  # noqa: E402
+
+jaxenv.force_cpu_inprocess()
+
+from corrosion_tpu.client import CorrosionApiClient  # noqa: E402
+from corrosion_tpu.net.mem import MemNetwork  # noqa: E402
+from corrosion_tpu.runtime.records import merge_records  # noqa: E402
+
+from tests.test_http_api import boot_with_api  # noqa: E402
+
+
+async def main(n_rows: int, batch: int) -> dict:
+    net = MemNetwork(seed=9)
+    agent, api, client = await boot_with_api(net, "agent-pubsub")
+    sub_client = CorrosionApiClient(api.addrs[0])
+    got = 0
+    done = asyncio.Event()
+
+    async def subscriber() -> None:
+        nonlocal got
+        async for ev in sub_client.subscribe(
+            "SELECT id, text FROM tests", skip_rows=True
+        ):
+            if "change" in ev:
+                got += 1
+                if got >= n_rows:
+                    done.set()
+                    return
+
+    sub_task = asyncio.ensure_future(subscriber())
+    try:
+        await asyncio.sleep(0.5)  # subscription established
+
+        t0 = time.monotonic()
+        for start in range(0, n_rows, batch):
+            stmts = [
+                [
+                    "INSERT INTO tests (id, text) VALUES (?, ?) "
+                    "ON CONFLICT (id) DO UPDATE SET text = excluded.text",
+                    [i, f"v{i}"],
+                ]
+                for i in range(start, min(start + batch, n_rows))
+            ]
+            await client.execute(stmts)
+        write_wall = time.monotonic() - t0
+        # wait on the subscriber TASK, not just the event: a subscriber
+        # crash must surface its real exception, not a bare TimeoutError
+        await asyncio.wait_for(sub_task, 300)
+        total_wall = time.monotonic() - t0
+
+        return {
+            "rung": f"pubsub-{n_rows}",
+            "n_rows": n_rows,
+            "batch": batch,
+            "write_wall_s": round(write_wall, 2),
+            "events_delivered": got,
+            "event_rate_per_s": round(got / total_wall, 1),
+            "write_rate_per_s": round(n_rows / write_wall, 1),
+            "total_wall_s": round(total_wall, 2),
+        }
+    finally:
+        sub_task.cancel()
+        await client.close()
+        await sub_client.close()
+        await api.stop()
+        from corrosion_tpu.agent.run import shutdown
+
+        await shutdown(agent)
+
+
+if __name__ == "__main__":
+    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 20_000
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+    rec = asyncio.run(main(n_rows, batch))
+    merge_records(os.path.join(REPO, "PUBSUB_BENCH.json"), [rec])
+    print(json.dumps(rec))

@@ -52,7 +52,11 @@ async def main(n_sim: int, n_crash: int) -> dict:
         print(f"absorbed={absorbed} size={ms.cluster_size} "
               f"in {absorb_s:.1f}s", flush=True)
 
-        dead = list(range(0, n_sim, max(1, n_sim // n_crash)))[:n_crash]
+        dead = (
+            list(range(0, n_sim, max(1, n_sim // n_crash)))[:n_crash]
+            if n_crash > 0
+            else []  # pure-absorption rung
+        )
         dead_ids = {sim_actor_id(j) for j in dead}
         for j in dead:
             bridge.crash(j)

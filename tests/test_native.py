@@ -123,8 +123,9 @@ def test_store_uses_native_pack():
         tx.execute("INSERT INTO t (a, b, c) VALUES (255, 'k', 1.5)")
         changes, _v, _s = tx.commit()
     assert changes
-    # the pk decodes to the sign-extended form (the intentional quirk:
-    # 255 packs to one byte 0xFF and decodes signed; not repack-stable)
-    assert unpack_columns(changes[0].pk) == [-1, "k"]
+    # the native trigger packer widens sign-boundary positives exactly
+    # like the python packer (see pack.py _num_bytes_needed): 255
+    # round-trips instead of upstream's sign-extended -1
+    assert unpack_columns(changes[0].pk) == [255, "k"]
     assert all(ch.pk == changes[0].pk for ch in changes)
     store.close()

@@ -63,15 +63,26 @@ def test_fixture_bytes():
     assert pack_columns([1.0]) == bytes([1, 2]) + struct.pack(">d", 1.0)
 
 
-def test_reference_sign_extension_quirk():
-    # The reference writer (pubsub.rs:2315-2340) packs 128..=255 into one
-    # byte but its reader (bytes::Buf::get_int) sign-extends, so 255
-    # canonically decodes to -1. We reproduce this exactly for wire parity;
-    # stores must treat packed pk bytes as the opaque row identity.
-    assert unpack_columns(pack_columns([255])) == [-1]
-    assert unpack_columns(pack_columns([0x80])) == [-128]
-    # the 9th bit makes it unambiguous again
-    assert unpack_columns(pack_columns([256])) == [256]
+def test_sign_boundary_encode_widens_decode_stays_reference_compatible():
+    # The reference's encoder/decoder pair is asymmetric: its writer
+    # (pubsub.rs:2315-2340) packs 128..=255 into ONE byte but its reader
+    # (bytes::Buf::get_int) sign-extends, so upstream 255 decodes to -1
+    # and such pks never round-trip (the matcher temp-table path drops
+    # them). Our encoder widens positive values whose top bit would
+    # sign-flip — every value round-trips...
+    for v in (127, 128, 255, 256, 32767, 32768, 2**31, 2**47):
+        assert unpack_columns(pack_columns([v])) == [v]
+    # ...while the DECODER stays bug-compatible: a reference node's
+    # 1-byte encoding of 255 (count=1, type=(1<<3)|INTEGER, 0xFF) still
+    # decodes to the same -1 the reference itself would read.
+    foreign = bytes([1, (1 << 3) | 0x01, 0xFF])
+    assert unpack_columns(foreign) == [-1]
+    foreign = bytes([1, (1 << 3) | 0x01, 0x80])
+    assert unpack_columns(foreign) == [-128]
+    # text/blob lengths ride the same integer coding: 128+-byte pks
+    # round-trip too (upstream raises/misreads these)
+    assert unpack_columns(pack_columns(["x" * 200])) == ["x" * 200]
+    assert unpack_columns(pack_columns([b"\x01" * 150])) == [b"\x01" * 150]
 
 
 def test_ordering_is_stable():

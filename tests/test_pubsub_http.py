@@ -223,3 +223,44 @@ def test_subscription_exactly_once_under_concurrent_writers():
                 await shutdown(ag)
 
     asyncio.run(main())
+
+
+def test_subscription_rows_across_sign_boundary():
+    """Regression: integer pks 128..255 pack into a sign-ambiguous byte
+    upstream (encoder/decoder asymmetry, pubsub.rs:2315-2340 vs get_int)
+    and the matcher's temp-table diff silently dropped their events —
+    a subscription stalled at exactly id 127. The widened encoder
+    (types/pack.py) must deliver every row."""
+
+    async def main():
+        net = MemNetwork(seed=41)
+        a, api, client = await boot_with_api(net, "agent-sb")
+        try:
+            got = []
+
+            async def subscriber():
+                async for ev in client.subscribe(
+                    "SELECT id, text FROM tests", skip_rows=True
+                ):
+                    if "change" in ev:
+                        got.append(ev["change"][2][0])
+                        if len(got) >= 40:
+                            return
+
+            task = asyncio.ensure_future(subscriber())
+            await asyncio.sleep(0.3)
+            stmts = [
+                ["INSERT INTO tests (id, text) VALUES (?, ?)", [i, f"v{i}"]]
+                for i in range(110, 150)  # crosses the 128 boundary
+            ]
+            await client.execute(stmts)
+            await asyncio.wait_for(task, 30)
+            assert sorted(got) == list(range(110, 150))
+        finally:
+            await client.close()
+            await api.stop()
+            from corrosion_tpu.agent.run import shutdown
+
+            await shutdown(a)
+
+    asyncio.run(main())
