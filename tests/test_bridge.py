@@ -18,7 +18,7 @@ from corrosion_tpu.models.cluster import ClusterSim
 from corrosion_tpu.net.gossip_codec import MemberState
 from corrosion_tpu.net.mem import MemNetwork
 
-from tests.test_agent import boot, wait_until
+from tests.test_agent import boot, count_rows, insert, wait_until
 
 N_SIM = 192
 
@@ -77,6 +77,63 @@ def test_agent_absorbs_kernel_population_and_detects_crashes():
             from corrosion_tpu.agent.run import shutdown
 
             await shutdown(agent)
+            await bridge.stop()
+
+    asyncio.run(main())
+
+
+def test_replication_alongside_simulated_population():
+    """Two real agents replicate CRDT writes while both absorb and track
+    a kernel-simulated population — the production stack and the tpu-sim
+    world coexisting on one gossip plane."""
+
+    async def main():
+        n_sim = 96
+        net = MemNetwork(seed=21)
+        sim = ClusterSim(n_sim, seed=4)
+        bridge = KernelPeerBridge(net, sim, seed=6)
+        bridge.start()
+
+        a = await boot(net, "agent-a")
+        b = await boot(net, "agent-b", bootstrap=("agent-a",))
+        try:
+            # join the simulated world via one virtual member
+            await a.membership.announce(bridge.addr(0))
+
+            # real->real replication keeps working
+            await insert(a, 1, "hello")
+            assert await wait_until(
+                lambda: count_rows(b) == 1, timeout=30.0
+            )
+
+            # BOTH real agents absorb the population (b learns the sim
+            # members only through a's piggyback — transitive spread)
+            assert await wait_until(
+                lambda: a.membership.cluster_size >= n_sim + 2, timeout=60.0
+            )
+            assert await wait_until(
+                lambda: b.membership.cluster_size >= n_sim + 2, timeout=60.0
+            )
+
+            # a crashed sim member is evicted from BOTH agents' tables
+            # (bridge gossips the kernel's ground-truth DOWN by default)
+            bridge.crash(17)
+            gone = sim_actor_id(17)
+            assert await wait_until(
+                lambda: gone in a.membership.downed
+                and gone in b.membership.downed,
+                timeout=60.0,
+            )
+            # ... while replication still flows
+            await insert(a, 2, "after-churn")
+            assert await wait_until(
+                lambda: count_rows(b) == 2, timeout=30.0
+            )
+        finally:
+            from corrosion_tpu.agent.run import shutdown
+
+            await shutdown(a)
+            await shutdown(b)
             await bridge.stop()
 
     asyncio.run(main())
