@@ -103,16 +103,18 @@ def test_replication_alongside_simulated_population():
             # real->real replication keeps working
             await insert(a, 1, "hello")
             assert await wait_until(
-                lambda: count_rows(b) == 1, timeout=30.0
+                lambda: count_rows(b) == 1, timeout=60.0
             )
 
             # BOTH real agents absorb the population (b learns the sim
             # members only through a's piggyback — transitive spread)
             assert await wait_until(
-                lambda: a.membership.cluster_size >= n_sim + 2, timeout=60.0
+                lambda: a.membership.cluster_size >= n_sim + 2,
+                timeout=120.0,
             )
             assert await wait_until(
-                lambda: b.membership.cluster_size >= n_sim + 2, timeout=60.0
+                lambda: b.membership.cluster_size >= n_sim + 2,
+                timeout=120.0,
             )
 
             # a crashed sim member is evicted from BOTH agents' tables
@@ -122,12 +124,12 @@ def test_replication_alongside_simulated_population():
             assert await wait_until(
                 lambda: gone in a.membership.downed
                 and gone in b.membership.downed,
-                timeout=60.0,
+                timeout=120.0,
             )
             # ... while replication still flows
             await insert(a, 2, "after-churn")
             assert await wait_until(
-                lambda: count_rows(b) == 2, timeout=30.0
+                lambda: count_rows(b) == 2, timeout=60.0
             )
         finally:
             from corrosion_tpu.agent.run import shutdown
