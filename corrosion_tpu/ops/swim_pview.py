@@ -116,6 +116,13 @@ class PViewParams(NamedTuple):
     loss: float = 0.0
     identity_hash: bool = False
     inbox_impl: str = "gsort"  # see swim.SwimParams.inbox_impl
+    # feed merge scheduling: "seq" (each feed's partner pick reads the
+    # already-merged table — the dense kernel's semantics, required for
+    # the identity-hash parity pin) or "batched" (all feeds pick from
+    # the pre-feed table and merge in ONE scatter-max — 1/nfeeds the
+    # scatter launches; the CPU tick is feed-scatter bound, PROFILE.md
+    # r4 pview phase table)
+    feed_mode: str = "seq"
 
 
 def _keycap(n: int) -> int:
@@ -491,7 +498,8 @@ def tick_impl(
     if fe > 0 and nfeeds > 0:
         spacing = max(1, steps_per_sweep // nfeeds)
 
-        def one_feed(fk, pk):
+        def _feed_pull(pk, fk):
+            """One feed's gathered window ([N, fe] packed) + partner rows."""
             r_feed = jax.random.fold_in(r_gossip, 104729 + fk)
             partner = _pick_known_alive(params, pk, idx, r_feed, 2, t)
             psafe = jnp.clip(partner, 0, n - 1)
@@ -503,7 +511,10 @@ def tick_impl(
             vw = jax.lax.dynamic_slice(pk, (jnp.int32(0), w), (n, fe))
             pulled = jnp.take(vw, psafe, axis=0)
             pulled = jnp.where(has_partner[:, None], pulled, 0)
-            p_subj, p_key = _unpack(params, pulled, psafe[:, None], t)
+            return pulled, psafe
+
+        def _feed_merge(pk, pulled, prows):
+            p_subj, p_key = _unpack(params, pulled, prows, t)
             # re-encode into the receiver's rotation before comparing
             repacked = jnp.where(
                 pulled > 0,
@@ -513,7 +524,30 @@ def tick_impl(
             cols = _hash(params, p_subj)
             return pk.at[idx[:, None], cols].max(repacked)
 
-        packed = jax.lax.fori_loop(0, nfeeds, one_feed, packed)
+        if params.feed_mode == "batched":
+            # all picks read the PRE-feed table; the nfeeds windows merge
+            # in a single [N, nfeeds*fe] scatter-max (intra-tick picks
+            # are one merge staler — convergence pinned by
+            # test_swim_pview.py::test_batched_feed_mode_converges)
+            pulls, rows = [], []
+            for fk in range(nfeeds):
+                pulled, psafe = _feed_pull(packed, fk)
+                pulls.append(pulled)
+                rows.append(
+                    jnp.broadcast_to(psafe[:, None], (n, fe))
+                )
+            packed = _feed_merge(
+                packed,
+                jnp.concatenate(pulls, axis=1),
+                jnp.concatenate(rows, axis=1),
+            )
+        else:
+
+            def one_feed(fk, pk):
+                pulled, psafe = _feed_pull(pk, fk)
+                return _feed_merge(pk, pulled, psafe[:, None])
+
+            packed = jax.lax.fori_loop(0, nfeeds, one_feed, packed)
 
     # ---- 4c. bootstrap-seed exchange (see swim.py 4c: the reference's
     # always-running bootstrap announcer; without it a healed partition

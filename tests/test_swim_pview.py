@@ -268,3 +268,35 @@ def test_incarnation_generation_sites_respect_packed_key_domain():
     # refutation cap: min(inc_cap, INC_CAP) for every n
     for nn in (64, 1000, 262144, 1048576):
         assert min(swim_pview.inc_cap(nn), swim.INC_CAP) * 4 + 7 < 2**15
+
+
+def test_batched_feed_mode_converges():
+    """feed_mode="batched" (one merged scatter per tick, picks read the
+    pre-feed table) must converge equivalently to "seq" — the flag exists
+    for hardware A/Bs (PROFILE.md r4: on CPU it is ~30% SLOWER at 25k;
+    scatter LAUNCH count was not the bottleneck)."""
+    import jax
+
+    n, k = 2048, 256
+    for mode in ("seq", "batched"):
+        params = swim_pview.PViewParams(
+            n=n, slots=k, feeds_per_tick=4, feed_entries=k // 16,
+            tie_epoch=512, feed_mode=mode,
+        )
+        state = swim_pview.init_state(
+            params, jax.random.PRNGKey(0), seed_mode="fingers"
+        )
+        rng = jax.random.PRNGKey(1)
+        converged = False
+        for _ in range(40):
+            rng, key = jax.random.split(rng)
+            state = swim_pview.tick_n_donated(state, key, params, 10)
+            st = swim_pview.membership_stats(state, params)
+            if (
+                st["pv_coverage"] >= 0.99
+                and st["min_in_degree"] >= 8
+                and st["false_positive"] == 0.0
+            ):
+                converged = True
+                break
+        assert converged, (mode, st)
