@@ -495,35 +495,37 @@ def tick_impl(
     fe = min(params.feed_entries, k)
     nfeeds = params.feeds_per_tick
     steps_per_sweep = -(-k // fe) if fe > 0 else 1
+    spacing = max(1, steps_per_sweep // nfeeds) if nfeeds > 0 else 1
+
+    def _feed_pull(pk, fk):
+        """One feed's gathered window ([N, fe] packed) + partner rows."""
+        r_feed = jax.random.fold_in(r_gossip, 104729 + fk)
+        partner = _pick_known_alive(params, pk, idx, r_feed, 2, t)
+        psafe = jnp.clip(partner, 0, n - 1)
+        has_partner = (
+            (partner < n) & alive & alive[psafe] & (part[psafe] == part)
+        )
+        j = (t + fk * spacing) % steps_per_sweep
+        w = jnp.minimum(j * fe, k - fe)
+        vw = jax.lax.dynamic_slice(pk, (jnp.int32(0), w), (n, fe))
+        pulled = jnp.take(vw, psafe, axis=0)
+        pulled = jnp.where(has_partner[:, None], pulled, 0)
+        return pulled, psafe
+
+    def _feed_merge(pk, pulled, prows):
+        p_subj, p_key = _unpack(params, pulled, prows, t)
+        # re-encode into the receiver's rotation before comparing
+        repacked = jnp.where(
+            pulled > 0,
+            _pack(params, p_subj, p_key, idx[:, None], t),
+            0,
+        )
+        cols = _hash(params, p_subj)
+        return pk.at[idx[:, None], cols].max(repacked)
+
     if fe > 0 and nfeeds > 0:
-        spacing = max(1, steps_per_sweep // nfeeds)
-
-        def _feed_pull(pk, fk):
-            """One feed's gathered window ([N, fe] packed) + partner rows."""
-            r_feed = jax.random.fold_in(r_gossip, 104729 + fk)
-            partner = _pick_known_alive(params, pk, idx, r_feed, 2, t)
-            psafe = jnp.clip(partner, 0, n - 1)
-            has_partner = (
-                (partner < n) & alive & alive[psafe] & (part[psafe] == part)
-            )
-            j = (t + fk * spacing) % steps_per_sweep
-            w = jnp.minimum(j * fe, k - fe)
-            vw = jax.lax.dynamic_slice(pk, (jnp.int32(0), w), (n, fe))
-            pulled = jnp.take(vw, psafe, axis=0)
-            pulled = jnp.where(has_partner[:, None], pulled, 0)
-            return pulled, psafe
-
-        def _feed_merge(pk, pulled, prows):
-            p_subj, p_key = _unpack(params, pulled, prows, t)
-            # re-encode into the receiver's rotation before comparing
-            repacked = jnp.where(
-                pulled > 0,
-                _pack(params, p_subj, p_key, idx[:, None], t),
-                0,
-            )
-            cols = _hash(params, p_subj)
-            return pk.at[idx[:, None], cols].max(repacked)
-
+        if params.feed_mode not in ("seq", "batched"):
+            raise ValueError(f"unknown feed_mode: {params.feed_mode!r}")
         if params.feed_mode == "batched":
             # all picks read the PRE-feed table; the nfeeds windows merge
             # in a single [N, nfeeds*fe] scatter-max (intra-tick picks
@@ -561,12 +563,7 @@ def tick_impl(
         vw = jax.lax.dynamic_slice(packed, (jnp.int32(0), w), (n, fe))
         pulled = jnp.take(vw, sp, axis=0)
         pulled = jnp.where(seed_ok[:, None], pulled, 0)
-        p_subj, p_key = _unpack(params, pulled, sp[:, None], t)
-        repacked = jnp.where(
-            pulled > 0, _pack(params, p_subj, p_key, idx[:, None], t), 0
-        )
-        cols = _hash(params, p_subj)
-        packed = packed.at[idx[:, None], cols].max(repacked)
+        packed = _feed_merge(packed, pulled, sp[:, None])
 
     # ---- 5. refutation (inbox + own slot) --------------------------------
     about_self = (in_subj == idx[:, None]) & (key_prec(in_key) >= PREC_SUSPECT)

@@ -275,8 +275,6 @@ def test_batched_feed_mode_converges():
     pre-feed table) must converge equivalently to "seq" — the flag exists
     for hardware A/Bs (PROFILE.md r4: on CPU it is ~30% SLOWER at 25k;
     scatter LAUNCH count was not the bottleneck)."""
-    import jax
-
     n, k = 2048, 256
     for mode in ("seq", "batched"):
         params = swim_pview.PViewParams(
