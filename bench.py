@@ -62,6 +62,10 @@ def _code_fingerprint() -> dict:
 
 def child_main() -> None:
     """The measured simulation; runs under an env chosen by the parent."""
+    # fingerprint BEFORE the (potentially tens-of-minutes) run: the sha
+    # must describe the code actually imported and measured, not whatever
+    # the tree holds by the time the result prints
+    code_sha = _code_fingerprint()
     jaxenv.enable_compilation_cache()
     import jax
 
@@ -179,7 +183,7 @@ def child_main() -> None:
                     "gossip_mode": sim.params.gossip_mode,
                     "device_loop": device_loop,
                     "check_every": check_every if device_loop else None,
-                    "code_sha": _code_fingerprint(),
+                    "code_sha": code_sha,
                     "measured_at": time.strftime(
                         "%Y-%m-%d %H:%M:%S", time.gmtime()
                     ),
@@ -224,7 +228,14 @@ def _run_child(env: dict, timeout: float) -> tuple[dict | None, int]:
     return None, proc.returncode
 
 
-def _stored_tpu_record(n: int) -> dict | None:
+def _banked_record_path(n: int) -> str:
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"BENCH_TPU_{n // 1000}k.json",
+    )
+
+
+def _stored_tpu_record(n: int) -> tuple[dict | None, str | None]:
     """Load this round's measured-on-TPU bench record for ``n``, if any.
 
     The round-start hunter battery (scripts/tpu_hunter.py) runs bench.py
@@ -238,21 +249,23 @@ def _stored_tpu_record(n: int) -> dict | None:
     - the stored record must match the requested config (n, seed mode,
       feeds, record cadence, coverage target) as derived from the same
       env vars the child uses; any mismatch disqualifies it;
-    - the measured-code fingerprint is recomputed at replay time and any
-      drift is reported in detail.code_drift rather than hidden (a
-      record with no fingerprint reports code_sha_missing);
+    - the measured-code fingerprint is recomputed at replay time and the
+      record is REJECTED unless it carries a fingerprint that matches the
+      tree exactly (r4 verdict: a TPU-labeled headline must be tied to a
+      code version — a sha-less or drifted record is evidence about some
+      other kernel, so the live number, even CPU, is the honest one);
     - the caller never substitutes it for a live MEASURED convergence
       failure — only for runs that could not reach the chip at all.
+
+    Returns ``(record, None)`` on success or ``(None, reason)`` where
+    ``reason`` explains the rejection for the attempts provenance.
     """
-    path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
-        f"BENCH_TPU_{n // 1000}k.json",
-    )
+    path = _banked_record_path(n)
     try:
         with open(path) as f:
             text = f.read()
     except OSError:
-        return None
+        return None, None
     feeds = max(1, int(os.environ.get("BENCH_FEEDS", "4")))
     want = {
         "n_members": n,
@@ -274,45 +287,41 @@ def _stored_tpu_record(n: int) -> dict | None:
             continue
         det = parsed["detail"]
         if any(det.get(k) != v for k, v in want.items()):
-            return None  # measured a different workload: not replayable
+            # measured a different workload: not replayable
+            return None, "replay-rejected:workload-mismatch"
         if "coverage_target" in det and det["coverage_target"] != want_target:
-            return None
+            return None, "replay-rejected:coverage-target-mismatch"
         if det.get("inbox_impl", "gsort") != os.environ.get(
             "BENCH_INBOX_IMPL", "gsort"
         ):
-            return None
+            return None, "replay-rejected:inbox-impl-mismatch"
         if det.get("gossip_mode", "pick") != os.environ.get(
             "BENCH_GOSSIP_MODE", "pick"
         ):
-            return None
-        if parsed.get("detail", {}).get("stable_tick") is None:
-            return None  # stored record itself is a convergence failure
+            return None, "replay-rejected:gossip-mode-mismatch"
+        if det.get("stable_tick") is None:
+            # stored record itself is a convergence failure
+            return None, "replay-rejected:stored-convergence-failure"
+        if "measured_at" not in det:
+            return None, "replay-rejected:measured-at-missing"
         stored_sha = det.get("code_sha")
         now_sha = _code_fingerprint()
         if stored_sha is None:
-            det["code_sha_missing"] = True
-        else:
-            drift = sorted(
-                f for f in set(stored_sha) | set(now_sha)
-                if stored_sha.get(f) != now_sha.get(f)
-            )
-            if drift:
-                det["code_drift"] = drift
+            return None, "replay-rejected:code-sha-missing"
+        drift = sorted(
+            f for f in set(stored_sha) | set(now_sha)
+            if stored_sha.get(f) != now_sha.get(f)
+        )
+        if drift:
+            return None, "replay-rejected:code-drift:" + ",".join(drift)
         det["replayed_from"] = {
             "file": os.path.basename(path),
-            # records embed their own UTC timestamp; the file-mtime
-            # fallback (pre-fingerprint records) is marked as such
-            # because mtime tracks checkout, not measurement
-            "measured_at": det.get(
-                "measured_at",
-                "mtime:" + time.strftime(
-                    "%Y-%m-%d %H:%M:%S",
-                    time.gmtime(os.path.getmtime(path)),
-                ),
-            ),
+            # always present: code_sha and measured_at are stamped
+            # together at capture, and sha-less records were rejected
+            "measured_at": det["measured_at"],
         }
-        return parsed
-    return None
+        return parsed, None
+    return None, None
 
 
 def main() -> None:
@@ -361,7 +370,9 @@ def main() -> None:
         result is None or result.get("detail", {}).get("platform") != "tpu"
     ):
         n = int(os.environ.get("BENCH_N", "10000"))
-        stored = _stored_tpu_record(n)
+        stored, reject_reason = _stored_tpu_record(n)
+        if reject_reason is not None:
+            attempts.append(reject_reason)
         if stored is not None:
             attempts.append("tpu-replay")
             if result is not None:

@@ -171,12 +171,44 @@ def main() -> None:
 
     # Completed steps record the measured-code fingerprint so a later
     # session can tell whether an artifact matches the tree (bench.py
-    # replay re-checks it independently).
-    from bench import _code_fingerprint
-    cur_sha = _code_fingerprint()
+    # replay independently REJECTS records whose embedded code_sha does
+    # not match HEAD — r4 verdict: a chip number must be tied to a code
+    # version).  Fingerprints are recomputed every loop turn so a hunter
+    # that outlives a code edit re-runs the affected steps instead of
+    # leaving a stale "done" mark shadowing the new code.  Each step's
+    # fingerprint covers the kernel files bench.py declares measured
+    # PLUS the step's own entry script (profile/pview edits must stale
+    # their steps too) plus the pview kernel for pview steps.
     done_sha = state.setdefault("done_sha", {})
 
+    def step_fingerprint(name: str, argv: list[str]) -> dict:
+        import hashlib
+
+        from bench import _code_fingerprint
+
+        out = _code_fingerprint()
+        extras = [a for a in argv[1:] if a.endswith(".py")]
+        if "pview" in name:
+            extras.append("corrosion_tpu/ops/swim_pview.py")
+        for rel in extras:
+            try:
+                with open(os.path.join(REPO, rel), "rb") as f:
+                    out[rel] = hashlib.sha256(f.read()).hexdigest()[:12]
+            except OSError:
+                out[rel] = "missing"
+        return out
+
+    by_name = {s[0]: s for s in steps}
     while time.monotonic() - t_start < budget:
+        stale = [
+            name for name in state["done"]
+            if name in by_name
+            and done_sha.get(name) != step_fingerprint(name, by_name[name][1])
+        ]
+        if stale:
+            log(f"measured code changed; re-queueing stale steps: {stale}")
+            state["done"] = [n for n in state["done"] if n not in stale]
+            save_state(state)
         pending = [s for s in steps if s[0] not in state["done"]]
         if not pending:
             log("battery complete")
@@ -194,12 +226,16 @@ def main() -> None:
             remaining = budget - (time.monotonic() - t_start)
             if remaining < 120:
                 break
+            # fingerprint per step, not per window: a battery window can
+            # span hours, and a mid-window code edit must tag only the
+            # steps that actually measured the old code
+            step_sha = step_fingerprint(name, argv)
             ok = run_step(name, argv, env_extra, min(timeout, remaining),
                           outfile)
             state["attempts"][name] = state["attempts"].get(name, 0) + 1
             if ok:
                 state["done"].append(name)
-                done_sha[name] = cur_sha
+                done_sha[name] = step_sha
                 save_state(state)
                 # brief pause so the tunnel's client slot is fully released
                 time.sleep(10)
