@@ -117,6 +117,7 @@ class ApiFrontend:
         up_w.write(head)
 
         async def pump(src: asyncio.StreamReader, dst: asyncio.StreamWriter):
+            err = False
             try:
                 while True:
                     data = await src.read(65536)
@@ -125,10 +126,17 @@ class ApiFrontend:
                     dst.write(data)
                     await dst.drain()
             except (ConnectionError, OSError, asyncio.CancelledError):
-                pass
+                err = True
             finally:
+                # clean EOF = the source half-closed its send side; pass
+                # the half-close through (a client that shutdown(SHUT_WR)
+                # after the request must still receive the streaming
+                # response). Full close only on error.
                 try:
-                    dst.close()
+                    if err or not dst.can_write_eof():
+                        dst.close()
+                    else:
+                        dst.write_eof()
                 except (ConnectionError, OSError):
                     pass
 
@@ -139,6 +147,11 @@ class ApiFrontend:
             await asyncio.gather(t1, t2, return_exceptions=True)
         finally:
             self._proxy_tasks.difference_update((t1, t2))
+            for w in (up_w, writer):
+                try:
+                    w.close()
+                except (ConnectionError, OSError):
+                    pass
 
     # -- h2 stream forwarding ----------------------------------------------
 

@@ -128,6 +128,8 @@ class CorrosionApiClient:
         self.base = f"http://{addr}"
         self.http2 = http2
         host, sep, port = addr.rpartition(":")
+        if host.startswith("[") and host.endswith("]"):
+            host = host[1:-1]  # [::1]:8080 — open_connection wants ::1
         if sep and port.isdigit():
             self._host, self._port = host or "127.0.0.1", int(port)
         else:  # bare hostname: default http port, as the h1 path resolves it

@@ -506,6 +506,9 @@ class H2Server:
         self._conns.add(conn)
         tasks: Dict[int, asyncio.Task] = {}
         last_sid = 0  # client stream ids are strictly increasing (§5.1.1)
+        seen_sids: set = set()  # sids that actually carried a request:
+        # distinguishes late trailers (valid) from HEADERS/DATA on a
+        # never-opened closed stream (PROTOCOL_ERROR, §5.1.1)
         try:
             if not preface_consumed:
                 preface = await asyncio.wait_for(
@@ -517,12 +520,17 @@ class H2Server:
             while True:
                 ftype, flags, sid, payload = await conn.read_frame()
                 if ftype == HEADERS:
+                    if sid == 0 or sid % 2 == 0:
+                        # §5.1.1: clients use odd ids; stream 0 carries
+                        # no HEADERS — connection error, not leniency
+                        raise H2Error(
+                            PROTOCOL_ERROR, "HEADERS on stream 0/even"
+                        )
                     block, flags = await conn.read_header_block(flags, payload, sid)
                     existing = conn.streams.get(sid)
-                    if existing is not None or sid <= last_sid:
+                    if existing is not None or sid in seen_sids:
                         # trailers — on an open stream, or late ones for a
-                        # stream whose handler already finished (sid can
-                        # never be a NEW request: ids increase). Decode
+                        # stream whose handler already finished. Decode
                         # either way: HPACK state is connection-ordered.
                         async with conn._hpack_lock:
                             trailers = conn.inflater.decode(block)
@@ -535,7 +543,15 @@ class H2Server:
                                 existing.recv_closed = True
                                 existing.body.put_nowait(None)
                         continue
+                    if sid <= last_sid:
+                        # a lower-numbered id that never carried a
+                        # request is "closed" (§5.1.1): HEADERS on it is
+                        # a connection error
+                        raise H2Error(
+                            PROTOCOL_ERROR, "HEADERS on never-opened stream"
+                        )
                     last_sid = sid
+                    seen_sids.add(sid)
                     stream = _Stream(sid, conn.peer_initial_window)
                     async with conn._hpack_lock:
                         stream.headers = conn.inflater.decode(block)
@@ -554,6 +570,14 @@ class H2Server:
                         lambda _t, s=sid: tasks.pop(s, None)
                     )
                 elif ftype == DATA:
+                    if sid == 0 or sid % 2 == 0 or sid not in seen_sids:
+                        # DATA on stream 0, a server-id stream, or a
+                        # stream that never carried a request: §6.1 /
+                        # §5.1.1 connection error (silently dropping it
+                        # would also corrupt flow-control accounting on
+                        # a misbehaving peer). DATA for a *finished*
+                        # request stream stays lenient below.
+                        raise H2Error(PROTOCOL_ERROR, "DATA on idle stream")
                     stream = conn.streams.get(sid)
                     data = conn._strip_data_padding(flags, payload)
                     if stream is not None and not stream.recv_closed:

@@ -50,6 +50,8 @@ CONNECT_TIMEOUT = 5.0  # transport.rs: 5s connect timeout
 
 def split_addr(addr: str) -> Tuple[str, int]:
     host, _, port = addr.rpartition(":")
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]  # [::1]:8080 — sockets want the bare literal
     return host, int(port)
 
 
@@ -335,8 +337,19 @@ class TcpTransport(Transport):
         one reconnect retry, like transport.rs:108-139."""
         conn_key = (addr, lane)
         self.reap_idle()
-        lock = self._locks.setdefault(conn_key, asyncio.Lock())
-        async with lock:
+        # acquire-and-revalidate: asyncio.Lock reports unlocked in the
+        # window between release and a queued waiter resuming, so
+        # reap_idle can pop a Lock that still has waiters; a waiter that
+        # acquired the orphaned Lock must detect the swap and queue on
+        # the current one, else two tasks interleave _write_frame on one
+        # socket
+        while True:
+            lock = self._locks.setdefault(conn_key, asyncio.Lock())
+            await lock.acquire()
+            if self._locks.get(conn_key) is lock:
+                break
+            lock.release()
+        try:
             for attempt in (0, 1):
                 writer = self._conns.get(conn_key)
                 if writer is None or writer.is_closing():
@@ -364,6 +377,8 @@ class TcpTransport(Transport):
                     ).inc()
                     if attempt:
                         raise
+        finally:
+            lock.release()
 
     async def send_uni(self, addr: str, payload: bytes) -> None:
         await self._send_cached(addr, LANE_UNI, payload)

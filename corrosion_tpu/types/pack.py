@@ -51,7 +51,20 @@ def _num_bytes_needed(val: int) -> int:
     temp-table path drops such rows). Widening the encode keeps every
     value bijective while the decoder stays bug-compatible: any byte
     string a reference node could emit still decodes to exactly what the
-    reference itself would decode."""
+    reference itself would decode.
+
+    Compatibility note (packed pk bytes are CRDT row IDENTITY): the
+    widened encoding changes the stored pk bytes for sign-boundary-band
+    values (ints 128..255 and each higher band, 128..255-byte
+    text/blob) relative to BOTH reference nodes and pre-widening builds
+    of this repo. In a mixed cluster such rows exist under two
+    identities until every writer runs the widened encoder — and a
+    persisted store created by a pre-widening build keeps its
+    old-identity rows: new writes to the same logical pk form a second
+    row rather than merging. For an upgraded-in-place store, repack the
+    affected rows once (identity changed iff decode->re-encode differs:
+    SELECT, DELETE, re-INSERT under the new encoder), or re-seed the
+    store from a fresh sync off an upgraded peer."""
     u = val & 0xFFFFFFFFFFFFFFFF
     for n in range(8, 0, -1):
         if u >> ((n - 1) * 8) & 0xFF:

@@ -408,12 +408,18 @@ def test_h2_server_robust_to_malformed_input():
             PREFACE + frame(0x8, 0x0, 0, b"\x00\x00"),      # bad WINDOW_UPDATE
             PREFACE + frame(0x3, 0x0, 1, b"\x00"),          # bad RST len
             PREFACE + b"\xff" * 200,                        # oversized frame hdr
+            # RFC 9113 §5.1.1/§6.1 connection errors (r4 advisor): the
+            # server must GOAWAY(PROTOCOL_ERROR), not silently consume
+            PREFACE + frame(0x0, 0x0, 0, b"data-on-zero"),  # DATA on stream 0
+            PREFACE + frame(0x0, 0x0, 2, b"data-even"),     # DATA on even sid
+            PREFACE + frame(0x0, 0x0, 1, b"data-idle"),     # DATA, no HEADERS
+            PREFACE + frame(0x1, 0x4, 0, b""),              # HEADERS on 0
+            PREFACE + frame(0x1, 0x4, 2, b""),              # HEADERS on even
         ]
         # these legitimately wait for more input; bounded-close is enough
         lenient_cases = [
             b"GET / HTTP/1.0\r\n\r\n",                      # not h2 at all
             PREFACE[:10],                                   # truncated preface
-            PREFACE + frame(0x0, 0x0, 0, b"data-on-zero"),  # DATA on stream 0
             PREFACE + frame(0xEE, 0x0, 1, b"unknown"),      # unknown type
         ]
         for raw in strict_cases:

@@ -205,3 +205,59 @@ def test_tcp_transport_idle_reaper():
         await server.close()
 
     asyncio.run(main())
+
+
+def test_split_addr_ipv6_brackets():
+    from corrosion_tpu.net.tcp import split_addr
+
+    assert split_addr("[::1]:8080") == ("::1", 8080)
+    assert split_addr("[fe80::1%eth0]:9") == ("fe80::1%eth0", 9)
+    assert split_addr("127.0.0.1:8080") == ("127.0.0.1", 8080)
+
+
+def test_client_parses_bracketed_ipv6_addr():
+    from corrosion_tpu.client import CorrosionApiClient
+
+    c = CorrosionApiClient("[::1]:8080")
+    assert (c._host, c._port) == ("::1", 8080)
+    c4 = CorrosionApiClient("10.0.0.1:8080")
+    assert (c4._host, c4._port) == ("10.0.0.1", 8080)
+
+
+def test_send_cached_lock_revalidation_after_reap():
+    """reap_idle can pop a Lock in the release->waiter-resume window; a
+    waiter that acquired the orphaned Lock must queue on the current one
+    instead of interleaving writes (r4 advisor, tcp.py reaper race)."""
+    import asyncio
+
+    from corrosion_tpu.net.tcp import TcpListener, TcpTransport
+
+    async def main():
+        got = []
+
+        async def on_uni(src, data):
+            got.append(data)
+
+        server = await TcpListener.bind()
+        server.serve(lambda s, d: None, on_uni, lambda st: None)
+        t = TcpTransport(await TcpListener.bind(), idle_timeout=30.0)
+        key = (server.addr, b"U")
+        await t.send_uni(server.addr, b"seed")  # create lock + conn
+
+        old_lock = t._locks[key]
+        await old_lock.acquire()
+        waiter = asyncio.ensure_future(t.send_uni(server.addr, b"queued"))
+        await asyncio.sleep(0.05)  # waiter now queued on old_lock
+        # simulate the reap window: lock released, waiter not yet resumed,
+        # reaper swaps the map entry
+        del t._locks[key]
+        old_lock.release()
+        await asyncio.wait_for(waiter, 5)
+        # the waiter must have re-queued onto the CURRENT lock and sent
+        assert t._locks[key] is not old_lock
+        await asyncio.sleep(0.1)
+        assert b"queued" in got
+        await t.close()
+        await server.close()
+
+    asyncio.run(main())
