@@ -37,7 +37,7 @@ from corrosion_tpu.agent.membership import (
 )
 from corrosion_tpu.agent.syncer import serve_sync, sync_loop
 from corrosion_tpu.net.mem import MemNetwork
-from corrosion_tpu.net.tcp import TcpListener, TcpTransport
+from corrosion_tpu.net.tcp import TcpListener, TcpTransport, split_addr
 from corrosion_tpu.net.transport import BiStream
 from corrosion_tpu.runtime.channels import bounded
 from corrosion_tpu.runtime.config import Config
@@ -70,8 +70,32 @@ async def setup(
         addr = config.gossip.bind_addr
         listener = network.listener(addr)
         transport = network.transport(addr)
+    elif config.gossip.transport == "quic":
+        # plaintext QUIC, the reference's native gossip plane
+        # (quinn_plaintext.rs:23-35): datagram/uni/bi lanes on one UDP
+        # socket. TLS-QUIC would need a TLS 1.3 handshake stack; this
+        # build pairs QUIC with the plaintext session only, so the
+        # secured path stays on the TCP/TLS lanes.
+        if not config.gossip.plaintext:
+            raise ValueError(
+                "gossip.transport = 'quic' supports plaintext mode only "
+                "(set gossip.plaintext = true, or use the tcp transport "
+                "with [gossip.tls])"
+            )
+        from corrosion_tpu.net.quic import QuicEndpoint, QuicTransport
+
+        host, port = split_addr(config.gossip.bind_addr)
+        listener = await QuicEndpoint.bind(host or "127.0.0.1", port)
+        transport = QuicTransport(
+            listener, idle_timeout=float(config.gossip.idle_timeout_secs)
+        )
+    elif config.gossip.transport != "tcp":
+        raise ValueError(
+            f"unknown gossip.transport {config.gossip.transport!r} "
+            "(expected 'tcp' or 'quic')"
+        )
     else:
-        host, _, port = config.gossip.bind_addr.rpartition(":")
+        host, port = split_addr(config.gossip.bind_addr)
         server_ctx = client_ctx = None
         if not config.gossip.plaintext:
             # secured gossip plane (peer/mod.rs:152-373): plaintext stays

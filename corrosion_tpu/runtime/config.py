@@ -56,6 +56,10 @@ class GossipConfig:
     # session for trusted networks; set false + a [gossip.tls] section for
     # a TLS-secured gossip plane
     plaintext: bool = True
+    # "tcp" = UDP datagrams + lane-tagged TCP/TLS streams (default);
+    # "quic" = plaintext QUIC (RFC 9000 subset, net/quic.py), the
+    # reference's native wire (quinn + quinn_plaintext.rs)
+    transport: str = "tcp"
     tls: GossipTlsConfig = field(default_factory=GossipTlsConfig)
     max_mtu: Optional[int] = None
     idle_timeout_secs: int = 30
