@@ -493,6 +493,8 @@ class QuicConnection:
         self.local_max_streams_bidi = LOCAL_MAX_STREAMS_BIDI
         self._remote_uni_opened = 0
         self._remote_bidi_opened = 0
+        self._max_remote_sid = {2: -1, 3: -1, 0: -1, 1: -1}  # by kind bits
+        self._stream_unacked: Dict[int, int] = {}
         self._bi_waiters: Dict[int, asyncio.Future] = {}
         # datagrams queued until established
         self._dgram_queue: List[bytes] = []
@@ -642,7 +644,10 @@ class QuicConnection:
 
     async def send_datagram(self, data: bytes) -> None:
         await self._ready()
-        if len(data) + 3 > min(self.max_datagram_remote or 0, MAX_UDP):
+        # the bound must match the flush gate (MAX_UDP - 96 headroom for
+        # packet overhead): an admitted-but-unsendable datagram would
+        # block the queue head forever
+        if len(data) + 3 > min(self.max_datagram_remote or 0, MAX_UDP - 96):
             raise QuicError("datagram too large for peer")
         self._dgram_queue.append(data)
         await self.flush()
@@ -759,13 +764,19 @@ class QuicConnection:
                 track.append(("hsdone",))
                 eliciting = True
                 self._hs_done_sent = True
-            while self.pending_other:
-                frames += self.pending_other.pop(0)
+            # control frames (flow-control credit updates etc.): tracked
+            # for retransmission — a lost MAX_DATA/MAX_STREAMS would
+            # otherwise deadlock the peer until idle timeout (values are
+            # monotone maxima, so re-sending a stale one is harmless)
+            while self.pending_other and len(frames) < MAX_UDP - 200:
+                fr = self.pending_other.pop(0)
+                frames += fr
+                track.append(("other", fr))
                 eliciting = True
             # datagrams
             while self._dgram_queue:
                 d = self._dgram_queue[0]
-                if len(frames) + len(d) + 3 > MAX_UDP - 64:
+                if len(frames) + len(d) + 3 > MAX_UDP - 96:
                     break
                 self._dgram_queue.pop(0)
                 frames += vint(F_DATAGRAM_LEN) + vint(len(d)) + d
@@ -796,6 +807,9 @@ class QuicConnection:
                         + vint(len(data)) + data
                     )
                     track.append(("stream", st.sid, off, data, fin_now))
+                    self._stream_unacked[st.sid] = (
+                        self._stream_unacked.get(st.sid, 0) + 1
+                    )
                     # flow control counts highest offsets, not bytes on
                     # the wire: retransmits don't consume credit (§4.1)
                     new_bytes = max(0, off + len(data) - st.highwater)
@@ -1047,6 +1061,26 @@ class QuicConnection:
         self.spaces[S_INIT].ack_pending = True
         self.established.set()
 
+    def _open_remote_stream(self, sid: int, kind: int) -> RecvStream:
+        rs = RecvStream(sid)
+        self.recv_streams[sid] = rs
+        if kind >= 2:  # uni
+            self._remote_uni_opened += 1
+            self.endpoint._on_uni_stream(self, rs)
+        else:
+            self._remote_bidi_opened += 1
+            # our send half of THEIR bidi stream: limited by the
+            # window they advertise for streams they initiated
+            send = SendStream(
+                sid, self, credit=getattr(self, "msd_bidi_local_remote", 0)
+            )
+            self.send_streams[sid] = send
+            self.endpoint._on_bi_stream(
+                self, QuicBiStream(self, sid, send, rs)
+            )
+        self._maybe_replenish_streams()
+        return rs
+
     def _on_stream(self, sid: int, off: int, data: bytes, fin: bool) -> None:
         # low bits: 0 client-bidi, 1 server-bidi, 2 client-uni, 3 server-uni
         kind = sid & 0x03
@@ -1055,28 +1089,32 @@ class QuicConnection:
         remote_initiated = initiated_by_client == (not self.is_client)
         rs = self.recv_streams.get(sid)
         if rs is None:
-            if not remote_initiated and not is_uni:
-                return  # our bidi's return half is pre-registered
             if not remote_initiated:
-                return  # STREAM on our own uni send: bogus, drop
-            rs = RecvStream(sid)
-            self.recv_streams[sid] = rs
-            if is_uni:
-                self._remote_uni_opened += 1
-                self.endpoint._on_uni_stream(self, rs)
-            else:
-                self._remote_bidi_opened += 1
-                # our send half of THEIR bidi stream: limited by the
-                # window they advertise for streams they initiated
-                send = SendStream(
-                    sid, self, credit=getattr(self, "msd_bidi_local_remote", 0)
-                )
-                self.send_streams[sid] = send
-                self.endpoint._on_bi_stream(
-                    self, QuicBiStream(self, sid, send, rs)
-                )
-            self._maybe_replenish_streams()
+                # our bidi's return half is pre-registered; anything else
+                # on our own send side (or a finished local stream's late
+                # retransmit) is dropped
+                return
+            if sid <= self._max_remote_sid[kind]:
+                # a sid at/below the high-water that's no longer in the
+                # map was opened and finished: stale retransmit, drop
+                # (recreating it would re-dispatch a handled payload)
+                return
+            # §3.2: a higher sid implicitly opens every lower stream of
+            # its kind — create them so reordered first-frames still land
+            # on live streams rather than being mistaken for stale ones
+            lo = self._max_remote_sid[kind] + 4 if \
+                self._max_remote_sid[kind] >= 0 else kind
+            self._max_remote_sid[kind] = sid
+            for s in range(lo, sid, 4):
+                if s not in self.recv_streams:
+                    self._open_remote_stream(s, kind)
+            rs = self._open_remote_stream(sid, kind)
         grown = rs.feed(off, data, fin)
+        if rs.asm.finished:
+            # the lane reader holds its own reference; dropping the map
+            # entry bounds long-lived connections (one uni stream per
+            # broadcast) and makes late retransmits identifiable above
+            self.recv_streams.pop(sid, None)
         self.data_consumed += grown
         if self.data_consumed > self.max_data_local // 2:
             self.max_data_local += LOCAL_MAX_DATA
@@ -1101,12 +1139,31 @@ class QuicConnection:
                 vint(F_MAX_STREAMS_BIDI) + vint(self.local_max_streams_bidi)
             )
 
+    def _gc_send_stream(self, sid: int) -> None:
+        """Drop a drained send stream: fin sent, nothing pending, nothing
+        in flight — bounds send_streams on long-lived connections (one
+        uni stream per broadcast payload)."""
+        st = self.send_streams.get(sid)
+        if (
+            st is not None and st.fin_sent and not st.pending
+            and self._stream_unacked.get(sid, 0) == 0
+        ):
+            self.send_streams.pop(sid, None)
+            self._stream_unacked.pop(sid, None)
+
     def _on_ack(self, space: int, ranges: List[Tuple[int, int]]) -> None:
         sp = self.spaces[space]
         now = time.monotonic()
         for lo, hi in ranges:
             for pn in [p for p in sp.sent if lo <= p <= hi]:
                 pkt = sp.sent.pop(pn)
+                for fr in pkt.frames:
+                    if fr[0] == "stream":
+                        sid = fr[1]
+                        self._stream_unacked[sid] = max(
+                            0, self._stream_unacked.get(sid, 0) - 1
+                        )
+                        self._gc_send_stream(sid)
                 if pn == ranges[0][1]:  # largest acked: RTT sample
                     rtt = now - pkt.sent_at
                     self.srtt = rtt if self.srtt is None \
@@ -1163,11 +1220,16 @@ class QuicConnection:
             self.spaces[sp_idx].crypto_pending.append((off, data))
         elif fr[0] == "stream":
             _, sid, off, data, fin = fr
+            self._stream_unacked[sid] = max(
+                0, self._stream_unacked.get(sid, 0) - 1
+            )
             st = self.send_streams.get(sid)
             if st is not None:
                 st.pending.append((off, data, fin))
         elif fr[0] == "hsdone":
             self._hs_done_sent = False
+        elif fr[0] == "other":
+            self.pending_other.append(fr[1])
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,500 @@
+"""Array-resident CRDT merge: the batch decision plane as a jitted kernel.
+
+SURVEY §7 step 1 asks for the merge engine "as C++/XLA-custom-call or
+Pallas kernels"; r4 shipped the host-native C++ engine
+(`native/crdt_batch.cpp`) with an argued ceiling.  This module is the
+measured counterpart: the same column-level LWW + causal-length decision
+rules (`agent/util.rs:703-1310` semantics, pinned to
+`store/crdt.py::_merge_table_python`) recast as a data-parallel program
+that XLA can fuse and a TPU can run over a whole sync-flood batch at
+once:
+
+  1. one lexsorted pass by (pk-group, arrival) + a segmented exclusive
+     prefix-max over causal lengths: which changes are causal
+     transitions, which are equal-cl candidates, what each row's final
+     cl / erasure watermark is;
+  2. one lexsorted pass by ((pk,cid)-group, arrival) + a segmented
+     exclusive prefix-max over the lexicographic key (cl, col_version,
+     value-digest): the per-change win mask — a change wins iff it
+     strictly beats everything before it (local baseline included);
+  3. two masked segment-argmaxes over the same key: the final clock-row
+     writer per cid (candidates at the final cl only — causal
+     transitions reset clock rows) and the final cell writer per cid
+     (candidates above the last applied delete's erasure watermark —
+     odd re-creates keep surviving cell values).
+
+Values enter the kernel as 128-bit order-preserving digests (type rank,
+then numeric key or bytes prefix).  A digest is exact for NULLs,
+numerics within float64-exact range, and text/blob ≤ 14 bytes; ties at
+equal INEXACT digests cannot be decided on-device and surface in the
+`ambiguous` output — the caller falls back to the host engine for that
+batch (the reference's merge-equal-values rule needs the true value
+order, `types/values.py::cmp_values`).
+
+The host wrapper `merge_table_array` slots into the same engine contract
+as `_merge_table_native` so the store can A/B the three engines on
+identical inputs (CORRO_CRDT_ENGINE=array|native|python;
+scripts/bench_crdt_merge.py records the measurement).
+"""
+
+from __future__ import annotations
+
+import struct
+from functools import partial
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+SENTINEL = "-1"
+
+_F64_EXACT = 1 << 53
+
+
+# ---------------------------------------------------------------------------
+# value digests (host side)
+
+
+def value_digest(val) -> tuple:
+    """(d0, d1, d2, d3, exact): 112-bit order-preserving digest as four
+    words that all fit int32 (≤28 payload bits each — the kernel runs
+    without jax x64).  Order matches types/values.py::cmp_values:
+    NULL < numeric < TEXT < BLOB; numerics by value, text/blob
+    lexicographic bytewise.
+
+    exact=True means the digest captures the full value order: NULLs,
+    numerics representable exactly in float64, text/blob ≤ 13 bytes
+    (13-byte prefix + capped length; equal-prefix ordering by length is
+    the bytewise prefix rule, valid precisely when one side is fully
+    captured)."""
+    if val is None:
+        return 0, 0, 0, 0, True
+    if isinstance(val, bool):
+        val = int(val)
+    if isinstance(val, (int, float)):
+        if isinstance(val, int):
+            exact = -_F64_EXACT <= val <= _F64_EXACT
+        else:
+            exact = True
+        f = float(val)
+        # total-order map of float64 to uint64: flip sign bit for
+        # positives, flip all bits for negatives
+        bits = struct.unpack(">Q", struct.pack(">d", f))[0]
+        if bits & (1 << 63):
+            bits = (~bits) & 0xFFFFFFFFFFFFFFFF
+        else:
+            bits |= 1 << 63
+        d0 = (1 << 28) | (bits >> 40)  # rank 1 + top 24 bits
+        d1 = (bits >> 12) & 0xFFFFFFF
+        d2 = (bits & 0xFFF) << 16
+        return d0, d1, d2, 0, exact
+    if isinstance(val, str):
+        rank, data = 2, val.encode("utf-8")
+    elif isinstance(val, (bytes, bytearray, memoryview)):
+        rank, data = 3, bytes(val)
+    else:  # pragma: no cover - schema guarantees sqlite types
+        return (4 << 28) - 1, 0, 0, 0, False
+    exact = len(data) <= 13
+    # 13-byte prefix + min(len, 14): equal prefixes order by length when
+    # one side is a true prefix (exact); two ≥14-byte values tie at 14
+    # and surface as inexact
+    w = int.from_bytes(
+        data[:13].ljust(13, b"\x00") + bytes([min(len(data), 14)]), "big"
+    )
+    d0 = (rank << 28) | ((w >> 84) & 0xFFFFFFF)
+    d1 = (w >> 56) & 0xFFFFFFF
+    d2 = (w >> 28) & 0xFFFFFFF
+    d3 = w & 0xFFFFFFF
+    return d0, d1, d2, d3, exact
+
+
+# ---------------------------------------------------------------------------
+# the jitted decision kernel
+
+
+def _lex_gt(a, b):
+    """Strict lexicographic a > b over tuples of equal-length arrays."""
+    import jax.numpy as jnp
+
+    gt = jnp.zeros(a[0].shape, dtype=bool)
+    eq = jnp.ones(a[0].shape, dtype=bool)
+    for xa, xb in zip(a, b):
+        gt = gt | (eq & (xa > xb))
+        eq = eq & (xa == xb)
+    return gt
+
+
+def _lex_max(a, b):
+    import jax.numpy as jnp
+
+    take_b = _lex_gt(b, a)
+    return tuple(jnp.where(take_b, xb, xa) for xa, xb in zip(a, b))
+
+
+def _seg_exclusive_lexmax(keys, seg_start, neg, n_key: int):
+    """Exclusive segmented prefix lexicographic max in sorted order.
+
+    keys: tuple of arrays — the first ``n_key`` components order the
+    max; any remaining components are payload carried with the winning
+    element (e.g. its exactness bit).  seg_start: bool array; neg:
+    per-component 'minus infinity' / default values."""
+    import jax
+    import jax.numpy as jnp
+
+    n = keys[0].shape[0]
+    # shift right by one: element i sees the max of [segment start, i)
+    shifted = tuple(
+        jnp.concatenate([jnp.full((1,), nv, dtype=k.dtype), k[:-1]])
+        for k, nv in zip(keys, neg)
+    )
+    start = jnp.concatenate([jnp.ones((1,), bool), seg_start[1:]])
+    reset = tuple(
+        jnp.where(start, jnp.full((n,), nv, dtype=k.dtype), k)
+        for k, nv in zip(shifted, neg)
+    )
+
+    def combine(x, y):
+        xf, xk = x
+        yf, yk = y
+        take_y = yf | _lex_gt(yk[:n_key], xk[:n_key])
+        merged = tuple(
+            jnp.where(take_y, yc, xc) for xc, yc in zip(xk, yk)
+        )
+        return xf | yf, merged
+
+    flags = start
+    _, out = jax.lax.associative_scan(combine, (flags, reset))
+    return out
+
+
+@partial(
+    __import__("jax").jit,
+    static_argnames=("num_groups", "num_cells"),
+)
+def _merge_kernel(
+    grp, cellg, cl, cv, d0, d1, d2, d3, exact, fake, pos, is_sent, valid,
+    num_groups: int, num_cells: int,
+):
+    """All-batch merge decisions; see module docstring for the shape.
+
+    Inputs are 1-D int32/bool arrays over changes + baseline rows
+    (baselines carry pos = -1).  Padding rows have valid = False and
+    grp/cellg pointing at reserved trailing segment ids."""
+    import jax.numpy as jnp
+    from jax import ops as jops
+
+    neg = jnp.int32(-1)
+    big = jnp.int32(2**31 - 1)
+
+    # ---- pass 1: row-cl prefix maxima in arrival order -------------------
+    order1 = jnp.lexsort((pos, grp))
+    g1 = grp[order1]
+    cl1 = jnp.where(valid[order1], cl[order1], neg)
+    seg1 = jnp.concatenate([jnp.ones((1,), bool), g1[1:] != g1[:-1]])
+    (prev_max,) = _seg_exclusive_lexmax((cl1,), seg1, (-1,), n_key=1)
+    is_change1 = pos[order1] >= 0
+    candidate1 = cl1 >= prev_max
+    transition1 = is_change1 & (cl1 > prev_max) & valid[order1]
+    equal_cl1 = is_change1 & (cl1 == prev_max) & valid[order1]
+
+    # scatter back to original positions
+    inv1 = jnp.zeros_like(order1).at[order1].set(jnp.arange(order1.shape[0]))
+    transition = transition1[inv1]
+    equal_cl = equal_cl1[inv1]
+    candidate = (candidate1 & valid[order1])[inv1]
+
+    # per-group aggregates
+    gsafe = jnp.where(valid, grp, num_groups - 1)
+    final_cl = jops.segment_max(
+        jnp.where(valid, cl, neg), gsafe, num_segments=num_groups
+    )
+    any_transition = (
+        jops.segment_max(
+            jnp.where(transition, jnp.int32(1), jnp.int32(0)),
+            gsafe, num_segments=num_groups,
+        ) > 0
+    )
+    applied_even = transition & (cl % 2 == 0)
+    max_erase = jops.segment_max(
+        jnp.where(applied_even, cl, neg), gsafe, num_segments=num_groups
+    )
+    any_delete = (
+        jops.segment_max(
+            jnp.where(applied_even, jnp.int32(1), jnp.int32(0)),
+            gsafe, num_segments=num_groups,
+        ) > 0
+    )
+
+    # ---- pass 2: per-(pk,cid) key scans ----------------------------------
+    key = (cl, cv, d0, d1, d2, d3)
+    order2 = jnp.lexsort((pos, cellg))
+    c2 = cellg[order2]
+    seg2 = jnp.concatenate([jnp.ones((1,), bool), c2[1:] != c2[:-1]])
+    key2 = tuple(jnp.where(valid[order2], k[order2], neg) for k in key)
+    # exactness and fake-digest bits ride along as payload of the
+    # running max element
+    exact2 = jnp.where(valid[order2], exact[order2].astype(jnp.int32), 1)
+    fake2 = jnp.where(valid[order2], fake[order2].astype(jnp.int32), 0)
+    scanned = _seg_exclusive_lexmax(
+        key2 + (exact2, fake2), seg2, (neg,) * 6 + (1, 0), n_key=6
+    )
+    prev_key2, prev_exact2, prev_fake2 = scanned[:6], scanned[6], scanned[7]
+    beats_prev2 = _lex_gt(key2, prev_key2)
+    # digest-level tie with EITHER side inexact → undecidable on-device
+    eq_prev2 = ~beats_prev2 & ~_lex_gt(prev_key2, key2)
+    fuzzy2 = eq_prev2 & ((exact2 == 0) | (prev_exact2 == 0))
+    # (cl, cv)-level tie against a FAKE baseline digest (local value not
+    # prefetched): the digest comparison is meaningless either way
+    clcv_eq2 = (key2[0] == prev_key2[0]) & (key2[1] == prev_key2[1])
+    fuzzy2 = fuzzy2 | (clcv_eq2 & (prev_fake2 == 1))
+    inv2 = jnp.zeros_like(order2).at[order2].set(jnp.arange(order2.shape[0]))
+    beats_prev = beats_prev2[inv2]
+    eq_fuzzy = fuzzy2[inv2]
+
+    # win mask (the loop's per-change outcome at its position)
+    odd = cl % 2 == 1
+    col_win = equal_cl & odd & ~is_sent & beats_prev
+    win = (transition | col_win) & valid & (pos >= 0)
+
+    # ambiguity: an equal-cl non-sentinel candidate tying the running max
+    # on a digest either side of which is inexact — the host must
+    # re-decide the batch with true value order
+    tie_risk = (
+        equal_cl & odd & ~is_sent & eq_fuzzy & valid & (pos >= 0)
+    )
+    ambiguous = jnp.any(tie_risk)
+
+    # ---- final writers per (pk,cid) --------------------------------------
+    csafe = jnp.where(valid, cellg, num_cells - 1)
+    erase_of = max_erase[gsafe]
+    final_of = final_cl[gsafe]
+    cell_live = candidate & (cl > erase_of) & ~is_sent & valid
+    # clock rows come only from ODD-cl writes: an even (delete)
+    # transition carrying a non-sentinel cid records only its sentinel
+    # entry in the reference loop
+    clock_live = candidate & (cl == final_of) & ~is_sent & valid & win & odd
+    # clock rows: baselines only count when no transition reset them
+    base_clock_live = (
+        (pos < 0) & ~is_sent & valid & (cl == final_of)
+    )
+    clock_cand = clock_live | base_clock_live
+    cell_cand = cell_live & (win | (pos < 0))
+
+    def seg_arglexmax(mask):
+        import jax.numpy as jnp2
+
+        # winner = lexicographically largest (key, -pos) among mask rows
+        neg_pos = -pos  # later arrivals lose ties (first writer keeps)
+        full = key + (neg_pos,)
+        masked = tuple(jnp2.where(mask, k, neg) for k in full)
+        # reduce per segment componentwise is wrong for lex order, so
+        # sort instead: order by (cellg, key, -pos) and take the last
+        # row of each segment
+        o = jnp2.lexsort(tuple(reversed(masked)) + (csafe,))
+        cs = csafe[o]
+        is_last = jnp2.concatenate([cs[1:] != cs[:-1], jnp2.ones((1,), bool)])
+        winner_rows = jnp2.where(is_last & mask[o], o, -1)
+        winners = jnp2.full((num_cells,), -1, dtype=jnp2.int32)
+        winners = winners.at[jnp2.where(is_last, cs, num_cells - 1)].set(
+            jnp2.where(is_last, winner_rows, -1), mode="drop"
+        )
+        return winners
+
+    cell_winner = seg_arglexmax(cell_cand)
+    clock_winner = seg_arglexmax(clock_cand)
+
+    return (
+        win, transition, final_cl, any_transition, any_delete, max_erase,
+        cell_winner, clock_winner, ambiguous,
+    )
+
+
+# ---------------------------------------------------------------------------
+# host wrapper (engine contract of store/crdt.py::_merge_table_native)
+
+
+def _pad(n: int) -> int:
+    p = 64
+    while p < n:
+        p *= 2
+    return p
+
+
+def merge_table_array(
+    store,
+    tbl: str,
+    chs: Sequence,
+    st: Dict[bytes, dict],
+    rcl: Dict[bytes, int],
+    clr: set,
+    ckf: Dict[bytes, Dict[str, tuple]],
+    clf: Dict[bytes, Dict[str, object]],
+    rdel: set,
+    rens: set,
+) -> Optional[List[bool]]:
+    """Merge one table's changes through the jitted kernel; None → caller
+    must use another engine (ambiguous value tie or out-of-range ints)."""
+    from corrosion_tpu.store.crdt import _clock_entry
+
+    n = len(chs)
+    if n == 0:
+        return []
+
+    pks: List[bytes] = []
+    pk_ids: Dict[bytes, int] = {}
+    cell_ids: Dict[tuple, int] = {}
+    rows_grp: List[int] = []
+    rows_cell: List[int] = []
+    rows_cl: List[int] = []
+    rows_cv: List[int] = []
+    rows_d = [[], [], [], []]
+    rows_exact: List[bool] = []
+    rows_fake: List[bool] = []
+    rows_pos: List[int] = []
+    rows_sent: List[bool] = []
+
+    def add_row(g, c, cl, cv, dig, exact, pos, sent, fake=False):
+        if not (0 <= cl < 2**31 and 0 <= cv < 2**31):
+            raise OverflowError
+        rows_grp.append(g)
+        rows_cell.append(c)
+        rows_cl.append(cl)
+        rows_cv.append(cv)
+        for k in range(4):
+            rows_d[k].append(dig[k])
+        rows_exact.append(exact)
+        rows_fake.append(fake)
+        rows_pos.append(pos)
+        rows_sent.append(sent)
+
+    def cell_id(g: int, cid: str) -> int:
+        key = (g, cid)
+        cid_idx = cell_ids.get(key)
+        if cid_idx is None:
+            cid_idx = len(cell_ids)
+            cell_ids[key] = cid_idx
+        return cid_idx
+
+    try:
+        # change rows (arrival order = pos)
+        for j, ch in enumerate(chs):
+            g = pk_ids.get(ch.pk)
+            if g is None:
+                g = len(pk_ids)
+                pk_ids[ch.pk] = g
+                pks.append(ch.pk)
+            sent = ch.cid == SENTINEL
+            d0, d1, d2, d3, exact = value_digest(
+                None if sent else ch.val
+            )
+            add_row(
+                g, cell_id(g, ch.cid), ch.cl,
+                0 if sent else ch.col_version,
+                (d0, d1, d2, d3), exact, j, sent,
+            )
+        # baseline rows: one per pk (row cl, as sentinel) + one per
+        # locally-clocked cid that appears in this batch
+        for pk, g in pk_ids.items():
+            s = st[pk]
+            local_cl = s["cl"]
+            add_row(
+                g, cell_id(g, SENTINEL), local_cl, 0,
+                (0, 0, 0, 0), True, -1, True,
+            )
+            disk = s["disk"] or {}
+            for cid, cv in s["clock"].items():
+                if cid == SENTINEL or (g, cid) not in cell_ids:
+                    continue
+                if cid in disk:
+                    d0, d1, d2, d3, exact = value_digest(disk[cid])
+                    fake = False
+                else:
+                    # value not prefetched: the digest is a placeholder —
+                    # ANY equal-(cl, cv) comparison against it must send
+                    # the batch to a host engine
+                    d0, d1, d2, d3, exact, fake = 0, 0, 0, 0, False, True
+                add_row(
+                    g, cell_ids[(g, cid)], local_cl, cv,
+                    (d0, d1, d2, d3), exact, -1, False, fake=fake,
+                )
+    except OverflowError:
+        return None
+
+    total = len(rows_grp)
+    pad_n = _pad(total)
+    num_groups = _pad(len(pk_ids) + 1)
+    num_cells = _pad(len(cell_ids) + 1)
+
+    def arr(xs, dtype=np.int32, fill=0):
+        a = np.full(pad_n, fill, dtype=dtype)
+        a[:total] = xs
+        return a
+
+    valid = np.zeros(pad_n, dtype=bool)
+    valid[:total] = True
+    out = _merge_kernel(
+        arr(rows_grp, fill=num_groups - 1),
+        arr(rows_cell, fill=num_cells - 1),
+        arr(rows_cl), arr(rows_cv),
+        arr(rows_d[0]), arr(rows_d[1]), arr(rows_d[2]), arr(rows_d[3]),
+        arr(rows_exact, dtype=bool), arr(rows_fake, dtype=bool),
+        arr(rows_pos, fill=-1),
+        arr(rows_sent, dtype=bool), valid,
+        num_groups=num_groups, num_cells=num_cells,
+    )
+    (win, transition, final_cl, any_tr, any_del, _max_erase,
+     cell_winner, clock_winner, ambiguous) = (
+        np.asarray(x) for x in out
+    )
+    if bool(ambiguous):
+        return None
+
+    # ---- rebuild the engine-contract flush plans -------------------------
+    wins = [bool(win[j]) for j in range(n)]
+    # single pass over changes: per-pk final-transition change + any-win
+    final_transition: Dict[bytes, object] = {}
+    any_win_pk: Dict[bytes, bool] = {}
+    for j, ch in enumerate(chs):
+        if wins[j]:
+            any_win_pk[ch.pk] = True
+        if transition[j] and ch.cl == int(final_cl[pk_ids[ch.pk]]):
+            final_transition.setdefault(ch.pk, ch)
+    for pk, g in pk_ids.items():
+        s = st[pk]
+        fcl = int(final_cl[g])
+        if bool(any_tr[g]):
+            s["cl"] = fcl
+            rcl[pk] = fcl
+            clr.add(pk)
+            # sentinel clock entry from the transition that reached fcl
+            ckf[pk] = {SENTINEL: _clock_entry(final_transition[pk], fcl)}
+            s["clock"] = {SENTINEL: fcl}
+        if bool(any_del[g]):
+            rdel.add(pk)
+            if fcl % 2 == 0:
+                s["vals"] = {}
+                clf.pop(pk, None)
+        if fcl % 2 == 1 and any_win_pk.get(pk):
+            rens.add(pk)
+
+    # cell + clock winners
+    for (g, cid), cidx in cell_ids.items():
+        if cid == SENTINEL:
+            continue
+        pk = pks[g]
+        if int(final_cl[g]) % 2 == 0:
+            continue  # dead row: no cells
+        cw = int(cell_winner[cidx])
+        if 0 <= cw < n:
+            ch = chs[cw]
+            clf.setdefault(pk, {})[cid] = ch.val
+            st[pk]["vals"][cid] = ch.val
+        elif bool(any_del[g]):
+            # erased and not rewritten: value gone with the delete
+            pass
+        kw = int(clock_winner[cidx])
+        if 0 <= kw < n:
+            ch = chs[kw]
+            ckf.setdefault(pk, {})[cid] = _clock_entry(ch, ch.col_version)
+            st[pk]["clock"][cid] = ch.col_version
+
+    return wins

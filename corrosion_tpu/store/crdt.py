@@ -173,6 +173,26 @@ def _native_batch_enabled() -> bool:
     return os.environ.get("CORRO_NATIVE_BATCH", "1") != "0"
 
 
+def _merge_engine() -> str:
+    """Engine order for the batch decision plane (phase B).
+
+    "native" (default): C++ columnar loop, Python fallback.
+    "array": jitted array kernel (ops/crdt_merge.py — SURVEY §7 step 1's
+    device-resident form), then native, then Python; the kernel declines
+    batches with undecidable value ties.  "python": reference loop only.
+    The A/B harness (scripts/bench_crdt_merge.py) flips this knob over
+    identical inputs."""
+    eng = os.environ.get("CORRO_CRDT_ENGINE", "native")
+    if eng not in ("native", "array", "python"):
+        raise ValueError(
+            f"unknown CORRO_CRDT_ENGINE {eng!r} "
+            "(expected 'native', 'array' or 'python')"
+        )
+    if eng == "native" and not _native_batch_enabled():
+        return "python"
+    return eng
+
+
 def _clock_entry(ch: Change, col_version: int) -> tuple:
     """One `__crsql_clock`-equivalent row plan: (col_version, db_version,
     seq, site_id, ts)."""
@@ -1008,11 +1028,23 @@ class CrdtStore:
         # available, else the pure-Python loop. Within a table, arrival
         # order is preserved; `impactful` keeps GLOBAL arrival order via
         # the per-table win masks + original positions.
-        lib = self._merge_lib if _native_batch_enabled() else None
+        engine = _merge_engine()
+        lib = self._merge_lib if engine in ("native", "array") else None
+        array_merge = None
+        if engine == "array":
+            from corrosion_tpu.ops.crdt_merge import merge_table_array
+
+            array_merge = merge_table_array
         win_global = [False] * len(changes)
         for tbl, chs in by_table.items():
             wins = None
-            if lib is not None:
+            if array_merge is not None:
+                wins = array_merge(
+                    self, tbl, chs, local[tbl],
+                    row_cl[tbl], cleared[tbl], clock_final[tbl],
+                    cell_final[tbl], row_delete[tbl], row_ensure[tbl],
+                )
+            if wins is None and lib is not None:
                 wins = self._merge_table_native(
                     lib, tbl, chs, local[tbl],
                     row_cl[tbl], cleared[tbl], clock_final[tbl],

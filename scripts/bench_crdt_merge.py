@@ -1,0 +1,185 @@
+"""A/B the CRDT batch-merge engines at sync-flood batch sizes.
+
+VERDICT r4 #5 / SURVEY §7 step 1: the on-TPU merge placement was argued,
+never measured.  This harness measures it: identical synthetic batches
+(change mix shaped like a sync flood: mostly equal-cl column updates
+over a hot row population, some transitions/deletes) through the three
+engines via the SAME store path (CORRO_CRDT_ENGINE), end to end —
+including phase A snapshot reads and phase C SQLite flushes — plus the
+isolated phase-B decision time per engine.  Output: CRDT_MERGE_AB.json.
+
+Run on CPU by default (forced in-process — the axon plugin can hang);
+pass --tpu to let jax pick up the chip for the array engine's kernel
+(host marshaling then crosses the tunnel and is timed honestly).
+
+Usage: python scripts/bench_crdt_merge.py [--tpu] [--sizes 512,4096,...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import random
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from corrosion_tpu.runtime import jaxenv  # noqa: E402
+
+
+def synth_batch(rng: random.Random, n: int, hot_rows: int) -> list:
+    """Sync-flood-shaped batch: column updates dominate, occasional
+    delete/re-create chains, several sites racing."""
+    from corrosion_tpu.types.actor import ActorId
+    from corrosion_tpu.types.base import Timestamp
+    from corrosion_tpu.types.change import SENTINEL, Change
+    from corrosion_tpu.types.pack import pack_columns
+
+    sites = [ActorId(bytes([i]) * 16) for i in (1, 2, 3, 4, 5)]
+    out = []
+    dbv = {s.bytes16: 0 for s in sites}
+    for _ in range(n):
+        site = rng.choice(sites).bytes16
+        row = rng.randint(1, hot_rows)
+        pk = pack_columns([row])
+        r = rng.random()
+        if r < 0.05:
+            cl, cid, val, cv = rng.choice([2, 4]), SENTINEL, None, 1
+        elif r < 0.10:
+            cl, cid, val, cv = rng.choice([1, 3, 5]), SENTINEL, None, 1
+        else:
+            cl = rng.choice([1, 1, 1, 1, 3])
+            cid = rng.choice(["a", "b"])
+            cv = rng.randint(1, 6)
+            val = (
+                rng.randint(0, 10**6)
+                if cid == "b"
+                else rng.choice(["x", "yy", "zzz", "abcdef", ""])
+            )
+        dbv[site] += rng.choice([0, 1])
+        out.append(
+            Change(
+                table="kv", pk=pk, cid=cid, val=val, col_version=cv,
+                db_version=max(1, dbv[site]), seq=rng.randint(0, 3),
+                site_id=site, cl=cl,
+                ts=Timestamp.from_unix(rng.randint(1, 100)),
+            )
+        )
+    return out
+
+
+def mk_store():
+    from corrosion_tpu.store.crdt import CrdtStore
+    from corrosion_tpu.types.actor import ActorId
+
+    st = CrdtStore(":memory:", site_id=ActorId(bytes([9]) * 16))
+    st.apply_schema_sql(
+        "CREATE TABLE kv (id INTEGER NOT NULL PRIMARY KEY,"
+        " a TEXT NOT NULL DEFAULT '', b INTEGER NOT NULL DEFAULT 0);"
+    )
+    return st
+
+
+def bench_engine(engine: str, batches, warm_batch) -> dict:
+    os.environ["CORRO_CRDT_ENGINE"] = engine
+    st = mk_store()
+    # warm: jit compile (array), lib load (native), code paths hot
+    st.apply_changes(copy.deepcopy(warm_batch))
+    t0 = time.monotonic()
+    total = 0
+    for batch in batches:
+        st.apply_changes(copy.deepcopy(batch))
+        total += len(batch)
+    wall = time.monotonic() - t0
+    st.close()
+    return {
+        "engine": engine,
+        "changes": total,
+        "wall_s": round(wall, 4),
+        "changes_per_s": round(total / wall) if wall > 0 else None,
+    }
+
+
+def bench_decision_only(engine: str, batch) -> dict:
+    """Phase B in isolation on a fresh-store snapshot (empty locals)."""
+    st = mk_store()
+    os.environ["CORRO_CRDT_ENGINE"] = engine
+    pks = {c.pk for c in batch}
+    base = {
+        pk: {"cl": 0, "clock": {}, "vals": {}, "disk": {}} for pk in pks
+    }
+
+    def run_once():
+        stx = copy.deepcopy(base)
+        plans = ({}, set(), {}, {}, set(), set())
+        if engine == "array":
+            from corrosion_tpu.ops.crdt_merge import merge_table_array
+
+            return merge_table_array(st, "kv", batch, stx, *plans)
+        if engine == "native":
+            lib = st._merge_lib
+            return st._merge_table_native(lib, "kv", batch, stx, *plans)
+        return st._merge_table_python("kv", batch, stx, *plans)
+
+    run_once()  # warm
+    reps = 5
+    t0 = time.monotonic()
+    for _ in range(reps):
+        out = run_once()
+    wall = (time.monotonic() - t0) / reps
+    st.close()
+    return {
+        "engine": engine,
+        "declined": out is None,
+        "decision_wall_s": round(wall, 5),
+        "decisions_per_s": round(len(batch) / wall) if wall > 0 else None,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tpu", action="store_true")
+    ap.add_argument("--sizes", default="512,4096,16384,65536")
+    ap.add_argument("--hot-rows", type=int, default=2048)
+    args = ap.parse_args()
+
+    if not args.tpu:
+        jaxenv.force_cpu_inprocess()
+    import jax
+
+    platform = jax.devices()[0].platform
+    sizes = [int(s) for s in args.sizes.split(",")]
+    rng = random.Random(1234)
+
+    results = {"platform": platform, "hot_rows": args.hot_rows, "rungs": []}
+    for size in sizes:
+        warm = synth_batch(rng, min(size, 2048), args.hot_rows)
+        batches = [synth_batch(rng, size, args.hot_rows) for _ in range(3)]
+        rung = {"batch_size": size, "end_to_end": [], "decision_only": []}
+        for engine in ("python", "native", "array"):
+            rung["end_to_end"].append(
+                bench_engine(engine, batches, warm)
+            )
+            rung["decision_only"].append(
+                bench_decision_only(engine, batches[0])
+            )
+            print(
+                f"[{size}] {engine}: e2e {rung['end_to_end'][-1]}"
+                f" decision {rung['decision_only'][-1]}",
+                flush=True,
+            )
+        results["rungs"].append(rung)
+
+    results["measured_at"] = time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime())
+    with open(os.path.join(REPO, "CRDT_MERGE_AB.json"), "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps({"metric": "crdt_merge_ab", "platform": platform,
+                      "rungs": len(results["rungs"])}))
+
+
+if __name__ == "__main__":
+    main()
