@@ -1,0 +1,36 @@
+"""The driver's multichip dryrun gate, kept green in CI at reduced n.
+
+`__graft_entry__._dryrun_body` is a correctness gate (boot, 1% crash
+detection, partition/heal with split-brain proof, sharded pview churn) —
+this runs the identical body on the test session's 8-device virtual CPU
+mesh with a smaller member count so regressions surface before the
+driver runs the full n=8192 gate.
+"""
+
+import json
+import os
+import sys
+
+
+def test_dryrun_gate_small_n(monkeypatch, capsys):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import __graft_entry__ as g
+
+    monkeypatch.setenv("GRAFT_DRYRUN_N", "1024")
+    g._dryrun_body(8)
+    out = capsys.readouterr().out
+    line = next(
+        ln for ln in out.splitlines() if ln.startswith("dryrun_multichip: ")
+    )
+    summary = json.loads(line.split(": ", 1)[1])
+    assert summary["n"] == 1024
+    assert summary["boot"]["coverage"] >= 0.99
+    assert summary["churn"]["detected"] >= 0.99
+    assert summary["churn"]["false_positive"] == 0.0
+    # split-brain actually formed, then healed clean
+    assert summary["partitioned"]["coverage"] < 0.9
+    assert summary["healed"]["coverage"] >= 0.99
+    assert summary["healed"]["false_positive"] == 0.0
+    assert summary["pview_churn"]["detected"] >= 0.99
+    assert summary["pview_churn"]["false_positive"] == 0.0
