@@ -295,8 +295,12 @@ def _stored_tpu_record(n: int) -> tuple[dict | None, str | None]:
             "BENCH_INBOX_IMPL", "gsort"
         ):
             return None, "replay-rejected:inbox-impl-mismatch"
+        # stored records without the field predate the knob (pick era);
+        # the env default must track the kernel's CURRENT default
+        # ("shift" since the r5 flip) so a replay always describes what
+        # a live run would measure
         if det.get("gossip_mode", "pick") != os.environ.get(
-            "BENCH_GOSSIP_MODE", "pick"
+            "BENCH_GOSSIP_MODE", "shift"
         ):
             return None, "replay-rejected:gossip-mode-mismatch"
         if det.get("stable_tick") is None:

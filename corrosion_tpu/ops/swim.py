@@ -89,16 +89,20 @@ class SwimParams(NamedTuple):
     # destination; ~20% faster tick at n=10k on the CPU fallback, default),
     # or "pallas" (sequential grouped scatter kernel, ops/inbox_pallas.py).
     # All three are bit-equal (tests/test_inbox_impls.py).
-    gossip_mode: str = "pick"  # gossip target selection: "pick" (each
-    # member independently picks known-alive targets; delivery needs the
-    # sort-based inbox build above) or "shift" (per-(tick, fanout-slot)
+    gossip_mode: str = "shift"  # gossip target selection: "shift"
+    # (default — r5 decision, COMPONENTS.md): per-(tick, fanout-slot)
     # random GLOBAL offsets: member i sends slot j's packet to
     # (i + off_j) mod n, so delivery is an exact row gather — no sort,
     # no bounded-inbox drop, and no target-pick view scans.  The same
     # rotating-permutation idea as the feed windows; per-tick random
     # offsets keep partner choice decorrelated across ticks.  Targets
-    # are no longer alive-biased: sends to dead members are masked and
-    # wasted, a small overhead at realistic churn).
+    # are not alive-biased: sends to dead members are masked and
+    # wasted, a small overhead at realistic churn.  "pick": each member
+    # independently picks known-alive targets; delivery needs the
+    # sort-based inbox build above.  Decided on the measured CPU A/B
+    # (shift 11.70 s / stable_tick 55 vs pick 14.16 s / 70 at n=10k,
+    # PROFILE.md) after the chip window never came; revert criterion
+    # recorded in COMPONENTS.md.
 
 
 VIEW_DTYPE = jnp.int16

@@ -118,16 +118,17 @@ def battery_steps() -> list[tuple[str, list[str], dict, float, str]]:
         ("bench40k",
          [py, "-u", "bench.py"],
          {**bench_env, "BENCH_N": "40000"}, 2400.0, "BENCH_TPU_40k.json"),
-        # the sortless shift-gossip A/B (on CPU: fewer ticks to converge
-        # AND a cheaper tick) — if it wins on chip it becomes the default
-        ("bench10k_shift",
+        # shift is the default since the r5 flip (COMPONENTS.md); the
+        # A/B direction reverses — these measure the OLD pick mode so a
+        # chip window can still overturn the CPU-evidence decision
+        ("bench10k_pick",
          [py, "-u", "bench.py"],
-         {**bench_env, "BENCH_N": "10000", "BENCH_GOSSIP_MODE": "shift"},
-         1500.0, "BENCH_TPU_10k_shift.json"),
-        ("bench40k_shift",
+         {**bench_env, "BENCH_N": "10000", "BENCH_GOSSIP_MODE": "pick"},
+         1500.0, "BENCH_TPU_10k_pick.json"),
+        ("bench40k_pick",
          [py, "-u", "bench.py"],
-         {**bench_env, "BENCH_N": "40000", "BENCH_GOSSIP_MODE": "shift"},
-         2400.0, "BENCH_TPU_40k_shift.json"),
+         {**bench_env, "BENCH_N": "40000", "BENCH_GOSSIP_MODE": "pick"},
+         2400.0, "BENCH_TPU_40k_pick.json"),
         # VERDICT r3 item 2 quality bar on chip: pv_coverage >= 0.99 then
         # 1% churn -> cluster-wide detection with FP 0, at 100k and 262k
         ("pview100k_conv",

@@ -31,7 +31,7 @@ def _record(n: int, **detail_overrides) -> dict:
         "record_every": 25,
         "coverage_target": 0.999,
         "inbox_impl": "gsort",
-        "gossip_mode": "pick",
+        "gossip_mode": "shift",  # the kernel default since the r5 flip
         "platform": "tpu",
         "measured_at": "2026-07-31 14:00:00",
         "code_sha": bench._code_fingerprint(),

@@ -362,7 +362,10 @@ def test_device_loop_matches_host_loop_convergence():
 
 
 def test_device_loop_nonconvergence_returns_none():
-    sim = ClusterSim(64, seed=12)
+    # loss=1.0 makes non-convergence deterministic (under the shift
+    # default, 64 members can genuinely converge inside 5 lossless
+    # ticks — the old premise)
+    sim = ClusterSim(64, seed=12, loss=1.0)
     out = sim.run_until_stable_device(
         coverage_target=1.0, max_ticks=5, check_every=5
     )
