@@ -229,7 +229,7 @@ def process_multiple_changes(
             to_apply_later: List[int] = []
             for cv in by_actor[actor_id]:
                 impactful = _process_one(
-                    agent, actor_id, cv, bv, observed, to_apply_later
+                    agent, actor_id, cv, bv, snap, observed, to_apply_later
                 )
                 all_impactful.extend(impactful)
             snap.insert_db(agent.store.gap_store(), observed)
@@ -245,7 +245,7 @@ def process_multiple_changes(
     )
 
 
-def _process_one(agent, actor_id, cv, bv, observed, to_apply_later) -> list:
+def _process_one(agent, actor_id, cv, bv, snap, observed, to_apply_later) -> list:
     cs = cv.changeset
     store = agent.store
 
@@ -279,6 +279,13 @@ def _process_one(agent, actor_id, cv, bv, observed, to_apply_later) -> list:
             seqs=RangeSet([cs.seqs]), last_seq=cs.last_seq, ts=cs.ts
         ),
     )
+    # the batch snapshot predates this insert and commit_snapshot
+    # REPLACES bv.partials with the snapshot's dict, so a partial first
+    # seen in this batch must be mirrored into the snapshot or it is
+    # silently wiped at commit — after which later chunks dedupe as
+    # "already present" and generate_sync reports nothing to repair:
+    # the version is lost until a full re-sync (r5 chaos-soak find)
+    snap.partials[cs.version] = partial
     # partial versions are observed (KnownDbVersion::Partial) — the gap
     # algebra must not re-mark them needed when later versions land
     observed.insert(cs.version, cs.version)
@@ -294,15 +301,20 @@ def process_fully_buffered(agent: Agent, actor_id: ActorId, version: int):
 
     store = agent.store
     changes = store.take_buffered_version(actor_id, version)
-    if changes and invariants.enabled():
-        # seqs of a fully-buffered version must be gap-free before the
-        # drain (ref assert_always "contiguous seq ranges", util.rs:1170)
-        seqs = sorted(c.seq for c in changes)
-        invariants.assert_always(
-            all(b - a <= 1 for a, b in zip(seqs, seqs[1:])),
-            "buffered.seqs_contiguous",
-            {"actor": str(actor_id), "version": version},
-        )
+    if changes:
+        if invariants.enabled():
+            # seqs of a fully-buffered version must be gap-free before
+            # the drain (ref assert_always "contiguous seq ranges",
+            # util.rs:1170) — the sort is the expensive part, so only
+            # the CHECK sits behind the mode gate
+            seqs = sorted(c.seq for c in changes)
+            invariants.assert_always(
+                all(b - a <= 1 for a, b in zip(seqs, seqs[1:])),
+                "buffered.seqs_contiguous",
+                {"actor": str(actor_id), "version": version},
+            )
+        # the coverage marker is cheap and must record in every mode —
+        # the soak's sometimes-contract depends on it
         invariants.assert_sometimes("buffered version drained")
     impactful = []
     if changes:
