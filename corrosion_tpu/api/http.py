@@ -80,10 +80,13 @@ class ApiServer:
         # hold the runner open indefinitely on cleanup
         self._runner = web.AppRunner(self.build_app(), shutdown_timeout=2.0)
         await self._runner.setup()
-        # the aiohttp app binds one internal loopback port; every public
-        # bind addr gets a dual-protocol front-end (api/h2front.py) that
-        # terminates HTTP/2 and passes HTTP/1.1 bytes through — the
-        # reference's hyper auto-mode server on the same port
+        # the aiohttp app binds one internal loopback port serving the
+        # HTTP/1.1 side; every public bind addr gets a dual-protocol
+        # front-end (api/h2front.py) — the reference's hyper auto-mode
+        # server on one port.  HTTP/2 is served NATIVELY against the
+        # same Application (route resolution + middleware chain +
+        # streaming responses as h2 frames); only h1 bytes take the
+        # loopback pass-through to aiohttp's own parser.
         site = web.TCPSite(self._runner, "127.0.0.1", 0)
         await site.start()
         internal_port = site._server.sockets[0].getsockname()[1]
@@ -94,6 +97,7 @@ class ApiServer:
             front = ApiFrontend(
                 "127.0.0.1", internal_port,
                 host=host or "127.0.0.1", port=int(port),
+                app=self._runner.app,
             )
             await front.start()
             self._fronts.append(front)
