@@ -72,7 +72,11 @@ def snapshot_membership(agent) -> Dict[ActorId, str]:
     """Serialize the live SWIM view (non-down members, like the reference
     which persists foca's active member set)."""
     out: Dict[ActorId, str] = {}
-    for aid, m in agent.membership.members.items():
+    # runs on a worker thread (member_states_loop's to_thread) while the
+    # event loop mutates membership: dict(d) is a single GIL-held copy,
+    # iterating the live dict raised "changed size during iteration"
+    # under absorption load
+    for aid, m in dict(agent.membership.members).items():
         if m.state == MemberState.DOWN:
             continue
         out[aid] = _state_json(m.actor, m.incarnation, m.state)

@@ -129,11 +129,15 @@ class Membership:
         self.on_notification = on_notification or (lambda n, a: None)
         self.members: Dict[ActorId, _Member] = {}
         self.downed: Dict[ActorId, float] = {}  # id -> when declared down
-        self._queue: List[_Dissemination] = []
+        # dissemination queue keyed by subject: one live assertion per
+        # actor (a newer assertion replaces the queued one in O(1));
+        # insertion order doubles as freshness order for _piggyback
+        self._queue: Dict[ActorId, _Dissemination] = {}
         self._incarnation = 0
         self._probe_no = 0
         self._pending: Dict[int, _Probe] = {}
         self._probe_ring: List[ActorId] = []
+        self._ring_set: set = set()  # O(1) membership for the hot add path
         self._probe_pos = 0
         self._tasks: List[asyncio.Task] = []
 
@@ -141,9 +145,11 @@ class Membership:
 
     @property
     def cluster_size(self) -> int:
-        return 1 + sum(
-            1 for m in self.members.values() if m.state != MemberState.DOWN
-        )
+        # members never retains DOWN entries (every DOWN transition
+        # deletes, _apply_update:278/308), so the active count is just
+        # the dict size — this is on the per-update hot path during mass
+        # absorption and an O(N) sum here made absorption quadratic
+        return 1 + len(self.members)
 
     def active_members(self) -> List[Actor]:
         return [
@@ -222,35 +228,40 @@ class Membership:
             METRICS.counter("corro.gossip.send.failed").inc()
 
     def _piggyback(self, msg: SwimMessage) -> None:
-        """Fill the remaining packet budget with queued updates, fewest
-        sends first (infection-style dissemination)."""
+        """Fill the remaining packet budget with queued updates, newest
+        assertions first (infection-style dissemination: fresh updates
+        have the most sends left — iterating insertion order backwards
+        gives the same priority as the old sort without the O(Q log Q)
+        per packet, and the fill stops at the packet budget instead of
+        scanning the whole queue)."""
         budget = MAX_PACKET - 64 - actor_wire_size(msg.sender)
         if msg.target:
             budget -= actor_wire_size(msg.target)
         if msg.origin:
             budget -= actor_wire_size(msg.origin)
-        self._queue.sort(key=lambda d: -d.sends_left)
-        kept: List[_Dissemination] = []
-        for d in self._queue:
+        if not self._queue:
+            return
+        spent: List[ActorId] = []
+        for aid in reversed(list(self._queue)):
+            d = self._queue[aid]
             size = update_wire_size(d.update)
-            if budget - size >= 0 and len(msg.updates) < 64:
-                msg.updates.append(d.update)
-                budget -= size
-                d.sends_left -= 1
-                if d.sends_left > 0:
-                    kept.append(d)
-            else:
-                kept.append(d)
-        self._queue = kept
+            if budget - size < 0 or len(msg.updates) >= 64:
+                break
+            msg.updates.append(d.update)
+            budget -= size
+            d.sends_left -= 1
+            if d.sends_left <= 0:
+                spent.append(aid)
+        for aid in spent:
+            self._queue.pop(aid, None)
 
     def _disseminate(self, update: MemberUpdate) -> None:
         n = self.cluster_size
-        # replace any queued assertion about the same actor
-        self._queue = [
-            d for d in self._queue if d.update.actor.id != update.actor.id
-        ]
-        self._queue.append(
-            _Dissemination(update, self.config.max_transmissions(n))
+        # replace any queued assertion about the same actor (O(1): the
+        # queue is keyed by subject), re-entering at the fresh end
+        self._queue.pop(update.actor.id, None)
+        self._queue[update.actor.id] = _Dissemination(
+            update, self.config.max_transmissions(n)
         )
 
     # -- update application -------------------------------------------------
@@ -288,8 +299,9 @@ class Membership:
                 actor=u.actor, incarnation=u.incarnation, state=u.state
             )
             self.downed.pop(u.actor.id, None)
-            if u.actor.id not in self._probe_ring:
+            if u.actor.id not in self._ring_set:
                 self._probe_ring.append(u.actor.id)
+                self._ring_set.add(u.actor.id)
             self._disseminate(u)
             # fires for renewed identities too: Members.add_member must
             # refresh to the new ts/bump
@@ -432,21 +444,26 @@ class Membership:
     # -- probe cycle ---------------------------------------------------------
 
     def _next_probe_target(self) -> Optional[Actor]:
-        ring = [
-            aid
-            for aid in self._probe_ring
-            if aid in self.members
-            and self.members[aid].state != MemberState.DOWN
-        ]
-        self._probe_ring = ring
-        if not ring:
-            return None
-        if self._probe_pos >= len(ring):
-            self.rng.shuffle(self._probe_ring)
-            self._probe_pos = 0
-        actor_id = self._probe_ring[self._probe_pos]
-        self._probe_pos += 1
-        return self.members[actor_id].actor
+        # departed members are skipped inline and compacted out once per
+        # ring cycle — rebuilding the whole ring per probe was O(N) on
+        # the probe cadence
+        while self._probe_ring:
+            if self._probe_pos >= len(self._probe_ring):
+                self._probe_ring = [
+                    aid for aid in self._probe_ring if aid in self.members
+                ]
+                self._ring_set = set(self._probe_ring)
+                self._probe_pos = 0
+                if not self._probe_ring:
+                    return None
+                self.rng.shuffle(self._probe_ring)
+                continue
+            actor_id = self._probe_ring[self._probe_pos]
+            self._probe_pos += 1
+            m = self.members.get(actor_id)
+            if m is not None and m.state != MemberState.DOWN:
+                return m.actor
+        return None
 
     async def _probe_loop(self, tripwire: Tripwire) -> None:
         cfg = self.config

@@ -83,8 +83,12 @@ class KernelPeerBridge:
         self.piggyback = piggyback
         self.prefix = addr_prefix
         self._rng = np.random.default_rng(seed)
-        self._alive = np.ones(self.n, dtype=bool)
-        self._inc = np.zeros(self.n, dtype=np.int32)
+        self._alive: Optional[np.ndarray] = None
+        self._inc: Optional[np.ndarray] = None
+        # hot-update queue: member -> send count (reference max_transmissions
+        # decay); fed by refresh() diffs, drained into every piggyback
+        self._hot: Dict[int, int] = {}
+        self.max_transmissions = 10
         self._listeners: List = []
         self._actors: Dict[int, Actor] = {}
         self.refresh()
@@ -115,10 +119,23 @@ class KernelPeerBridge:
 
     def refresh(self) -> None:
         """Re-snapshot ground truth from the kernel arrays (call after
-        sim.step() / crash / restart)."""
+        sim.step() / crash / restart).  Members whose (alive, inc)
+        changed since the last snapshot enter the hot-update queue:
+        piggyback carries FRESH updates first with a send-count decay —
+        the reference's dissemination shape (`broadcast/mod.rs:653-779`
+        re-send decay), without which a dead member's DOWN only reaches
+        a peer by uniform-random luck (~n/piggyback replies at scale)."""
         state = self.sim.state
-        self._alive = np.asarray(state.alive).astype(bool)
-        self._inc = np.asarray(state.inc, dtype=np.int32)
+        alive = np.asarray(state.alive).astype(bool)
+        inc = np.asarray(state.inc, dtype=np.int32)
+        if self._alive is not None:
+            changed = np.nonzero(
+                (alive != self._alive) | (inc != self._inc)
+            )[0]
+            for j in changed:
+                self._hot[int(j)] = 0  # reset send count
+        self._alive = alive
+        self._inc = inc
 
     def crash(self, j: int) -> None:
         self.sim.crash(j)
@@ -148,24 +165,44 @@ class KernelPeerBridge:
 
     # -- wire handling -------------------------------------------------------
 
+    def _update_for(self, j: int) -> MemberUpdate:
+        return MemberUpdate(
+            self.actor(j),
+            int(self._inc[j]),
+            MemberState.ALIVE if self._alive[j] else MemberState.DOWN,
+        )
+
     def _sample_updates(self, exclude: int) -> List[MemberUpdate]:
-        """Random piggyback sample of virtual members (size-capped by
+        """Piggyback: hot (recently changed) updates first with a
+        send-count decay, then a random fill (size-capped by
         fill_updates at send time)."""
         out: List[MemberUpdate] = []
+        if self._hot:
+            spent = []
+            for j, sent in self._hot.items():
+                if j == exclude:
+                    continue
+                if not self._alive[j] and not self.gossip_down:
+                    continue
+                out.append(self._update_for(j))
+                self._hot[j] = sent + 1
+                if sent + 1 >= self.max_transmissions:
+                    spent.append(j)
+                if len(out) >= self.piggyback:
+                    break
+            for j in spent:
+                self._hot.pop(j, None)
         count = min(self.piggyback * 2, self.n)
-        for j in self._rng.choice(self.n, size=count, replace=False):
+        # with-replacement sampling: choice(replace=False) materializes
+        # an O(n) permutation PER REPLY, which dominates at 100k members;
+        # duplicate picks just waste a slot in a size-capped sample
+        for j in self._rng.integers(0, self.n, size=count):
             j = int(j)
             if j == exclude:
                 continue
             if not self._alive[j] and not self.gossip_down:
                 continue
-            out.append(
-                MemberUpdate(
-                    self.actor(j),
-                    int(self._inc[j]),
-                    MemberState.ALIVE if self._alive[j] else MemberState.DOWN,
-                )
-            )
+            out.append(self._update_for(j))
             if len(out) >= self.piggyback:
                 break
         return out

@@ -8,7 +8,22 @@ production membership pipeline against thousands of peers. Records
 absorption time (announce → full member table) and silent-crash
 detection latency at the configured scale into BRIDGE_SCALE.json.
 
-Usage: python scripts/bridge_scale.py [n_sim] [n_crash]   (default 10000 20)
+Usage: python scripts/bridge_scale.py [n_sim] [n_crash] [mode]
+       (default 10000 20 silent)
+
+Detection modes:
+  silent — crashed virtual members just go quiet; the ONE real agent's
+           own probe/suspicion pipeline must find them. Detection is
+           probe-sweep-bound (~n * probe_period), which is the honest
+           single-prober physics: this mode pins the production
+           pipeline and is the default through the 10k rung.
+  gossip — the bridge gossips the kernel's ground-truth DOWNs (the
+           bridge default in production use): detection reaches the
+           agent epidemically, the way a real n-member cluster
+           collectively detects (whoever probes the dead gossips it).
+           The 100k rung uses this mode — a lone prober sweeping 100k
+           members would need ~84 min per cycle by construction, not
+           by defect.
 """
 
 from __future__ import annotations
@@ -35,10 +50,10 @@ from corrosion_tpu.runtime.records import merge_records  # noqa: E402
 from tests.test_agent import boot, wait_until  # noqa: E402
 
 
-async def main(n_sim: int, n_crash: int) -> dict:
+async def main(n_sim: int, n_crash: int, mode: str = "silent") -> dict:
     net = MemNetwork(seed=11)
     sim = ClusterSim(n_sim, seed=3)
-    bridge = KernelPeerBridge(net, sim, seed=5, gossip_down=False)
+    bridge = KernelPeerBridge(net, sim, seed=5, gossip_down=(mode == "gossip"))
     bridge.start()
     agent = await boot(net, "agent-real")
     ms = agent.membership
@@ -74,9 +89,10 @@ async def main(n_sim: int, n_crash: int) -> dict:
         print(f"detected={detected} in {detect_s:.1f}s fp={len(fp)}",
               flush=True)
         return {
-            "rung": f"bridge-{n_sim}",
+            "rung": f"bridge-{n_sim}" + ("" if mode == "silent" else f"-{mode}"),
             "n_sim": n_sim,
             "n_crash": len(dead),
+            "mode": mode,
             "absorbed": absorbed,
             "absorb_s": round(absorb_s, 1),
             "detected": detected,
@@ -94,6 +110,7 @@ async def main(n_sim: int, n_crash: int) -> dict:
 if __name__ == "__main__":
     n_sim = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
     n_crash = int(sys.argv[2]) if len(sys.argv) > 2 else 20
-    rec = asyncio.run(main(n_sim, n_crash))
+    mode = sys.argv[3] if len(sys.argv) > 3 else "silent"
+    rec = asyncio.run(main(n_sim, n_crash, mode))
     merge_records(os.path.join(REPO, "BRIDGE_SCALE.json"), [rec])
     print(json.dumps(rec))
