@@ -78,7 +78,9 @@ def collect_once(agent) -> None:
     from corrosion_tpu.agent.membership import MemberState
 
     by_state = {s.name: 0 for s in MemberState}
-    for m in agent.membership.members.values():
+    # worker thread (metrics_loop's to_thread) vs event-loop mutation:
+    # copy under the GIL before iterating
+    for m in list(agent.membership.members.values()):
         by_state[m.state.name] = by_state.get(m.state.name, 0) + 1
     for name, count in by_state.items():
         METRICS.gauge("corro.gossip.member.states", state=name).set(count)

@@ -47,7 +47,7 @@ from corrosion_tpu.models.cluster import ClusterSim  # noqa: E402
 from corrosion_tpu.net.mem import MemNetwork  # noqa: E402
 from corrosion_tpu.runtime.records import merge_records  # noqa: E402
 
-from tests.test_agent import boot, wait_until  # noqa: E402
+from tests.test_agent import boot, wait_progress, wait_until  # noqa: E402
 
 
 async def main(n_sim: int, n_crash: int, mode: str = "silent") -> dict:
@@ -60,8 +60,12 @@ async def main(n_sim: int, n_crash: int, mode: str = "silent") -> dict:
     try:
         t0 = time.monotonic()
         await ms.announce(bridge.addr(0))
-        absorbed = await wait_until(
-            lambda: ms.cluster_size >= n_sim + 1, timeout=600.0, step=0.25
+        # progress-based (r4 weak #6 pattern): absorption may take
+        # minutes at 100k — only a genuine STALL fails the rung
+        absorbed = await wait_progress(
+            lambda: ms.cluster_size >= n_sim + 1,
+            lambda: ms.cluster_size,
+            stall=60.0, cap=3600.0, step=0.25,
         )
         absorb_s = time.monotonic() - t0
         print(f"absorbed={absorbed} size={ms.cluster_size} "
