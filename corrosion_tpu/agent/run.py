@@ -82,10 +82,14 @@ async def setup(
                 "(set gossip.plaintext = true, or use the tcp transport "
                 "with [gossip.tls])"
             )
-        from corrosion_tpu.net.quic import QuicEndpoint, QuicTransport
+        from corrosion_tpu.net.quic import MAX_UDP, QuicEndpoint, QuicTransport
 
         host, port = split_addr(config.gossip.bind_addr)
-        listener = await QuicEndpoint.bind(host or "127.0.0.1", port)
+        listener = await QuicEndpoint.bind(
+            host or "127.0.0.1", port,
+            # gossip.max_mtu (api/peer/mod.rs:121-150 fixed-MTU knob)
+            mtu=min(config.gossip.max_mtu or MAX_UDP, MAX_UDP),
+        )
         transport = QuicTransport(
             listener, idle_timeout=float(config.gossip.idle_timeout_secs)
         )

@@ -504,6 +504,9 @@ class QuicConnection:
         self.srtt: Optional[float] = None
         self.last_recv = time.monotonic()
         self.idle_timeout = 30.0
+        # gossip.max_mtu (the reference's fixed-MTU knob,
+        # api/peer/mod.rs:121-150): caps every datagram this end builds
+        self.mtu = min(endpoint.mtu, MAX_UDP)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -513,7 +516,7 @@ class QuicConnection:
     def local_transport_params(self) -> bytes:
         params: Dict[int, object] = {
             TP_IDLE: int(self.idle_timeout * 1000),
-            TP_MAX_UDP: MAX_UDP,
+            TP_MAX_UDP: self.mtu,
             TP_MAX_DATA: LOCAL_MAX_DATA,
             TP_MSD_BIDI_LOCAL: LOCAL_MAX_STREAM_DATA,
             TP_MSD_BIDI_REMOTE: LOCAL_MAX_STREAM_DATA,
@@ -647,7 +650,7 @@ class QuicConnection:
         # the bound must match the flush gate (MAX_UDP - 96 headroom for
         # packet overhead): an admitted-but-unsendable datagram would
         # block the queue head forever
-        if len(data) + 3 > min(self.max_datagram_remote or 0, MAX_UDP - 96):
+        if len(data) + 3 > min(self.max_datagram_remote or 0, self.mtu - 96):
             raise QuicError("datagram too large for peer")
         self._dgram_queue.append(data)
         await self.flush()
@@ -768,7 +771,7 @@ class QuicConnection:
             # for retransmission — a lost MAX_DATA/MAX_STREAMS would
             # otherwise deadlock the peer until idle timeout (values are
             # monotone maxima, so re-sending a stale one is harmless)
-            while self.pending_other and len(frames) < MAX_UDP - 200:
+            while self.pending_other and len(frames) < self.mtu - 200:
                 fr = self.pending_other.pop(0)
                 frames += fr
                 track.append(("other", fr))
@@ -776,7 +779,7 @@ class QuicConnection:
             # datagrams
             while self._dgram_queue:
                 d = self._dgram_queue[0]
-                if len(frames) + len(d) + 3 > MAX_UDP - 96:
+                if len(frames) + len(d) + 3 > self.mtu - 96:
                     break
                 self._dgram_queue.pop(0)
                 frames += vint(F_DATAGRAM_LEN) + vint(len(d)) + d
@@ -787,7 +790,7 @@ class QuicConnection:
             for st in list(self.send_streams.values()):
                 while st.pending:
                     off, data, fin = st.pending[0]
-                    room = MAX_UDP - 96 - len(frames)
+                    room = self.mtu - 96 - len(frames)
                     credit = min(
                         st.credit - off,
                         self.max_data_remote - self.data_sent,
@@ -816,7 +819,7 @@ class QuicConnection:
                     st.highwater = max(st.highwater, off + len(data))
                     self.data_sent += new_bytes
                     eliciting = True
-                if len(frames) > MAX_UDP - 200:
+                if len(frames) > self.mtu - 200:
                     break
         if not frames:
             return b"", [], False
@@ -1254,7 +1257,8 @@ class QuicEndpoint(Listener):
     connections (`handlers.rs:54-190`) while the Transport dials outbound
     from the same identity."""
 
-    def __init__(self) -> None:
+    def __init__(self, mtu: int = MAX_UDP) -> None:
+        self.mtu = min(mtu, MAX_UDP)
         self._udp_transport = None
         self._addr = ""
         self.conns_by_scid: Dict[bytes, QuicConnection] = {}
@@ -1267,8 +1271,9 @@ class QuicEndpoint(Listener):
         self._handler_tasks: set = set()
 
     @classmethod
-    async def bind(cls, host: str = "127.0.0.1", port: int = 0) -> "QuicEndpoint":
-        self = cls()
+    async def bind(cls, host: str = "127.0.0.1", port: int = 0,
+                   mtu: int = MAX_UDP) -> "QuicEndpoint":
+        self = cls(mtu=mtu)
         loop = asyncio.get_event_loop()
         self._udp_transport, _ = await loop.create_datagram_endpoint(
             lambda: _UdpProto(self), local_addr=(host, port)

@@ -478,3 +478,45 @@ def test_quic_requires_plaintext_mode():
             await setup(cfg)
 
     asyncio.new_event_loop().run_until_complete(main())
+
+
+def test_mtu_knob_caps_datagrams():
+    """gossip.max_mtu parity (api/peer/mod.rs:121-150): an endpoint
+    bound with a smaller MTU advertises it and never emits a larger
+    UDP payload."""
+
+    async def main():
+        got = []
+
+        async def on_dgram(src, data):
+            got.append(data)
+
+        async def nope(*a):
+            pass
+
+        server = await QuicEndpoint.bind("127.0.0.1", 0, mtu=1300)
+        server.serve(on_dgram, nope, nope)
+        client = await QuicEndpoint.bind("127.0.0.1", 0, mtu=1300)
+        sizes = []
+        real = client._sendto
+
+        def spy(data, peer):
+            sizes.append(len(data))
+            real(data, peer)
+
+        client._sendto = spy
+        t = QuicTransport(client)
+        await t.send_datagram(server.addr, b"x" * 1100)
+        await asyncio.sleep(0.2)
+        assert got == [b"x" * 1100]
+        assert max(sizes) <= 1300
+        conn = t._conns[server.addr]
+        import pytest as _pytest
+
+        with _pytest.raises(Exception, match="too large"):
+            await conn.send_datagram(b"y" * 1290)
+        await t.close()
+        await client.close()
+        await server.close()
+
+    asyncio.new_event_loop().run_until_complete(asyncio.wait_for(main(), 30))
