@@ -280,3 +280,45 @@ def test_loop_lag_monitor():
     reg = METRICS.render_prometheus()
     assert "corro_runtime_loop_ticks" in reg or "corro.runtime.loop.ticks" in reg
     assert "loop_lag" in reg.replace(".", "_") or "lag" in reg
+
+
+def test_wait_progress_semantics():
+    """The soak-wait primitive: succeeds on pred, tolerates slow but
+    steady progress past the stall bound, fails fast on a true stall,
+    and caps livelock (progress forever, pred never)."""
+    import asyncio
+
+    from tests.test_agent import wait_progress
+
+    async def main():
+        # pred already true
+        assert await wait_progress(lambda: True, lambda: 0)
+
+        # steady progress, pred turns true after > stall worth of wall
+        state = {"n": 0}
+
+        def prog():
+            state["n"] += 1
+            return state["n"]
+
+        t0 = asyncio.get_event_loop().time()
+        assert await wait_progress(
+            lambda: asyncio.get_event_loop().time() - t0 > 0.4,
+            prog, stall=0.15, step=0.02,
+        )
+
+        # true stall: frozen progress fails after ~stall, well under cap
+        t0 = asyncio.get_event_loop().time()
+        assert not await wait_progress(
+            lambda: False, lambda: 42, stall=0.2, cap=30.0, step=0.02
+        )
+        assert asyncio.get_event_loop().time() - t0 < 2.0
+
+        # livelock: progress keeps changing, cap bounds the wait
+        t0 = asyncio.get_event_loop().time()
+        assert not await wait_progress(
+            lambda: False, prog, stall=5.0, cap=0.3, step=0.02
+        )
+        assert asyncio.get_event_loop().time() - t0 < 2.0
+
+    asyncio.run(main())
