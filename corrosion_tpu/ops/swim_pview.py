@@ -722,7 +722,12 @@ def _stats_impl(params: PViewParams, packed, alive, t):
         .at[jnp.where(ka_entry, subj, 0)]
         .add(ka_entry.astype(jnp.int32))
     )
-    total_entries = jnp.sum(ka_entry & subj_alive)
+    # float32 accumulators: bool sums default to int32, and n·slots
+    # crosses 2^31 at n=2M×K=2048 — the wrapped total made `expected`
+    # negative and the pv_coverage threshold vacuously true (caught on
+    # the first 2M rung; float32's ~2^-24 relative rounding is
+    # irrelevant for a mean)
+    total_entries = jnp.sum((ka_entry & subj_alive).astype(jnp.float32))
     expected = total_entries / n_alive  # mean in-degree over live subjects
     live_indeg = jnp.where(alive, indeg, jnp.int32(INT32_MAX))
     min_in = jnp.min(live_indeg)
@@ -730,8 +735,12 @@ def _stats_impl(params: PViewParams, packed, alive, t):
         jnp.where(alive, (indeg.astype(jnp.float32) >= expected * 0.5), False)
     ) / n_alive
     fp_entries = occupied & (prec >= PREC_SUSPECT) & live_obs & subj_alive
-    fp = jnp.sum(fp_entries) / jnp.maximum(jnp.sum(af) * (n_alive - 1), 1.0)
-    occ = jnp.sum(occupied & live_obs) / (n_alive * params.slots)
+    fp = jnp.sum(fp_entries.astype(jnp.float32)) / jnp.maximum(
+        jnp.sum(af) * (n_alive - 1), 1.0
+    )
+    occ = jnp.sum((occupied & live_obs).astype(jnp.float32)) / (
+        n_alive * params.slots
+    )
     # churn detection: a dead member counts as DETECTED when no live
     # observer still holds an ALIVE entry for it (suspect/down entries and
     # absence both mean "won't be routed to") — the partial-view analog of
