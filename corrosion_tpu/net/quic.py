@@ -34,6 +34,15 @@ the wire format follows RFC 9000/9221 and the reference's tag scheme so
 a real quinn+quinn_plaintext peer is expected to accept it, but that
 final step is unverified here.  The SeaHash tag primitive IS verified
 against the seahash crate's published vectors (tests/test_quic.py).
+
+Recorded deviations from quinn's endpoint shape (transport.rs:57-71,
+api/peer/mod.rs:121-150): one UDP socket instead of 8 hashed client
+endpoints (the spread dilutes per-socket kernel buffers under real
+kernel-path pressure; asyncio drains one datagram endpoint per wakeup
+and the bound port doubles as the node's reply identity), and no GSO
+(a sendmsg/UDP_SEGMENT batching optimization below the portable
+asyncio API; gossip datagrams are single-MTU).  gossip.max_mtu IS
+honored (QuicEndpoint.bind(mtu=...), advertised + enforced).
 """
 
 from __future__ import annotations
