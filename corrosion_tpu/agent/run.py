@@ -85,13 +85,37 @@ async def setup(
         from corrosion_tpu.net.quic import MAX_UDP, QuicEndpoint, QuicTransport
 
         host, port = split_addr(config.gossip.bind_addr)
+        # parse BEFORE binding anything: a malformed client_addr must
+        # not leave the gossip socket bound behind a config error
+        c_host, c_port = split_addr(config.gossip.client_addr or ":0")
+        mtu = min(config.gossip.max_mtu or MAX_UDP, MAX_UDP)
         listener = await QuicEndpoint.bind(
             host or "127.0.0.1", port,
             # gossip.max_mtu (api/peer/mod.rs:121-150 fixed-MTU knob)
-            mtu=min(config.gossip.max_mtu or MAX_UDP, MAX_UDP),
+            mtu=mtu,
         )
+        # outbound spread (transport.rs:57-71): 8 hashed dial-only
+        # sockets when client_addr's port is 0 (the default), 1 when an
+        # operator pinned a port
+        n_client = 8 if c_port == 0 else 1
+        client_eps = []
+        try:
+            for _ in range(n_client):
+                client_eps.append(await QuicEndpoint.bind(
+                    c_host or host or "127.0.0.1", c_port,
+                    mtu=mtu, accept_inbound=False,
+                ))
+        except OSError:
+            # e.g. a pinned client_addr port already in use: release
+            # everything bound so far or a setup() retry hits EADDRINUSE
+            # on our own gossip port
+            for ep in client_eps:
+                await ep.close()
+            await listener.close()
+            raise
         transport = QuicTransport(
-            listener, idle_timeout=float(config.gossip.idle_timeout_secs)
+            listener, idle_timeout=float(config.gossip.idle_timeout_secs),
+            client_endpoints=client_eps,
         )
     elif config.gossip.transport != "tcp":
         raise ValueError(

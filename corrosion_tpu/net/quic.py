@@ -35,14 +35,19 @@ a real quinn+quinn_plaintext peer is expected to accept it, but that
 final step is unverified here.  The SeaHash tag primitive IS verified
 against the seahash crate's published vectors (tests/test_quic.py).
 
-Recorded deviations from quinn's endpoint shape (transport.rs:57-71,
-api/peer/mod.rs:121-150): one UDP socket instead of 8 hashed client
-endpoints (the spread dilutes per-socket kernel buffers under real
-kernel-path pressure; asyncio drains one datagram endpoint per wakeup
-and the bound port doubles as the node's reply identity), and no GSO
-(a sendmsg/UDP_SEGMENT batching optimization below the portable
-asyncio API; gossip datagrams are single-MTU).  gossip.max_mtu IS
-honored (QuicEndpoint.bind(mtu=...), advertised + enforced).
+Endpoint shape (transport.rs:57-71, api/peer/mod.rs:121-150): like the
+reference, outbound dials spread across 8 hashed client sockets when
+gossip.client_addr has port 0 (the default), or use 1 socket bound to a
+fixed client_addr — `QuicTransport(client_endpoints=[...])`, picked by
+SeaHash of the peer addr mod the socket count, diluting per-socket
+kernel buffers under kernel-path pressure exactly as the reference's
+comment intends (the hash input differs: Rust hashes the SocketAddr
+struct via its Hash impl, we hash the canonical "host:port" bytes —
+both are stable per-peer, which is all the spread needs).  Remaining
+recorded deviation: no GSO (a sendmsg/UDP_SEGMENT batching optimization
+below the portable asyncio API; gossip datagrams are single-MTU).
+gossip.max_mtu IS honored (QuicEndpoint.bind(mtu=...), advertised +
+enforced).
 """
 
 from __future__ import annotations
@@ -1180,7 +1185,13 @@ class QuicConnection:
                     rtt = now - pkt.sent_at
                     self.srtt = rtt if self.srtt is None \
                         else 0.875 * self.srtt + 0.125 * rtt
-                    self.endpoint._observe_rtt(self.peer_addr, rtt)
+                    # dialer-side only (transport.rs rtt_tx feeds from the
+                    # client connect path): on inbound conns peer_addr is
+                    # the dialer's ephemeral spread socket, not a member
+                    # identity — keying members.rtts / per-addr metrics by
+                    # it would grow without bound and never hit the ring
+                    if self.is_client:
+                        self.endpoint._observe_rtt(self.peer_addr, rtt)
             sp.largest_acked = max(sp.largest_acked, hi)
         self.pto_count = 0
         if not self.is_client and space == S_HS:
@@ -1215,6 +1226,9 @@ class QuicConnection:
                         if not pkt.frames:
                             continue
                         fired = True
+                        # quinn path-stats analog (corro.transport.path.*):
+                        # a PTO-expired packet is declared lost
+                        METRICS.counter("corro.transport.path.lost_packets").inc()
                         for fr in pkt.frames:
                             self._requeue(space, fr)
                 if fired:
@@ -1266,8 +1280,13 @@ class QuicEndpoint(Listener):
     connections (`handlers.rs:54-190`) while the Transport dials outbound
     from the same identity."""
 
-    def __init__(self, mtu: int = MAX_UDP) -> None:
+    def __init__(self, mtu: int = MAX_UDP,
+                 accept_inbound: bool = True) -> None:
         self.mtu = min(mtu, MAX_UDP)
+        # dial-only spread sockets (quinn client endpoints accept no
+        # inbound): a stray Initial must not spawn a server-role
+        # connection + timer on an unauthenticated open port
+        self.accept_inbound = accept_inbound
         self._udp_transport = None
         self._addr = ""
         self.conns_by_scid: Dict[bytes, QuicConnection] = {}
@@ -1281,8 +1300,9 @@ class QuicEndpoint(Listener):
 
     @classmethod
     async def bind(cls, host: str = "127.0.0.1", port: int = 0,
-                   mtu: int = MAX_UDP) -> "QuicEndpoint":
-        self = cls(mtu=mtu)
+                   mtu: int = MAX_UDP,
+                   accept_inbound: bool = True) -> "QuicEndpoint":
+        self = cls(mtu=mtu, accept_inbound=accept_inbound)
         loop = asyncio.get_event_loop()
         self._udp_transport, _ = await loop.create_datagram_endpoint(
             lambda: _UdpProto(self), local_addr=(host, port)
@@ -1368,7 +1388,7 @@ class QuicEndpoint(Listener):
             if conn is not None:
                 return conn
             ptype = (first >> 4) & 0x03
-            if ptype == T_INITIAL:
+            if ptype == T_INITIAL and self.accept_inbound:
                 # new inbound connection (server role); lanes without a
                 # serve() handler simply drop their payloads
                 scl_pos = 6 + dcl
@@ -1426,15 +1446,34 @@ class QuicEndpoint(Listener):
 class QuicTransport(Transport):
     """Client half over a shared QuicEndpoint: cached connections per
     peer with one reconnect retry, RTT observations into the members
-    rings — the shape of `transport.rs:81-230`."""
+    rings — the shape of `transport.rs:81-230`.
+
+    When `client_endpoints` is given, outbound dials spread across those
+    dial-only sockets, picked by SeaHash of the peer addr mod the socket
+    count (`transport.rs:170-173` measured_connect) — the reference's
+    8-endpoint kernel-buffer dilution.  Without it, dials originate from
+    the serving endpoint (single-socket identity mode, used by tests and
+    standalone endpoints).  Peers never reply to the dialing socket's
+    source addr — SWIM replies go to the payload-embedded advertised
+    addr — so dial-only sockets need no serve() handlers."""
 
     def __init__(self, endpoint: QuicEndpoint,
-                 idle_timeout: float = 30.0) -> None:
+                 idle_timeout: float = 30.0,
+                 client_endpoints: Optional[List[QuicEndpoint]] = None,
+                 ) -> None:
         self._endpoint = endpoint
-        endpoint._rtt_sink = lambda addr, rtt: self.observe_rtt(addr, rtt)
+        self._client_eps = list(client_endpoints or [])
+        for ep in (endpoint, *self._client_eps):
+            ep._rtt_sink = lambda addr, rtt: self.observe_rtt(addr, rtt)
         self._idle_timeout = idle_timeout
         self._conns: Dict[str, QuicConnection] = {}
         self._locks: Dict[str, asyncio.Lock] = {}
+
+    def _dial_endpoint(self, addr: str) -> QuicEndpoint:
+        if not self._client_eps:
+            return self._endpoint
+        idx = seahash.hash_bytes(addr.encode()) % len(self._client_eps)
+        return self._client_eps[idx]
 
     async def _conn(self, addr: str) -> QuicConnection:
         conn = self._conns.get(addr)
@@ -1445,7 +1484,7 @@ class QuicTransport(Transport):
             conn = self._conns.get(addr)
             if conn is not None and not conn.closed.is_set():
                 return conn
-            conn = await self._endpoint.connect(addr)
+            conn = await self._dial_endpoint(addr).connect(addr)
             conn.idle_timeout = self._idle_timeout
             self._conns[addr] = conn
             METRICS.counter("corro.quic.connect.total").inc()
@@ -1488,4 +1527,6 @@ class QuicTransport(Transport):
     async def close(self) -> None:
         for conn in list(self._conns.values()):
             conn.close("transport closed")
+        for ep in self._client_eps:
+            await ep.close()
         self._conns.clear()

@@ -63,6 +63,11 @@ class GossipConfig:
     tls: GossipTlsConfig = field(default_factory=GossipTlsConfig)
     max_mtu: Optional[int] = None
     idle_timeout_secs: int = 30
+    # quic only: where outbound dials originate (config.rs:162-163,
+    # default [::]:0). Port 0 -> 8 hashed dial-only sockets
+    # (transport.rs:57-71 kernel-buffer dilution); a fixed port -> 1
+    # socket bound there.
+    client_addr: Optional[str] = None
 
     @property
     def tls_enabled(self) -> bool:

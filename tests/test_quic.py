@@ -463,6 +463,155 @@ def test_two_agents_replicate_over_quic():
     asyncio.new_event_loop().run_until_complete(asyncio.wait_for(main(), 120))
 
 
+def test_client_socket_spread():
+    """Outbound-endpoint spread parity (transport.rs:57-71, 170-173):
+    dials leave through dial-only client sockets picked by SeaHash of
+    the peer addr mod the socket count, the serving socket never
+    originates dials, and transport.close() reaps the dial sockets."""
+
+    async def main():
+        sinks, on_dgram, on_uni, on_bi = _lane_fixture()
+        servers = []
+        for _ in range(6):
+            s = await QuicEndpoint.bind("127.0.0.1", 0)
+            s.serve(on_dgram, on_uni, on_bi)
+            servers.append(s)
+        identity = await QuicEndpoint.bind("127.0.0.1", 0)
+        clients = [await QuicEndpoint.bind("127.0.0.1", 0) for _ in range(3)]
+        t = QuicTransport(identity, client_endpoints=clients)
+        for s in servers:
+            await t.send_datagram(s.addr, b"probe")
+        await asyncio.sleep(0.3)
+        assert len(sinks["dgram"]) == 6
+        # each dial left through exactly the socket the reference's
+        # formula picks, deterministically per peer
+        from corrosion_tpu.net import seahash
+
+        for s in servers:
+            idx = seahash.hash_bytes(s.addr.encode()) % len(clients)
+            assert t._conns[s.addr].endpoint is clients[idx]
+        # the serving identity socket originated no outbound connections
+        assert not identity.conns_by_scid
+        await t.close()
+        for ep in clients:
+            assert ep._udp_transport.is_closing()
+        for s in servers:
+            await s.close()
+        await identity.close()
+
+    asyncio.new_event_loop().run_until_complete(asyncio.wait_for(main(), 30))
+
+
+def test_dial_only_socket_refuses_inbound():
+    """A spread socket (accept_inbound=False, quinn client-endpoint
+    shape) must not spawn a server-role connection for a stray Initial
+    on its unauthenticated open port."""
+    from corrosion_tpu.net.quic import QuicError
+
+    async def main():
+        dial_only = await QuicEndpoint.bind(
+            "127.0.0.1", 0, accept_inbound=False
+        )
+        other = await QuicEndpoint.bind("127.0.0.1", 0)
+        t = QuicTransport(other)
+        with pytest.raises(QuicError, match="timeout"):
+            await t.send_datagram(dial_only.addr, b"stray")
+        assert not dial_only.conns_by_scid
+        assert not dial_only.conns_by_peer
+        await t.close()
+        await other.close()
+        await dial_only.close()
+
+    asyncio.new_event_loop().run_until_complete(asyncio.wait_for(main(), 30))
+
+
+def test_rtt_observed_on_dialer_side_only():
+    """RTT samples feed the members rings keyed by the addr the dialer
+    dialed (transport.rs rtt_tx, client connect path); the accept side
+    must NOT observe RTT — its peer_addr is the dialer's ephemeral
+    spread socket, which would grow members.rtts/per-addr metrics
+    without bound and never match a member identity."""
+
+    async def main():
+        sinks, on_dgram, on_uni, on_bi = _lane_fixture()
+        server = await QuicEndpoint.bind("127.0.0.1", 0)
+        server.serve(on_dgram, on_uni, on_bi)
+        server_t = QuicTransport(server)
+        server_seen = []
+        server_t.observe_rtt = lambda addr, rtt: server_seen.append(addr)
+
+        identity = await QuicEndpoint.bind("127.0.0.1", 0)
+        spread = await QuicEndpoint.bind(
+            "127.0.0.1", 0, accept_inbound=False
+        )
+        t = QuicTransport(identity, client_endpoints=[spread])
+        client_seen = []
+        t.observe_rtt = lambda addr, rtt: client_seen.append(addr)
+
+        await t.send_datagram(server.addr, b"ping")
+        await asyncio.sleep(0.5)  # let handshake/app ACKs generate samples
+        assert sinks["dgram"] == [b"ping"]
+        # dialer keys samples by the advertised addr it dialed
+        assert client_seen and set(client_seen) == {server.addr}
+        # accept side never keys by the ephemeral source
+        assert server_seen == []
+        await t.close()
+        await server_t.close()
+        await identity.close()
+        await server.close()
+
+    asyncio.new_event_loop().run_until_complete(asyncio.wait_for(main(), 30))
+
+
+def test_agent_spread_socket_count():
+    """config.rs:162-163 / transport.rs:57-71: the agent builds 8 dial
+    sockets for the default client_addr (port 0) and exactly 1 when an
+    operator pins a client port."""
+    from tests.test_agent import fast_config
+    from corrosion_tpu.agent.run import setup, shutdown
+
+    async def main():
+        cfg = fast_config("127.0.0.1:0", bootstrap=[])
+        cfg.gossip.transport = "quic"
+        agent = await setup(cfg, network=None)
+        try:
+            assert len(agent.transport._client_eps) == 8
+            assert all(
+                not ep.accept_inbound
+                for ep in agent.transport._client_eps
+            )
+        finally:
+            await shutdown(agent)
+
+        cfg2 = fast_config("127.0.0.1:0", bootstrap=[])
+        cfg2.gossip.transport = "quic"
+        cfg2.gossip.client_addr = "127.0.0.1:0"  # port 0 -> still spread
+        agent2 = await setup(cfg2, network=None)
+        try:
+            assert len(agent2.transport._client_eps) == 8
+        finally:
+            await shutdown(agent2)
+
+        import socket
+
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.bind(("127.0.0.1", 0))
+        pinned = s.getsockname()[1]
+        s.close()
+        cfg3 = fast_config("127.0.0.1:0", bootstrap=[])
+        cfg3.gossip.transport = "quic"
+        cfg3.gossip.client_addr = f"127.0.0.1:{pinned}"
+        agent3 = await setup(cfg3, network=None)
+        try:
+            eps = agent3.transport._client_eps
+            assert len(eps) == 1
+            assert eps[0].addr == f"127.0.0.1:{pinned}"
+        finally:
+            await shutdown(agent3)
+
+    asyncio.new_event_loop().run_until_complete(asyncio.wait_for(main(), 60))
+
+
 def test_quic_requires_plaintext_mode():
     from corrosion_tpu.agent.run import setup
     from corrosion_tpu.runtime.config import Config
