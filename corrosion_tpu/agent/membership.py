@@ -57,6 +57,15 @@ class SwimConfig:
     num_indirect_probes: int = 3
     suspicion_mult: float = 4.0  # suspect window = mult * log2(n+2) * period
     max_transmissions_base: int = 10  # scaled down for big clusters
+    # carrier-budget multiplier for DOWN updates: a DOWN that goes
+    # extinct before full coverage costs a straggler its entire
+    # self-discovery round (own probe-ring pass + suspicion window —
+    # measured 13-20 periods vs ~8 cluster-wide at n=8 with mult 1;
+    # tail gone over 20 trials at mult 3). The batched kernel closes
+    # the same hole with its anti-entropy tail pushes
+    # (ops/swim.py `antientropy`); the full agent additionally repairs
+    # via the steady announce/feed loop (run.py)
+    down_transmissions_mult: int = 3
     remove_down_after: float = 48 * 3600.0  # broadcast/mod.rs:953
     announce_backoff_start: float = 5.0
     announce_backoff_max: float = 120.0
@@ -257,12 +266,15 @@ class Membership:
 
     def _disseminate(self, update: MemberUpdate) -> None:
         n = self.cluster_size
+        sends = self.config.max_transmissions(n)
+        if update.state == MemberState.DOWN:
+            # deaths are rare and extinction of a DOWN is expensive;
+            # see down_transmissions_mult in SwimConfig
+            sends *= self.config.down_transmissions_mult
         # replace any queued assertion about the same actor (O(1): the
         # queue is keyed by subject), re-entering at the fresh end
         self._queue.pop(update.actor.id, None)
-        self._queue[update.actor.id] = _Dissemination(
-            update, self.config.max_transmissions(n)
-        )
+        self._queue[update.actor.id] = _Dissemination(update, sends)
 
     # -- update application -------------------------------------------------
 

@@ -10,6 +10,8 @@ import random
 from corrosion_tpu.agent.members import Members, ring_for_rtt
 from corrosion_tpu.agent.membership import (
     Membership,
+    MemberState,
+    MemberUpdate,
     Notification,
     SwimConfig,
 )
@@ -50,6 +52,25 @@ async def wait_until(pred, timeout=10.0, step=0.02):
             return True
         await asyncio.sleep(step)
     return pred()
+
+
+def test_down_updates_get_deeper_carrier_budget():
+    """A DOWN entering the dissemination queue carries
+    down_transmissions_mult x the infection budget of ALIVE/SUSPECT
+    chatter (extinction of a DOWN costs a straggler a full
+    self-discovery round; see SwimConfig.down_transmissions_mult)."""
+    net = MemNetwork(seed=3)
+    ms = mk_node(net, 1)
+    peer = Actor(
+        id=ActorId(bytes([9]) * 16), addr="node9", ts=Timestamp.from_unix(9)
+    )
+    base = ms.config.max_transmissions(ms.cluster_size)
+    ms._disseminate(MemberUpdate(peer, 0, MemberState.ALIVE))
+    assert ms._queue[peer.id].sends_left == base
+    ms._disseminate(MemberUpdate(peer, 0, MemberState.DOWN))
+    assert ms._queue[peer.id].sends_left == (
+        base * ms.config.down_transmissions_mult
+    )
 
 
 def test_three_nodes_converge_and_detect_failure():
