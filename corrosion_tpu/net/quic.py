@@ -43,19 +43,24 @@ SeaHash of the peer addr mod the socket count, diluting per-socket
 kernel buffers under kernel-path pressure exactly as the reference's
 comment intends (the hash input differs: Rust hashes the SocketAddr
 struct via its Hash impl, we hash the canonical "host:port" bytes —
-both are stable per-peer, which is all the spread needs).  Remaining
-recorded deviation: no GSO (a sendmsg/UDP_SEGMENT batching optimization
-below the portable asyncio API; gossip datagrams are single-MTU).
-gossip.max_mtu IS honored (QuicEndpoint.bind(mtu=...), advertised +
-enforced).
+both are stable per-peer, which is all the spread needs).  GSO: bulk
+flushes coalesce consecutive equal-size datagrams to one sendmsg with a
+UDP_SEGMENT cmsg (quinn's transport.enable_segmentation_offload,
+`api/peer/mod.rs:121-150` gso knob) — capability-probed at runtime, with
+a per-datagram fallback where the kernel or socket refuses (non-Linux,
+older kernels).  gossip.max_mtu IS honored (QuicEndpoint.bind(mtu=...),
+advertised + enforced).
 """
 
 from __future__ import annotations
 
 import asyncio
+import errno
 import logging
 import os
+import socket
 import struct
+import sys
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -75,6 +80,15 @@ CID_LEN = 8  # quinn's default random CID length; ours is fixed, peers' vary
 TAG_LEN = 8  # quinn_plaintext.rs:331-334
 MAX_UDP = 1452
 MIN_INITIAL = 1200  # RFC 9000 §14.1: client Initial datagrams are padded
+
+# Linux UDP generalized segmentation offload: one sendmsg carries many
+# equal-size datagrams, split by the kernel (quinn's GSO path).  The
+# socket-level constants predate their CPython exposure, so fall back to
+# the stable kernel values when the build's socket module lacks them.
+SOL_UDP = getattr(socket, "SOL_UDP", 17)
+UDP_SEGMENT = getattr(socket, "UDP_SEGMENT", 103)
+GSO_MAX_SEGS = 64  # kernel UDP_MAX_SEGMENTS
+GSO_MAX_BYTES = 65000  # stay inside one IP datagram's payload bound
 
 # packet-number spaces
 S_INIT, S_HS, S_APP = 0, 1, 2
@@ -729,6 +743,7 @@ class QuicConnection:
         if self.closed.is_set():
             return
         budget = 10  # datagrams per flush; retx loop resumes if more
+        outbox: List[bytes] = []
         while budget > 0:
             datagram = bytearray()
             for space in (S_INIT, S_HS, S_APP):
@@ -750,9 +765,11 @@ class QuicConnection:
                     pad_to=pad,
                 )
             if not datagram:
-                return
-            self.endpoint._sendto(bytes(datagram), self.peer)
+                break
+            outbox.append(bytes(datagram))
             budget -= 1
+        if outbox:
+            self.endpoint._send_batch(outbox, self.peer)
 
     def _frames_for_space(self, space: int):
         sp = self.spaces[space]
@@ -1262,6 +1279,34 @@ class QuicConnection:
 # endpoint
 
 
+def gso_groups(grams: List[bytes]) -> List[Tuple[int, List[bytes]]]:
+    """Greedy-group consecutive datagrams for UDP_SEGMENT coalescing.
+
+    A valid GSO batch is N equal-size segments plus at most one shorter
+    trailer, within the kernel's segment-count and total-size bounds.
+    Returns [(segment_size, [datagrams...])]; singleton groups mean "send
+    plain".  Order is preserved — QUIC tolerates reordering but there is
+    no reason to introduce any.
+    """
+    groups: List[Tuple[int, List[bytes]]] = []
+    i = 0
+    while i < len(grams):
+        seg = len(grams[i])
+        total = seg
+        j = i + 1
+        while (j < len(grams) and len(grams[j]) == seg
+               and j - i < GSO_MAX_SEGS and total + seg <= GSO_MAX_BYTES):
+            total += seg
+            j += 1
+        if (j < len(grams) and len(grams[j]) < seg
+                and j - i < GSO_MAX_SEGS
+                and total + len(grams[j]) <= GSO_MAX_BYTES):
+            j += 1  # shorter trailer rides the same batch
+        groups.append((seg, grams[i:j]))
+        i = j
+    return groups
+
+
 class _UdpProto(asyncio.DatagramProtocol):
     def __init__(self, endpoint: "QuicEndpoint") -> None:
         self.endpoint = endpoint
@@ -1297,6 +1342,10 @@ class QuicEndpoint(Listener):
         self._on_bi = None
         self._rtt_sink: Optional[Callable[[str, float], None]] = None
         self._handler_tasks: set = set()
+        # UDP GSO: assumed available until a sendmsg says otherwise
+        # (Linux ≥4.18; EINVAL/ENOTSUP flips this off permanently)
+        self._gso_ok = sys.platform == "linux"
+        self._gso_sock: Optional[socket.socket] = None
 
     @classmethod
     async def bind(cls, host: str = "127.0.0.1", port: int = 0,
@@ -1309,6 +1358,19 @@ class QuicEndpoint(Listener):
         )
         sock = self._udp_transport.get_extra_info("sockname")
         self._addr = f"{host}:{sock[1]}"
+        # asyncio's TransportSocket hides sendmsg; dup the fd into a real
+        # socket object for the GSO path (shares the bound UDP socket)
+        if self._gso_ok:
+            raw = self._udp_transport.get_extra_info("socket")
+            fd = -1
+            try:
+                fd = os.dup(raw.fileno())
+                self._gso_sock = socket.socket(fileno=fd)
+                self._gso_sock.setblocking(False)
+            except (OSError, AttributeError):
+                self._gso_ok = False
+                if fd >= 0 and self._gso_sock is None:
+                    os.close(fd)
         return self
 
     # Listener interface
@@ -1326,6 +1388,9 @@ class QuicEndpoint(Listener):
             conn.close("endpoint closed")
         if self._udp_transport is not None:
             self._udp_transport.close()
+        if self._gso_sock is not None:
+            self._gso_sock.close()
+            self._gso_sock = None
         for t in list(self._handler_tasks):
             t.cancel()
 
@@ -1335,6 +1400,61 @@ class QuicEndpoint(Listener):
         if self._udp_transport is not None:
             self._udp_transport.sendto(data, peer)
             METRICS.counter("corro.quic.udp_tx.bytes").inc(len(data))
+
+    def _send_batch(self, grams: List[bytes], peer: Tuple[str, int]) -> None:
+        """Send a flush's datagrams, GSO-coalescing where the kernel allows.
+
+        Falls back to per-datagram transport sends when GSO is probed
+        unsupported, the batch doesn't coalesce, the asyncio transport has
+        buffered writes pending (a raw sendmsg would jump that queue), or
+        the socket would block (the transport path buffers for us).
+        """
+        if self._udp_transport is None:
+            return
+        sock = self._gso_sock
+        if (not self._gso_ok or len(grams) < 2 or sock is None
+                or self._udp_transport.get_write_buffer_size() > 0):
+            for g in grams:
+                self._sendto(g, peer)
+            return
+        blocked = False  # once one group buffers, the rest must follow it
+        for seg, group in gso_groups(grams):
+            # a singleton/fallback group may itself have buffered into the
+            # transport; a raw sendmsg after that would jump the queue
+            if not blocked and self._udp_transport.get_write_buffer_size():
+                blocked = True
+            if blocked or len(group) < 2 or not self._gso_ok:
+                for g in group:
+                    self._sendto(g, peer)
+                continue
+            cmsg = [(SOL_UDP, UDP_SEGMENT, struct.pack("@H", seg))]
+            try:
+                sock.sendmsg([b"".join(group)], cmsg, 0, peer)
+            except BlockingIOError:
+                # this group goes to the transport's write buffer; a later
+                # raw sendmsg would jump ahead of it, so stop GSO here
+                blocked = True
+                for g in group:
+                    self._sendto(g, peer)
+                continue
+            except OSError as e:
+                if e.errno in (errno.EINVAL, errno.ENOTSUP, errno.EOPNOTSUPP):
+                    # kernel or socket refuses GSO itself — disable for
+                    # this endpoint's lifetime
+                    log.debug("quic: GSO unsupported (%s); disabling", e)
+                    self._gso_ok = False
+                else:
+                    # transient send error (ENOBUFS, EPERM, ...): fall
+                    # back for this flush, keep GSO armed
+                    log.debug("quic: GSO send failed (%s); falling back", e)
+                for g in group:
+                    self._sendto(g, peer)
+                continue
+            METRICS.counter("corro.quic.udp_tx.bytes").inc(
+                sum(len(g) for g in group)
+            )
+            METRICS.counter("corro.quic.gso.batches").inc()
+            METRICS.counter("corro.quic.gso.segments").inc(len(group))
 
     def _observe_rtt(self, addr: str, rtt: float) -> None:
         if self._rtt_sink is not None:
