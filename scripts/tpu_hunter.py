@@ -156,6 +156,15 @@ def battery_steps() -> list[tuple[str, list[str], dict, float, str]]:
         ("pview262k_conv",
          [py, "-u", "scripts/pview_converge.py", "262144", "2048"],
          {}, 3600.0, "TPU_PVIEW_CONV_262k.txt"),
+        # on-chip ladder above the CPU rungs (r4 verdict item 6's on-chip
+        # option): 512k = 4.3 GB table, 1M = 8.6 GB — both fit the 16 GB
+        # chip with the donated tick; 2M (16.8 GB table) does not
+        ("pview512k_conv",
+         [py, "-u", "scripts/pview_converge.py", "524288", "2048"],
+         {}, 3600.0, "TPU_PVIEW_CONV_512k.txt"),
+        ("pview1m_conv",
+         [py, "-u", "scripts/pview_converge.py", "1048576", "2048"],
+         {}, 4800.0, "TPU_PVIEW_CONV_1m.txt"),
         # (the legacy pview100k inline-code step was dropped: its 0.95
         # coverage bar is strictly weaker than pview100k_conv's 0.99 +
         # churn phase — a live window must not pay for the same rung twice)

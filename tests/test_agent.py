@@ -8,6 +8,7 @@ connected, via anti-entropy sync for late joiners.
 """
 
 import asyncio
+import socket
 
 import pytest
 
@@ -28,6 +29,21 @@ TEST_SCHEMA = (
 )
 
 FAST_SWIM = SwimConfig(probe_period=0.05, probe_rtt=0.02, suspicion_mult=1.0)
+
+
+def free_port(dgram: bool = False) -> int:
+    """Pick a currently-free loopback port.
+
+    Inherently racy (close-then-rebind); centralized so any hardening —
+    retry-on-collision, SO_REUSEADDR — lands in one place for every test
+    that needs a port before the server under test binds it."""
+    s = socket.socket(
+        socket.AF_INET, socket.SOCK_DGRAM if dgram else socket.SOCK_STREAM
+    )
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 # File-backed test dbs, NOT :memory: (runtime/tmpdb.py: the shared-cache
 # in-memory fallback has no real WAL and flakes concurrent read+apply as

@@ -18,12 +18,7 @@ import pytest
 REPO = Path(__file__).resolve().parent.parent
 
 
-def free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from tests.test_agent import free_port  # noqa: E402  (shared port helper)
 
 
 def cli_env():

@@ -15,7 +15,6 @@ change a single bit of protocol state.
 
 import json
 import os
-import socket
 import subprocess
 import sys
 
@@ -32,12 +31,7 @@ WORKER = os.path.join(REPO, "tests", "dcn_worker.py")
 N_TICKS = 5
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from tests.test_agent import free_port as _free_port  # noqa: E402
 
 
 def _run_workers(nprocs: int, local_devices: int) -> list:

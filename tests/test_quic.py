@@ -406,24 +406,16 @@ def test_two_agents_replicate_over_quic():
     gossip membership (SWIM datagrams), replicate a row (uni broadcast),
     and a late joiner syncs (bi streams) — the reference's three quinn
     lanes (`transport.rs:81-140`) end-to-end through this stack."""
-    import socket
-
     from tests.test_agent import (
         TEST_SCHEMA,
         FAST_SWIM,
         count_rows,
         fast_config,
+        free_port,
         insert,
         wait_until,
     )
     from corrosion_tpu.agent.run import run, setup, shutdown
-
-    def free_port():
-        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
-        return port
 
     async def main():
         agents = []

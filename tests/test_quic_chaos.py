@@ -1,0 +1,136 @@
+"""Chaos contract on the QUIC lane: loss + partition/heal at the UDP layer.
+
+The mem-transport chaos soak (test_chaos_soak.py) proves the agent stack
+under seeded faults, but its faults are injected in MemNetwork — the QUIC
+lane never sees them.  This test injects the same fault classes at the
+real UDP receive path (`QuicEndpoint._on_udp`): seeded 10% datagram loss
+throughout, then a full partition with divergent writes, then heal.  The
+product claims under test mirror the reference's quinn behavior
+(`transport.rs:81-230`, sync over bi streams per SURVEY §2.6):
+
+  - SWIM + broadcast + sync all survive sustained datagram loss (PTO
+    retransmission carries streams; SWIM datagrams are loss-tolerant by
+    protocol),
+  - a partition produces divergence (the non-cut side still replicates),
+  - after heal, anti-entropy repairs both sides to identical stores.
+
+Receive-side injection is deliberate: with GSO on the send path a single
+sendmsg can carry many datagrams, but the kernel re-segments so the
+receiver still sees (and drops) individual datagrams.  Source-agent
+attribution uses the local port of every socket an agent binds (listener
++ 8 dial-only spread sockets) — ephemeral dial ports make address-based
+filtering reliable only with that full map.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+from corrosion_tpu.agent.run import run, setup, shutdown
+from tests.test_agent import (
+    FAST_SWIM,
+    TEST_SCHEMA,
+    count_rows,
+    fast_config,
+    free_port,
+    insert,
+    wait_until,
+)
+
+
+class UdpChaos:
+    """Seeded receive-side fault injector over a set of QUIC agents."""
+
+    def __init__(self, seed: int) -> None:
+        self.rng = random.Random(seed)
+        self.loss = 0.0
+        self.groups: dict[str, int] = {}  # agent name -> partition group
+        self.port_owner: dict[int, str] = {}
+        self.dropped = 0
+
+    def endpoints(self, agent):
+        t = agent.transport
+        return [t._endpoint, *t._client_eps]
+
+    def install(self, name: str, agent) -> None:
+        for ep in self.endpoints(agent):
+            self.port_owner[int(ep.addr.rsplit(":", 1)[1])] = name
+        for ep in self.endpoints(agent):
+            inner = ep._on_udp
+
+            def filtered(data, addr, _inner=inner, _me=name):
+                src = self.port_owner.get(addr[1])
+                if src is not None and src != _me:
+                    if self.groups and self.groups.get(src) != self.groups.get(_me):
+                        self.dropped += 1
+                        return
+                    if self.loss and self.rng.random() < self.loss:
+                        self.dropped += 1
+                        return
+                _inner(data, addr)
+
+            ep._on_udp = filtered
+
+    def partition(self, groups: dict[str, int]) -> None:
+        self.groups = dict(groups)
+
+    def heal(self) -> None:
+        self.groups = {}
+
+
+def test_quic_lane_survives_loss_partition_heal():
+    async def main():
+        chaos = UdpChaos(seed=7)
+        agents: dict[str, object] = {}
+        addrs = {n: f"127.0.0.1:{free_port(dgram=True)}" for n in ("a", "b", "c")}
+        # loss is armed BEFORE boot: join/bootstrap itself runs lossy
+        chaos.loss = 0.10
+        for name, addr in addrs.items():
+            cfg = fast_config(addr, bootstrap=[v for k, v in addrs.items() if k != name])
+            cfg.gossip.transport = "quic"
+            agent = await setup(cfg, network=None)
+            agent.membership.config = FAST_SWIM
+            agent.store.apply_schema_sql(TEST_SCHEMA)
+            chaos.install(name, agent)
+            await run(agent)
+            agents[name] = agent
+
+        a, b, c = agents["a"], agents["b"], agents["c"]
+
+        # phase 1: boot + replicate under sustained 10% datagram loss
+        assert await wait_until(
+            lambda: all(len(ag.members.states) >= 2 for ag in agents.values()),
+            timeout=30,
+        ), "QUIC agents did not form a full mesh under loss"
+        await insert(a, 1, "boot-row")
+        assert await wait_until(
+            lambda: count_rows(b) == 1 and count_rows(c) == 1, timeout=30
+        ), "row did not replicate over lossy QUIC"
+
+        # phase 2: partition {a} | {b,c}; divergent writes on both sides
+        chaos.partition({"a": 0, "b": 1, "c": 1})
+        await insert(a, 2, "island-row")
+        await insert(b, 3, "mainland-row")
+        # the non-cut side must still replicate; the cut row must NOT cross
+        assert await wait_until(lambda: count_rows(c) == 2, timeout=30), (
+            "mainland replication died during partition"
+        )
+        assert count_rows(c, "id = 2") == 0, "partition leaked a datagram"
+        assert count_rows(a) == 2
+
+        # phase 3: heal; anti-entropy must repair both sides fully
+        chaos.heal()
+        assert await wait_until(
+            lambda: all(count_rows(ag) == 3 for ag in agents.values()),
+            timeout=60,
+        ), (
+            "stores did not converge after heal: "
+            f"{[(n, count_rows(ag)) for n, ag in agents.items()]}"
+        )
+        assert chaos.dropped > 0, "injector never dropped anything"
+
+        for agent in agents.values():
+            await shutdown(agent)
+
+    asyncio.new_event_loop().run_until_complete(asyncio.wait_for(main(), 180))

@@ -11,7 +11,6 @@ default, and two full agents gossip + replicate over a TLS transport.
 
 from corrosion_tpu.runtime.tmpdb import fresh_db_path
 import asyncio
-import socket
 import ssl
 
 import pytest
@@ -212,12 +211,7 @@ def test_two_agents_replicate_over_tls(certs):
     )
     from corrosion_tpu.agent.run import run, setup, shutdown
 
-    def free_port():
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
-        return port
+    from tests.test_agent import free_port
 
     async def main():
         cfg_tls = tls_cfg(certs)
