@@ -145,6 +145,9 @@ def main() -> None:
     ap.add_argument("--tpu", action="store_true")
     ap.add_argument("--sizes", default="512,4096,16384,65536")
     ap.add_argument("--hot-rows", type=int, default=2048)
+    # a chip run must not clobber the banked CPU record (both are
+    # decision evidence — COMPONENTS.md "CRDT engine placement")
+    ap.add_argument("--out", default="CRDT_MERGE_AB.json")
     args = ap.parse_args()
 
     if not args.tpu:
@@ -175,7 +178,7 @@ def main() -> None:
         results["rungs"].append(rung)
 
     results["measured_at"] = time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime())
-    with open(os.path.join(REPO, "CRDT_MERGE_AB.json"), "w") as f:
+    with open(os.path.join(REPO, args.out), "w") as f:
         json.dump(results, f, indent=1)
     print(json.dumps({"metric": "crdt_merge_ab", "platform": platform,
                       "rungs": len(results["rungs"])}))

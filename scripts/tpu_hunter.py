@@ -165,6 +165,14 @@ def battery_steps() -> list[tuple[str, list[str], dict, float, str]]:
         ("pview1m_conv",
          [py, "-u", "scripts/pview_converge.py", "1048576", "2048"],
          {}, 4800.0, "TPU_PVIEW_CONV_1m.txt"),
+        # VERDICT r4 item 5's chip half: the array-merge A/B was
+        # CPU-measured (native wins 3-4x); this measures whether the
+        # chip overturns it at sync-flood batch sizes.  Own artifact
+        # file — must not clobber the banked CPU record.
+        ("crdt_ab_tpu",
+         [py, "-u", "scripts/bench_crdt_merge.py", "--tpu",
+          "--out", "CRDT_MERGE_AB_TPU.json"],
+         {}, 1800.0, "TPU_CRDT_AB.txt"),
         # (the legacy pview100k inline-code step was dropped: its 0.95
         # coverage bar is strictly weaker than pview100k_conv's 0.99 +
         # churn phase — a live window must not pay for the same rung twice)

@@ -419,7 +419,7 @@ def test_two_agents_replicate_over_quic():
 
     async def main():
         agents = []
-        addrs = [f"127.0.0.1:{free_port()}" for _ in range(2)]
+        addrs = [f"127.0.0.1:{free_port(dgram=True)}" for _ in range(2)]
         for addr in addrs:
             cfg = fast_config(addr, bootstrap=[a for a in addrs if a != addr])
             cfg.gossip.transport = "quic"
@@ -438,7 +438,7 @@ def test_two_agents_replicate_over_quic():
             "row did not replicate over QUIC broadcast"
         )
         # late joiner: must catch up via bi-stream sync
-        late_addr = f"127.0.0.1:{free_port()}"
+        late_addr = f"127.0.0.1:{free_port(dgram=True)}"
         cfg = fast_config(late_addr, bootstrap=list(addrs))
         cfg.gossip.transport = "quic"
         c = await setup(cfg, network=None)
