@@ -1346,6 +1346,7 @@ class QuicEndpoint(Listener):
         # (Linux ≥4.18; EINVAL/ENOTSUP flips this off permanently)
         self._gso_ok = sys.platform == "linux"
         self._gso_sock: Optional[socket.socket] = None
+        self._gso_fail_streak = 0
 
     @classmethod
     async def bind(cls, host: str = "127.0.0.1", port: int = 0,
@@ -1414,6 +1415,8 @@ class QuicEndpoint(Listener):
         sock = self._gso_sock
         if (not self._gso_ok or len(grams) < 2 or sock is None
                 or self._udp_transport.get_write_buffer_size() > 0):
+            if self._gso_ok and sock is not None and len(grams) >= 2:
+                METRICS.counter("corro.quic.gso.diverted").inc()
             for g in grams:
                 self._sendto(g, peer)
             return
@@ -1424,6 +1427,8 @@ class QuicEndpoint(Listener):
             if not blocked and self._udp_transport.get_write_buffer_size():
                 blocked = True
             if blocked or len(group) < 2 or not self._gso_ok:
+                if blocked and len(group) >= 2 and self._gso_ok:
+                    METRICS.counter("corro.quic.gso.diverted").inc()
                 for g in group:
                     self._sendto(g, peer)
                 continue
@@ -1434,6 +1439,7 @@ class QuicEndpoint(Listener):
                 # this group goes to the transport's write buffer; a later
                 # raw sendmsg would jump ahead of it, so stop GSO here
                 blocked = True
+                METRICS.counter("corro.quic.gso.diverted").inc()
                 for g in group:
                     self._sendto(g, peer)
                 continue
@@ -1445,11 +1451,22 @@ class QuicEndpoint(Listener):
                     self._gso_ok = False
                 else:
                     # transient send error (ENOBUFS, EPERM, ...): fall
-                    # back for this flush, keep GSO armed
-                    log.debug("quic: GSO send failed (%s); falling back", e)
+                    # back for this flush and keep GSO armed — but a
+                    # deterministic failure (e.g. route-state EMSGSIZE)
+                    # must not cost a doomed syscall per flush forever
+                    self._gso_fail_streak += 1
+                    if self._gso_fail_streak >= 3:
+                        log.debug(
+                            "quic: GSO failed %d consecutive sends (%s); "
+                            "disabling", self._gso_fail_streak, e,
+                        )
+                        self._gso_ok = False
+                    else:
+                        log.debug("quic: GSO send failed (%s); falling back", e)
                 for g in group:
                     self._sendto(g, peer)
                 continue
+            self._gso_fail_streak = 0
             METRICS.counter("corro.quic.udp_tx.bytes").inc(
                 sum(len(g) for g in group)
             )

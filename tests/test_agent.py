@@ -90,19 +90,30 @@ async def wait_progress(pred, progress, stall=30.0, cap=900.0, step=0.05):
     wrong (r4 weak #6/#8: the coexistence soak flaked under full-suite
     load, passed in isolation).  ``stall`` bounds how long progress may
     freeze; ``cap`` is a safety net against livelock (progress changing
-    forever without pred becoming true)."""
+    forever without pred becoming true).
+
+    The stall clock is starvation-compensated (the same correction the
+    swim-parity windows apply): when a monitor wakeup arrives far past
+    its ``step`` sleep, the process was descheduled — and the agents
+    sharing this event loop were descheduled WITH it, so the gap is
+    scheduler lag, not system silence.  Such gaps charge one step, not
+    their wall duration; otherwise a single multi-second freeze of a
+    loaded host trips ``stall`` the instant the monitor resumes."""
     loop = asyncio.get_event_loop()
     t0 = loop.time()
     last = progress()
-    last_change = t0
+    silence = 0.0
+    prev = t0
     while True:
         if pred():
             return True
         now = loop.time()
+        dt, prev = now - prev, now
+        silence += dt if dt <= 5 * step else step
         cur = progress()
         if cur != last:
-            last, last_change = cur, now
-        if now - last_change > stall:
+            last, silence = cur, 0.0
+        if silence > stall:
             return pred()  # stalled: one final check
         if now - t0 > cap:
             return pred()

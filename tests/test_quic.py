@@ -722,6 +722,7 @@ def test_gso_engages_on_bulk_transfer():
         client = await QuicEndpoint.bind("127.0.0.1", 0)
         seg_before = METRICS.counter("corro.quic.gso.segments").value
         bat_before = METRICS.counter("corro.quic.gso.batches").value
+        div_before = METRICS.counter("corro.quic.gso.diverted").value
         t = QuicTransport(client)
         bi = await t.open_bi(server.addr)
         await bi.send(blob)
@@ -731,11 +732,16 @@ def test_gso_engages_on_bulk_transfer():
         assert b"".join(received) == blob
         segments = METRICS.counter("corro.quic.gso.segments").value - seg_before
         batches = METRICS.counter("corro.quic.gso.batches").value - bat_before
-        # a loaded host can divert every batch to the fallback (write
-        # buffer nonempty / BlockingIOError) with _gso_ok still True, so
-        # assert on batches that actually went out, not on _gso_ok
+        diverted = METRICS.counter("corro.quic.gso.diverted").value - div_before
+        if client._gso_ok:
+            # on a GSO-capable kernel every bulk flush either coalesced
+            # or was explicitly diverted (write buffer / would-block);
+            # silent non-engagement is a regression
+            assert batches > 0 or diverted > 0
         if batches:
-            assert segments >= 2 * batches
+            # coalescing health: the 10-datagram flush budget should
+            # yield well above the 2-segment floor on a bulk transfer
+            assert segments / batches >= 3
         await t.close()
         await client.close()
         await server.close()

@@ -321,4 +321,29 @@ def test_wait_progress_semantics():
         )
         assert asyncio.get_event_loop().time() - t0 < 2.0
 
+        # scheduler starvation is NOT a stall (r5: the coexistence soak
+        # flaked when a loaded host froze the whole process past the
+        # stall bound): block the event loop synchronously for > stall;
+        # progress is still at its pre-freeze value at the first
+        # post-freeze poll (nothing ran during the freeze) and resumes
+        # two polls later.  The old wall-clock silence check tripped at
+        # that first poll; the compensated clock charges the freeze one
+        # step and sees the resumed headway.
+        import time as _time
+
+        state["phase"] = 0
+
+        def pred3():
+            state["phase"] += 1
+            if state["phase"] == 1:
+                _time.sleep(0.5)  # whole-process freeze >> stall
+            return state["phase"] >= 5
+
+        def prog3():
+            return state["phase"] if state["phase"] >= 3 else 0
+
+        assert await wait_progress(
+            pred3, prog3, stall=0.2, cap=30.0, step=0.02
+        ), "a monitor freeze longer than stall was charged as silence"
+
     asyncio.run(main())
