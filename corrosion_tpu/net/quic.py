@@ -1421,6 +1421,7 @@ class QuicEndpoint(Listener):
                 self._sendto(g, peer)
             return
         blocked = False  # once one group buffers, the rest must follow it
+        gso_sent = gso_failed = False
         for seg, group in gso_groups(grams):
             # a singleton/fallback group may itself have buffered into the
             # transport; a raw sendmsg after that would jump the queue
@@ -1451,27 +1452,33 @@ class QuicEndpoint(Listener):
                     self._gso_ok = False
                 else:
                     # transient send error (ENOBUFS, EPERM, ...): fall
-                    # back for this flush and keep GSO armed — but a
-                    # deterministic failure (e.g. route-state EMSGSIZE)
-                    # must not cost a doomed syscall per flush forever
-                    self._gso_fail_streak += 1
-                    if self._gso_fail_streak >= 3:
-                        log.debug(
-                            "quic: GSO failed %d consecutive sends (%s); "
-                            "disabling", self._gso_fail_streak, e,
-                        )
-                        self._gso_ok = False
-                    else:
-                        log.debug("quic: GSO send failed (%s); falling back", e)
+                    # back and keep GSO armed for now
+                    gso_failed = True
+                    log.debug("quic: GSO send failed (%s); falling back", e)
                 for g in group:
                     self._sendto(g, peer)
                 continue
-            self._gso_fail_streak = 0
+            gso_sent = True
             METRICS.counter("corro.quic.udp_tx.bytes").inc(
                 sum(len(g) for g in group)
             )
             METRICS.counter("corro.quic.gso.batches").inc()
             METRICS.counter("corro.quic.gso.segments").inc(len(group))
+        # failure accounting is per FLUSH, not per group: one ENOBUFS
+        # burst inside a single flush is a moment of buffer pressure, but
+        # three consecutive flushes failing with zero successes looks
+        # deterministic (e.g. route-state EMSGSIZE) — stop paying a
+        # doomed syscall per flush at that point
+        if gso_sent:
+            self._gso_fail_streak = 0
+        elif gso_failed:
+            self._gso_fail_streak += 1
+            if self._gso_fail_streak >= 3 and self._gso_ok:
+                log.debug(
+                    "quic: GSO failed %d consecutive flushes; disabling",
+                    self._gso_fail_streak,
+                )
+                self._gso_ok = False
 
     def _observe_rtt(self, addr: str, rtt: float) -> None:
         if self._rtt_sink is not None:

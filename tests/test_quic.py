@@ -738,9 +738,11 @@ def test_gso_engages_on_bulk_transfer():
             # or was explicitly diverted (write buffer / would-block);
             # silent non-engagement is a regression
             assert batches > 0 or diverted > 0
-        if batches:
-            # coalescing health: the 10-datagram flush budget should
-            # yield well above the 2-segment floor on a bulk transfer
+        if batches and not diverted:
+            # coalescing health, asserted only on an unloaded run: with
+            # zero diversions the 10-datagram flush budget should yield
+            # well above the 2-segment floor.  Under load, diverted
+            # flushes can leave only small tail batches — not a failure.
             assert segments / batches >= 3
         await t.close()
         await client.close()
