@@ -897,36 +897,12 @@ def set_partition(state: SwimState, groups) -> SwimState:
 
 @jax.jit
 def _stats_impl(view, alive):
-    """Row-reduction formulation: three fused masked row-sums over the
-    [N, N] view (one streaming pass each — no [N, N] boolean temporaries,
-    which made the r2 version cost ~2 s at n=10k on CPU), then O(N)
-    combination. Diagonal (self) terms are subtracted in closed form:
-    a live member's self entry is always an alive-precedence key."""
+    """All three metrics from ONE block-row streaming pass (see
+    _stats_sums) plus O(N) combination.  Diagonal (self) terms are
+    subtracted in closed form: a live member's self entry is always an
+    alive-precedence key."""
     n = view.shape[0]
-    af = alive.astype(jnp.float32)  # [N]
-    cov_num, n_alive = _coverage_rows(view, alive)
-    prec = key_prec(view)
-    known = key_known(view)
-    # down-marked subjects that ARE dead, per observer. The whole-cluster-
-    # alive case (every bootstrap run) short-circuits: with no dead
-    # members the sum is identically zero, and lax.cond executes only one
-    # branch — a full [N, N] streaming pass (~270 ms at n=10k on CPU)
-    # skipped at every pre-churn stats call
-    row_td = jax.lax.cond(
-        n_alive >= jnp.float32(n),
-        lambda: jnp.zeros((n,), jnp.float32),
-        lambda: jnp.sum(
-            jnp.where(
-                known & (prec == PREC_DOWN), 1.0 - af[None, :], 0.0
-            ),
-            axis=1,
-        ),
-    )
-    row_fp = jnp.sum(  # suspected/downed subjects that ARE alive
-        jnp.where(known & (prec >= PREC_SUSPECT), af[None, :], 0.0), axis=1
-    )
-    det_num = jnp.sum(row_td * af)  # diag: live self never dead-subject
-    fp_num = jnp.sum(row_fp * af)  # diag: live self never suspect
+    cov_num, det_num, fp_num, n_alive = _stats_sums(view, alive)
     n_alive_pairs = jnp.maximum(n_alive * (n_alive - 1.0), 1.0)
     n_dead_pairs = jnp.maximum(n_alive * (n - n_alive), 1.0)
     return jnp.stack(
@@ -934,23 +910,60 @@ def _stats_impl(view, alive):
     )
 
 
-def _coverage_rows(view, alive):
-    """Shared coverage reduction (device-loop predicate AND stats):
-    (numerator, n_alive) of the live-knows-live ratio, ONE streaming
-    pass over the [N, N] view, diagonal subtracted in closed form."""
-    af = alive.astype(jnp.float32)
+# [B, N] row blocks for the stats reductions.  The whole-view
+# formulation materialized shared prec/known temporaries next to the
+# int16 view — at n=80k that is multi-GB of HLO temps beside a 12.8 GB
+# view, which OOMed a 16 GB v5e chip (BENCH_TPU_80k.json.failed, r5).
+# Blocking caps the temps at [B, N] regardless of n.
+_STATS_BLOCK = 2048
+
+
+def _stats_sums(view, alive):
+    """(cov_num, det_num, fp_num, n_alive): the three masked row-sums
+    of the stats/coverage reductions, streamed over [B, N] row blocks
+    in a single pass (lax.fori_loop + dynamic_slice).  The last block's
+    start is clamped, so rows an earlier block already counted are
+    masked out of its observer weights."""
+    n = view.shape[0]
+    b = min(n, _STATS_BLOCK)
+    nblocks = (n + b - 1) // b
+    af = alive.astype(jnp.float32)  # [N]
+
+    def body(i, acc):
+        cov, det, fp = acc
+        start = jnp.minimum(i * b, n - b)
+        rows = jax.lax.dynamic_slice(view, (start, 0), (b, n))
+        prec = key_prec(rows)
+        known = key_known(rows)
+        row_ka = jnp.sum(
+            jnp.where(known & (prec == PREC_ALIVE), af[None, :], 0.0), axis=1
+        )
+        row_td = jnp.sum(  # down-marked subjects that ARE dead
+            jnp.where(known & (prec == PREC_DOWN), 1.0 - af[None, :], 0.0),
+            axis=1,
+        )
+        row_fp = jnp.sum(  # suspected/downed subjects that ARE alive
+            jnp.where(known & (prec >= PREC_SUSPECT), af[None, :], 0.0),
+            axis=1,
+        )
+        rg = start + jnp.arange(b)
+        w = af[rg] * (rg >= i * b)  # fresh live observers only
+        return (
+            cov + jnp.sum(row_ka * w),
+            det + jnp.sum(row_td * w),
+            fp + jnp.sum(row_fp * w),
+        )
+
+    z = jnp.float32(0.0)
+    cov, det, fp = jax.lax.fori_loop(0, nblocks, body, (z, z, z))
     n_alive = jnp.sum(af)
-    prec = key_prec(view)
-    known = key_known(view)
-    row_ka = jnp.sum(
-        jnp.where(known & (prec == PREC_ALIVE), af[None, :], 0.0), axis=1
-    )
-    num = jnp.sum(row_ka * af) - n_alive  # minus the alive diagonal
-    return num, n_alive
+    # minus the alive diagonal (self entries are alive-precedence);
+    # dead/suspect diagonals contribute zero by the same argument
+    return cov - n_alive, det, fp, n_alive
 
 
 def _coverage_impl(view, alive):
-    num, n_alive = _coverage_rows(view, alive)
+    num, _, _, n_alive = _stats_sums(view, alive)
     return num / jnp.maximum(n_alive * (n_alive - 1.0), 1.0)
 
 

@@ -249,7 +249,21 @@ def init_state(
     seeds — `seed_mode="ring"`: the next `seeds_per_member` neighbours;
     `"fingers"`: Chord-style power-of-two offsets (`swim.finger_offsets`,
     same expander bootstrap rationale as `swim.init_state`: long-range
-    feed partners from tick 0)."""
+    feed partners from tick 0).
+
+    Jitted as ONE program: the eager op-by-op form compiled each
+    scatter separately, which on the tunneled chip cost ~99 s at n=100k
+    and died with an UNAVAILABLE device/compile error at n ≥ 262k
+    (TPU_PVIEW_CONV_{262k,512k}.txt.failed, r5)."""
+    return _init_impl(params, seeds_per_member, seed_mode)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("params", "seeds_per_member", "seed_mode")
+)
+def _init_impl(
+    params: PViewParams, seeds_per_member: int, seed_mode: str
+) -> PViewState:
     n, k, b, s = params.n, params.slots, params.buffer_slots, params.susp_slots
     idx = jnp.arange(n, dtype=jnp.int32)
     alive_key = make_key(0, PREC_ALIVE)

@@ -239,9 +239,12 @@ def main() -> None:
         # timeout) must not starve the queue — but ONE failure proves
         # nothing (the common case is the tunnel dying under the step,
         # and a single wedge must not demote a headline bench behind the
-        # long gambles).  Demote only from the second failure on; the
-        # sort is stable, so everything else keeps battery order.
-        pending.sort(key=lambda s: max(0, state["attempts"].get(s[0], 0) - 1))
+        # long gambles).  Demote only from the second FAILURE on —
+        # counted separately from attempts, which also tally successful
+        # runs (a stale-fingerprint re-queue must not demote a bench for
+        # having succeeded before).  Stable sort keeps battery order.
+        failures = state.setdefault("failures", {})
+        pending.sort(key=lambda s: max(0, failures.get(s[0], 0) - 1))
         if not pending:
             log("battery complete")
             return
@@ -264,14 +267,21 @@ def main() -> None:
             step_sha = step_fingerprint(name, argv)
             ok = run_step(name, argv, env_extra, min(timeout, remaining),
                           outfile)
+            # attempts = run-count telemetry for the round logs; the
+            # demotion sort reads ONLY the failures dict
             state["attempts"][name] = state["attempts"].get(name, 0) + 1
             if ok:
                 state["done"].append(name)
                 done_sha[name] = step_sha
+                # a success clears the failure history: a later stale
+                # re-queue must treat this step as healthy, not demoted
+                state.setdefault("failures", {}).pop(name, None)
                 save_state(state)
                 # brief pause so the tunnel's client slot is fully released
                 time.sleep(10)
             else:
+                fails = state.setdefault("failures", {})
+                fails[name] = fails.get(name, 0) + 1
                 save_state(state)
                 log("step failed; returning to probe loop")
                 time.sleep(cooldown)
