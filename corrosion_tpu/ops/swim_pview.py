@@ -251,10 +251,14 @@ def init_state(
     same expander bootstrap rationale as `swim.init_state`: long-range
     feed partners from tick 0).
 
-    Jitted as ONE program: the eager op-by-op form compiled each
-    scatter separately, which on the tunneled chip cost ~99 s at n=100k
-    and died with an UNAVAILABLE device/compile error at n ≥ 262k
-    (TPU_PVIEW_CONV_{262k,512k}.txt.failed, r5)."""
+    Construction is scatter-free and jitted as ONE program.  History of
+    why (r5 chip window): the original eager scatter-max form compiled
+    each op separately — ~99 s of init at n=100k through the tunnel and
+    an UNAVAILABLE device/compile fault at n ≥ 262k; jitting that same
+    scatter chain whole then HUNG outright at n=100k (5400 s, zero
+    output).  The blocked one-hot construction below has fixed [B,F,K]
+    shapes, no scatter, and is bit-equal to the scatter-max semantics
+    (same-slot contenders resolved by max over packed values)."""
     return _init_impl(params, seeds_per_member, seed_mode)
 
 
@@ -267,21 +271,40 @@ def _init_impl(
     n, k, b, s = params.n, params.slots, params.buffer_slots, params.susp_slots
     idx = jnp.arange(n, dtype=jnp.int32)
     alive_key = make_key(0, PREC_ALIVE)
-    packed = jnp.zeros((n, k), dtype=SLOT_DTYPE)
-    packed = packed.at[idx, _hash(params, idx)].set(
-        _pack(params, idx, alive_key, idx, 0)
-    )
     if seed_mode == "ring":
         offs = jnp.arange(1, seeds_per_member + 1, dtype=jnp.int32)
     elif seed_mode == "fingers":
         offs = finger_offsets(n)
     else:
         raise ValueError(f"unknown seed_mode {seed_mode!r}")
-    # one batched scatter-max over all seed offsets (a per-offset loop
-    # would copy the [N, K] table once per stride at init)
-    peers = (idx[:, None] + offs[None, :]) % n  # [N, F]
-    packed = packed.at[idx[:, None], _hash(params, peers)].max(
-        _pack(params, peers, alive_key, idx[:, None], 0)
+    # self + seeds, one [B, F, K] one-hot max per row block: for each
+    # observer row the F+1 seed entries land in their hashed slots via
+    # comparison against the slot index, max-reduced over seeds —
+    # identical cell contents to a scatter-max, with bounded temps
+    offs_all = jnp.concatenate([jnp.zeros(1, jnp.int32), offs])
+    bb = min(n, 1024)
+    nblocks = (n + bb - 1) // bb
+    slot_ids = jnp.arange(k, dtype=jnp.int32)
+
+    def init_block(i, packed):
+        start = jnp.minimum(i * bb, n - bb)
+        rows = start + jnp.arange(bb, dtype=jnp.int32)  # [B]
+        peers = (rows[:, None] + offs_all[None, :]) % n  # [B, F+1]
+        slot = _hash(params, peers)  # [B, F+1]
+        val = _pack(params, peers, alive_key, rows[:, None], 0)
+        block = jnp.max(
+            jnp.where(
+                slot[:, :, None] == slot_ids[None, None, :],
+                val[:, :, None],
+                0,
+            ),
+            axis=1,
+        ).astype(SLOT_DTYPE)  # [B, K]
+        # clamped last block recomputes identical rows — no mask needed
+        return jax.lax.dynamic_update_slice(packed, block, (start, 0))
+
+    packed = jax.lax.fori_loop(
+        0, nblocks, init_block, jnp.zeros((n, k), dtype=SLOT_DTYPE)
     )
 
     buf_subj = jnp.full((n, b), n, dtype=jnp.int32)
