@@ -129,15 +129,6 @@ def battery_steps() -> list[tuple[str, list[str], dict, float, str]]:
          [py, "-u", "bench.py"],
          {**bench_env, "BENCH_N": "40000", "BENCH_GOSSIP_MODE": "pick"},
          2400.0, "BENCH_TPU_40k_pick.json"),
-        # VERDICT r3 item 2 quality bar on chip: pv_coverage >= 0.99 then
-        # 1% churn -> cluster-wide detection with FP 0.  The churn tail
-        # is protocol-bound at ~1625 ticks at n=100k (the CPU record's
-        # count exactly; the first chip attempt timed out at 3000s with
-        # detection at 0.995 and FP 0 — TPU_PVIEW_CONV_100k.txt.failed),
-        # so the cap covers init+boot+full tail at the measured ~1.7s/tick
-        ("pview100k_conv",
-         [py, "-u", "scripts/pview_converge.py", "100000", "2048"],
-         {}, 5400.0, "TPU_PVIEW_CONV_100k.txt"),
         # phase tables with the fixed pallas kernel and per-iteration
         # input variation; 40k shows where its per-tick time goes
         ("profile10k",
@@ -169,9 +160,6 @@ def battery_steps() -> list[tuple[str, list[str], dict, float, str]]:
         ("pview512k_boot",
          [py, "-u", "scripts/pview_converge.py", "524288", "2048"],
          {"PVIEW_SKIP_CHURN": "1"}, 3600.0, "TPU_PVIEW_CONV_512k.txt"),
-        ("pview1m_boot",
-         [py, "-u", "scripts/pview_converge.py", "1048576", "2048"],
-         {"PVIEW_SKIP_CHURN": "1"}, 4800.0, "TPU_PVIEW_CONV_1m.txt"),
         # VERDICT r4 item 5's chip half: the array-merge A/B was
         # CPU-measured (native wins 3-4x); this measures whether the
         # chip overturns it at sync-flood batch sizes.  Own artifact
@@ -180,6 +168,19 @@ def battery_steps() -> list[tuple[str, list[str], dict, float, str]]:
          [py, "-u", "scripts/bench_crdt_merge.py", "--tpu",
           "--out", "CRDT_MERGE_AB_TPU.json"],
          {}, 1800.0, "TPU_CRDT_AB.txt"),
+        # the true long gambles: the 100k full-churn bar (VERDICT r3
+        # item 2 on chip — the churn tail is protocol-bound at ~1625
+        # ticks, the CPU record's count exactly; cap sized from the
+        # measured ~1.7 s/tick) and the 1M boot rung.  These run LAST:
+        # the first 100k attempt cost a whole window to a hung init
+        # (since replaced), and a 5400 s step must never gate the
+        # cheap banks.
+        ("pview100k_conv",
+         [py, "-u", "scripts/pview_converge.py", "100000", "2048"],
+         {}, 5400.0, "TPU_PVIEW_CONV_100k.txt"),
+        ("pview1m_boot",
+         [py, "-u", "scripts/pview_converge.py", "1048576", "2048"],
+         {"PVIEW_SKIP_CHURN": "1"}, 4800.0, "TPU_PVIEW_CONV_1m.txt"),
         # (the legacy pview100k inline-code step was dropped: its 0.95
         # coverage bar is strictly weaker than pview100k_conv's 0.99 +
         # churn phase — a live window must not pay for the same rung twice)
