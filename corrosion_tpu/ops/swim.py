@@ -200,6 +200,24 @@ def init_state(
     just devcluster bootstrap-address choices: a real deployment
     configures gossip.bootstrap freely, and log2(n) configured
     addresses is modest (17 entries at 100k)."""
+    # ONE jitted program (the pview kernel learned this at r5 chip
+    # scale): run eagerly, every `.at[].set` on the [N, N] view is its
+    # own dispatch producing a fresh view-sized buffer — at n=80k the
+    # tunnel backend's lazy deallocation of that churn starved the next
+    # allocation (membership_stats OOMed at runtime with only ~13 GB
+    # live).  Jitted, init is a single output buffer and one compile.
+    return _init_state_impl(params, rng, seeds_per_member, seed_mode)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("params", "seeds_per_member", "seed_mode")
+)
+def _init_state_impl(
+    params: SwimParams,
+    rng: jax.Array,
+    seeds_per_member: int,
+    seed_mode: str,
+) -> SwimState:
     n, b, s = params.n, params.buffer_slots, params.susp_slots
     view = jnp.zeros((n, n), dtype=VIEW_DTYPE)
     idx = jnp.arange(n)
@@ -742,7 +760,21 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
                 v, jnp.maximum(vw, pulled), (jnp.int32(0), w)
             )
 
-        view = jax.lax.fori_loop(0, nfeeds, one_feed, view)
+        # unrolled (nfeeds is static, typically 4): a fori_loop here nests
+        # an inner while around the [N, N] view inside tick_n's scan, and
+        # XLA's copy insertion then double-buffers the view across the
+        # loop boundary — a compile-time OOM at n=80k (24.2 G > 15.75 G
+        # HBM; PROFILE.md "80k dense OOM" preserves the allocation
+        # report). Unrolled, the whole tick
+        # updates the view in place under donation. Unrolling is linear
+        # in nfeeds (HLO size and compile time), so unusually large
+        # values keep the rolled loop: those configs pay the view
+        # double-buffer, which only matters where n is also huge.
+        if nfeeds <= 8:
+            for _k in range(nfeeds):
+                view = one_feed(_k, view)
+        else:
+            view = jax.lax.fori_loop(0, nfeeds, one_feed, view)
 
     # ---- 4c. bootstrap-seed exchange -------------------------------------
     # The reference's announcer keeps announcing to its CONFIGURED
@@ -913,9 +945,12 @@ def _stats_impl(view, alive):
 # [B, N] row blocks for the stats reductions.  The whole-view
 # formulation materialized shared prec/known temporaries next to the
 # int16 view — at n=80k that is multi-GB of HLO temps beside a 12.8 GB
-# view, which OOMed a 16 GB v5e chip (BENCH_TPU_80k.json.failed, r5).
-# Blocking caps the temps at [B, N] regardless of n.
-_STATS_BLOCK = 2048
+# view, which OOMed a 16 GB v5e chip (PROFILE.md "80k dense OOM", r5).
+# Blocking caps the temps at [B, N] regardless of n.  B=512: at n=80k
+# the resident view leaves under 4 GB of headroom, and the B=2048
+# blocks' f32 temps still exhausted it at runtime; 512 keeps the
+# streamed temps a few hundred MB for no measurable CPU/TPU cost.
+_STATS_BLOCK = 512
 
 
 def _stats_sums(view, alive):
