@@ -586,7 +586,19 @@ def tick_impl(
                 pulled, psafe = _feed_pull(pk, fk)
                 return _feed_merge(pk, pulled, psafe[:, None])
 
-            packed = jax.lax.fori_loop(0, nfeeds, one_feed, packed)
+            # ALWAYS unrolled (nfeeds is static, default 4-8): a
+            # fori_loop here is an inner while carrying the [N, K]
+            # table inside tick_n's scan, and XLA's copy insertion
+            # answers that nesting by double-buffering the carried
+            # table (PROFILE.md "80k dense OOM" documents the dense
+            # sibling) — at K=2048 that rejects the 1M-member table
+            # (2 x 8.6 GiB) on a 16 GiB chip. A rolled fallback for
+            # large nfeeds would be a silent memory cliff one notch
+            # above the scripts' default of 8; unrolling instead costs
+            # compile time linear in nfeeds, which is the safer trade
+            # at any configuration this kernel realistically sees.
+            for _fk in range(nfeeds):
+                packed = one_feed(_fk, packed)
 
     # ---- 4c. bootstrap-seed exchange (see swim.py 4c: the reference's
     # always-running bootstrap announcer; without it a healed partition
@@ -741,55 +753,90 @@ def set_partition(state: PViewState, groups) -> PViewState:
     return state._replace(partition=jnp.asarray(groups, dtype=jnp.int32))
 
 
+# [B, K] row blocks for the stats pass, mirroring the dense kernel's
+# _stats_sums: the whole-table formulation unpacks subj/key plus ~6
+# derived [N, K] temporaries in one program — at n=512k (4.3 GiB
+# table) that program crashed the tunnel's remote-compile helper
+# outright (HTTP 500, tpu_compile_helper exit 1) while init and the
+# tick itself compiled fine. Blocking caps every temp at [B, K]; the
+# [n] in-degree/stale accumulators ride the loop carry.
+_STATS_BLOCK_ROWS = 4096
+
+
 @functools.partial(jax.jit, static_argnames=("params",))
 def _stats_impl(params: PViewParams, packed, alive, t):
-    n = params.n
+    n, k = params.n, params.slots
     af = alive.astype(jnp.float32)
     n_alive = jnp.maximum(jnp.sum(af), 1.0)
-    rows = jnp.arange(n, dtype=jnp.int32)[:, None]
-    subj, key = _unpack(params, packed, rows, t)
-    occupied = key > 0
-    prec = key_prec(key)
-    live_obs = alive[:, None]
-    subj_alive = alive[jnp.clip(subj, 0, n - 1)]
-    # in-degree: for each subject, how many LIVE observers hold it alive
-    ka_entry = occupied & (prec == PREC_ALIVE) & live_obs & (subj != jnp.arange(n)[:, None])
-    indeg = (
-        jnp.zeros(n, dtype=jnp.int32)
-        .at[jnp.where(ka_entry, subj, 0)]
-        .add(ka_entry.astype(jnp.int32))
+    b = min(n, _STATS_BLOCK_ROWS)
+    nblocks = (n + b - 1) // b
+
+    def body(i, acc):
+        indeg, stale, total, fp_sum, occ_sum = acc
+        start = jnp.minimum(i * b, n - b)
+        blk = jax.lax.dynamic_slice(packed, (start, jnp.int32(0)), (b, k))
+        row_ids = start + jnp.arange(b, dtype=jnp.int32)
+        rows = row_ids[:, None]
+        subj, key = _unpack(params, blk, rows, t)
+        occupied = key > 0
+        prec = key_prec(key)
+        live_obs = jax.lax.dynamic_slice(alive, (start,), (b,))[:, None]
+        subj_alive = alive[jnp.clip(subj, 0, n - 1)]
+        # clamped last block: rows an earlier block already counted are
+        # masked out (same dedupe as swim._stats_sums)
+        fresh = (row_ids >= i * b)[:, None]
+        # in-degree: for each subject, how many LIVE observers hold it
+        # alive
+        ka_entry = (
+            occupied & (prec == PREC_ALIVE) & live_obs
+            & (subj != rows) & fresh
+        )
+        indeg = indeg.at[jnp.where(ka_entry, subj, 0)].add(
+            ka_entry.astype(jnp.int32)
+        )
+        # float32 accumulators: bool sums default to int32, and n·slots
+        # crosses 2^31 at n=2M×K=2048 — the wrapped total made
+        # `expected` negative and the pv_coverage threshold vacuously
+        # true (caught on the first 2M rung; float32's ~2^-24 relative
+        # rounding is irrelevant for a mean)
+        total = total + jnp.sum((ka_entry & subj_alive).astype(jnp.float32))
+        fp_entries = (
+            occupied & (prec >= PREC_SUSPECT) & live_obs & subj_alive
+            & fresh
+        )
+        fp_sum = fp_sum + jnp.sum(fp_entries.astype(jnp.float32))
+        occ_sum = occ_sum + jnp.sum(
+            (occupied & live_obs & fresh).astype(jnp.float32)
+        )
+        # churn detection: a dead member counts as DETECTED when no
+        # live observer still holds an ALIVE entry for it
+        # (suspect/down entries and absence both mean "won't be routed
+        # to") — the partial-view analog of the dense kernel's "dead
+        # members marked down" (swim.py stats)
+        stale_entry = (
+            occupied & (prec == PREC_ALIVE) & live_obs & ~subj_alive
+            & fresh
+        )
+        stale = stale.at[jnp.where(stale_entry, subj, 0)].add(
+            stale_entry.astype(jnp.int32)
+        )
+        return indeg, stale, total, fp_sum, occ_sum
+
+    zeros_n = jnp.zeros(n, dtype=jnp.int32)
+    zf = jnp.float32(0.0)
+    indeg, stale_per_subject, total_entries, fp_sum, occ_sum = (
+        jax.lax.fori_loop(
+            0, nblocks, body, (zeros_n, zeros_n, zf, zf, zf)
+        )
     )
-    # float32 accumulators: bool sums default to int32, and n·slots
-    # crosses 2^31 at n=2M×K=2048 — the wrapped total made `expected`
-    # negative and the pv_coverage threshold vacuously true (caught on
-    # the first 2M rung; float32's ~2^-24 relative rounding is
-    # irrelevant for a mean)
-    total_entries = jnp.sum((ka_entry & subj_alive).astype(jnp.float32))
     expected = total_entries / n_alive  # mean in-degree over live subjects
     live_indeg = jnp.where(alive, indeg, jnp.int32(INT32_MAX))
     min_in = jnp.min(live_indeg)
     pv_cov = jnp.sum(
         jnp.where(alive, (indeg.astype(jnp.float32) >= expected * 0.5), False)
     ) / n_alive
-    fp_entries = occupied & (prec >= PREC_SUSPECT) & live_obs & subj_alive
-    fp = jnp.sum(fp_entries.astype(jnp.float32)) / jnp.maximum(
-        jnp.sum(af) * (n_alive - 1), 1.0
-    )
-    occ = jnp.sum((occupied & live_obs).astype(jnp.float32)) / (
-        n_alive * params.slots
-    )
-    # churn detection: a dead member counts as DETECTED when no live
-    # observer still holds an ALIVE entry for it (suspect/down entries and
-    # absence both mean "won't be routed to") — the partial-view analog of
-    # the dense kernel's "dead members marked down" (swim.py stats)
-    stale_alive = (
-        occupied & (prec == PREC_ALIVE) & live_obs & ~subj_alive
-    )
-    stale_per_subject = (
-        jnp.zeros(n, dtype=jnp.int32)
-        .at[jnp.where(stale_alive, subj, 0)]
-        .add(stale_alive.astype(jnp.int32))
-    )
+    fp = fp_sum / jnp.maximum(jnp.sum(af) * (n_alive - 1), 1.0)
+    occ = occ_sum / (n_alive * params.slots)
     n_dead = jnp.sum(~alive)
     detected = jnp.where(
         n_dead > 0,

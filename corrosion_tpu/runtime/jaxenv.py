@@ -214,6 +214,28 @@ def run_python(
         return None
 
 
+def default_tpu_compile_env() -> None:
+    """Defaults the TPU topology env vars the tunnel's chipless AOT
+    compile helper needs but the terminal does not always provide.
+
+    Programs whose compilation consults accelerator "host bounds" (seen
+    first on the 1M-member pview init, an 8.6 GiB-output program) fail
+    with `remote_compile: HTTP 500, tpu_compile_helper exit 1` and
+    "Failed to find host bounds for accelerator type: WARNING: could
+    not determine TPU accelerator type" when TPU_ACCELERATOR_TYPE is
+    unset; setting it client-side propagates through to the helper and
+    was verified to fix that exact compile (PROFILE.md r5). Applied
+    ONLY when the axon tunnel plugin is the selected backend — a real
+    multi-chip pod (JAX_PLATFORMS=tpu or unset) auto-detects its
+    topology and must not be pinned to a single-chip type — and
+    setdefault only, so an environment that knows its topology wins."""
+    if "axon" not in os.environ.get("JAX_PLATFORMS", ""):
+        return
+    os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-1")
+    os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+    os.environ.setdefault("TPU_WORKER_ID", "0")
+
+
 def enable_compilation_cache(path: str | None = None) -> str:
     """Point jax at a persistent on-disk compilation cache.
 
@@ -229,6 +251,9 @@ def enable_compilation_cache(path: str | None = None) -> str:
         "CORRO_JAX_CACHE", "/tmp/corrosion_jax_cache"
     )
     os.makedirs(cache, exist_ok=True)
+    # every TPU-touching entry point routes through here before its
+    # first compile — the natural seam for the helper-env defaults
+    default_tpu_compile_env()
     import jax
 
     jax.config.update("jax_compilation_cache_dir", cache)
