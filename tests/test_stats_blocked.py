@@ -1,0 +1,139 @@
+"""Blocked stats passes vs their whole-view/whole-table references.
+
+Both kernels stream their stats reductions over row blocks so the
+temporaries stay [B, N]/[B, K] no matter how big the state is (the
+whole-view forms OOMed an 80k dense run and crashed the 512k pview
+remote compile — PROFILE.md "80k dense OOM" / "the tunnel's
+device-execution-time limit").  These tests pin the blocked passes to
+the straightforward whole-state formulations they replaced, on shapes
+that force multi-block paths with a CLAMPED, overlapping last block,
+and on states that exercise every lane (live/dead members, suspect and
+down entries, self diagonals).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corrosion_tpu.ops import swim, swim_pview
+
+
+def _dense_reference(view, alive):
+    """The pre-blocking whole-view formulation of swim._stats_impl."""
+    n = view.shape[0]
+    af = np.asarray(alive, dtype=np.float32)
+    prec = np.asarray(swim.key_prec(view))
+    known = np.asarray(swim.key_known(view))
+    n_alive = af.sum()
+    row_ka = np.where(known & (prec == swim.PREC_ALIVE), af[None, :], 0.0).sum(1)
+    row_td = np.where(
+        known & (prec == swim.PREC_DOWN), 1.0 - af[None, :], 0.0
+    ).sum(1)
+    row_fp = np.where(
+        known & (prec >= swim.PREC_SUSPECT), af[None, :], 0.0
+    ).sum(1)
+    cov_num = (row_ka * af).sum() - n_alive  # minus the alive diagonal
+    det_num = (row_td * af).sum()
+    fp_num = (row_fp * af).sum()
+    n_alive_pairs = max(n_alive * (n_alive - 1.0), 1.0)
+    n_dead_pairs = max(n_alive * (n - n_alive), 1.0)
+    return np.array(
+        [cov_num / n_alive_pairs, det_num / n_dead_pairs, fp_num / n_alive_pairs],
+        dtype=np.float32,
+    )
+
+
+@pytest.mark.parametrize("n", [96, 193])
+def test_dense_stats_match_whole_view_reference(monkeypatch, n):
+    # block far smaller than n and NOT dividing it: the final block
+    # clamps and overlaps, exercising the fresh-row dedupe mask
+    monkeypatch.setattr(swim, "_STATS_BLOCK", 64)
+    # scoped: only this function captured the patched block-size global;
+    # jax.clear_caches() would evict every compiled kernel in the session
+    swim._stats_impl.clear_cache()
+    params = swim.SwimParams(n=n)
+    state = swim.init_state(params, jax.random.PRNGKey(0), 3, "fingers")
+    rng = jax.random.PRNGKey(1)
+    for _ in range(6):
+        rng, key = jax.random.split(rng)
+        state = swim.tick(state, key, params)
+    # kill a handful mid-run so DOWN/suspect entries and dead subjects
+    # appear; more ticks let suspicion propagate
+    for m in (1, n // 2, n - 5):
+        state = swim.set_alive(state, m, False)
+    for _ in range(10):
+        rng, key = jax.random.split(rng)
+        state = swim.tick(state, key, params)
+
+    got = np.asarray(jax.device_get(swim._stats_impl(state.view, state.alive)))
+    want = _dense_reference(np.asarray(state.view), np.asarray(state.alive))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def _pview_reference(params, packed, alive, t):
+    """The pre-blocking whole-table formulation of swim_pview._stats_impl."""
+    n = params.n
+    af = np.asarray(alive, dtype=np.float32)
+    n_alive = max(af.sum(), 1.0)
+    rows = np.arange(n, dtype=np.int32)[:, None]
+    subj, key = swim_pview._unpack(params, jnp.asarray(packed), rows, t)
+    subj, key = np.asarray(subj), np.asarray(key)
+    occupied = key > 0
+    prec = np.asarray(swim_pview.key_prec(jnp.asarray(key)))
+    live_obs = np.asarray(alive)[:, None]
+    subj_alive = np.asarray(alive)[np.clip(subj, 0, n - 1)]
+    ka = occupied & (prec == swim_pview.PREC_ALIVE) & live_obs & (subj != rows)
+    indeg = np.zeros(n, dtype=np.int64)
+    np.add.at(indeg, np.where(ka, subj, 0), ka.astype(np.int64))
+    total = (ka & subj_alive).sum(dtype=np.float64)
+    expected = total / n_alive
+    min_in = indeg[np.asarray(alive)].min()
+    pv_cov = (
+        np.where(np.asarray(alive), indeg >= expected * 0.5, False).sum() / n_alive
+    )
+    fp_entries = occupied & (prec >= swim_pview.PREC_SUSPECT) & live_obs & subj_alive
+    fp = fp_entries.sum() / max(af.sum() * (n_alive - 1), 1.0)
+    occ = (occupied & live_obs).sum() / (n_alive * params.slots)
+    stale = occupied & (prec == swim_pview.PREC_ALIVE) & live_obs & ~subj_alive
+    stale_per = np.zeros(n, dtype=np.int64)
+    np.add.at(stale_per, np.where(stale, subj, 0), stale.astype(np.int64))
+    dead = ~np.asarray(alive)
+    n_dead = dead.sum()
+    detected = (
+        (dead & (stale_per == 0)).sum() / max(n_dead, 1) if n_dead else 1.0
+    )
+    return np.array(
+        [pv_cov, expected, float(min_in), occ, fp, detected], dtype=np.float32
+    )
+
+
+@pytest.mark.parametrize("n,slots", [(193, 64), (520, 96)])
+def test_pview_stats_match_whole_table_reference(monkeypatch, n, slots):
+    monkeypatch.setattr(swim_pview, "_STATS_BLOCK_ROWS", 64)
+    swim_pview._stats_impl.clear_cache()
+    params = swim_pview.PViewParams(
+        n=n, slots=slots, feeds_per_tick=4, feed_entries=16
+    )
+    state = swim_pview.init_state(params, jax.random.PRNGKey(0), seed_mode="fingers")
+    rng = jax.random.PRNGKey(1)
+    for _ in range(8):
+        rng, key = jax.random.split(rng)
+        state = swim_pview.tick(state, key, params)
+    kills = np.random.RandomState(0).choice(n, max(2, n // 40), replace=False)
+    state = swim_pview.set_alive_many(state, kills, False)
+    for _ in range(10):
+        rng, key = jax.random.split(rng)
+        state = swim_pview.tick(state, key, params)
+
+    got = np.asarray(
+        jax.device_get(
+            swim_pview._stats_impl(params, state.slot_packed, state.alive, state.t)
+        )
+    )
+    want = _pview_reference(
+        params, np.asarray(state.slot_packed), np.asarray(state.alive), state.t
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
