@@ -17,7 +17,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from corrosion_tpu.ops import swim
+from corrosion_tpu.ops import swim, swim_pview
+from corrosion_tpu.runtime.metrics import record_phase_seconds
 
 
 @dataclass
@@ -172,3 +173,112 @@ class ClusterSim:
             if self.stats()["detected"] >= detect_target:
                 return i
         return None
+
+
+class PViewClusterSim:
+    """The bounded partial-view counterpart of ClusterSim: drives
+    `ops.swim_pview` as a simulated devcluster past the dense kernel's
+    [N, N] memory wall.  Same driver shape (step / crash / stats /
+    run-until loops); convergence is the pview bar — pv_coverage +
+    in-degree quorum + table saturation + FP 0, the four terms
+    `scripts/pview_converge.py` banks rungs under.
+
+    Wall-clock per step() is published to the shared metrics registry
+    (`corro.kernel.phase.seconds{kernel="pview", phase="tick"}`), so an
+    agent embedding a simulation exposes tick cost on /metrics the same
+    way its loops expose lag."""
+
+    def __init__(
+        self,
+        n: int,
+        slots: int = 1024,
+        seed: int = 0,
+        seed_mode: str = "fingers",
+        **param_overrides,
+    ):
+        self.params = swim_pview.PViewParams(n=n, slots=slots, **param_overrides)
+        self._rng = jax.random.PRNGKey(seed)
+        self._rng, init_key = jax.random.split(self._rng)
+        self.state = swim_pview.init_state(
+            self.params, init_key, seed_mode=seed_mode
+        )
+        self.ticks = 0  # host-side mirror of state.t (no device readback)
+
+    def step(self, ticks: int = 1) -> None:
+        """Advance `ticks` protocol periods in ONE donated dispatch."""
+        self._rng, key = jax.random.split(self._rng)
+        t0 = time.monotonic()
+        self.state = swim_pview.tick_n_donated(
+            self.state, key, self.params, ticks
+        )
+        jax.block_until_ready(self.state.slot_packed)
+        record_phase_seconds(
+            "pview", "tick", (time.monotonic() - t0) / max(1, ticks)
+        )
+        self.ticks += ticks
+
+    def crash_many(self, members) -> None:
+        self.state = swim_pview.set_alive_many(self.state, members, False)
+
+    def restart_many(self, members) -> None:
+        self.state = swim_pview.set_alive_many(self.state, members, True)
+
+    def stats(self) -> Dict[str, float]:
+        return swim_pview.membership_stats(self.state, self.params)
+
+    def converged(self, stats: Dict[str, float], cov_target: float = 0.99,
+                  quorum: int = 8) -> bool:
+        return (
+            stats["pv_coverage"] >= cov_target
+            and stats["min_in_degree"] >= quorum
+            and stats["mean_in_degree"]
+            >= swim_pview.saturation_floor(self.params.n, self.params.slots)
+            and stats["false_positive"] == 0.0
+        )
+
+    def run_until_converged(
+        self,
+        cov_target: float = 0.99,
+        quorum: int = 8,
+        max_ticks: int = 2000,
+        check_every: int = 10,
+    ) -> Optional[int]:
+        """Host-driven chunked loop (the tunnel-safe shape): advance
+        `check_every` ticks per dispatch, check the four-term bar on
+        host.  Returns the tick count at convergence or None."""
+        while self.ticks < max_ticks:
+            self.step(min(check_every, max_ticks - self.ticks))
+            if self.converged(self.stats(), cov_target, quorum):
+                return self.ticks
+        return None
+
+    def run_until_converged_device(
+        self,
+        cov_target: float = 0.99,
+        quorum: int = 8,
+        max_ticks: int = 2000,
+        check_every: int = 10,
+    ) -> Optional[int]:
+        """`run_until_converged` with the tick/check loop resident ON
+        DEVICE (swim_pview.run_to_converged): one dispatch, zero host
+        round-trips until the bar holds.  NOT for the tunneled chip —
+        the tunnel kills single executions past ~45-60 s (PROFILE.md);
+        use the host loop there."""
+        if check_every < 1:
+            raise ValueError("check_every must be >= 1")
+        self._rng, key = jax.random.split(self._rng)
+        limit = self.ticks + max_ticks
+        self.state, vals = swim_pview.run_to_converged(
+            self.state, key, self.params,
+            float(cov_target), int(quorum), int(check_every), int(limit),
+        )
+        self.ticks = int(self.state.t)
+        vals = np.asarray(jax.device_get(vals))
+        sat = swim_pview.saturation_floor(self.params.n, self.params.slots)
+        ok = (
+            vals[0] >= np.float32(cov_target)
+            and vals[2] >= quorum
+            and vals[1] >= np.float32(sat)
+            and vals[4] == 0.0
+        )
+        return self.ticks if ok else None

@@ -290,7 +290,18 @@ def _pick_known_alive(view_rows, self_idx, rng, params: SwimParams, tries: int):
 # make_key(INC_CAP, 3) = 32763 < 2^15 everywhere they are generated (see
 # VIEW_DTYPE note), and real send counts stay ≤ max_transmissions+fanout
 # ≪ 2^15 — the INT32_MAX empty sentinel clamps to _SENT_CLAMP, which
-# still orders after every real count
+# still orders after every real count.
+#
+# CROSS-KERNEL CONTRACT (r6): the 15-bit key domain and _SENT_CLAMP are
+# load-bearing for the partial-view kernel too — swim_pview stores its
+# buf_key/buf_sent lanes as int16 at rest (LANE_DTYPE) precisely because
+# every merged key stays < 2^15 and every merged send count stays
+# <= _SENT_CLAMP = 2^15 - 1 (the int16 maximum, exactly), and it
+# INITIALIZES empty buf_sent slots at _SENT_CLAMP rather than the dense
+# kernel's INT32_MAX sentinel (trajectory-identical: the first merge
+# normalizes the sentinel to the clamp, and every consumer only tests
+# `sent < max_transmissions` or ordering).  Widening _KEY_BITS would
+# silently overflow those lanes — change both together.
 _KEY_BITS = 15
 _KEY_MAX = (1 << _KEY_BITS) - 1
 _SENT_CLAMP = (1 << _KEY_BITS) - 1

@@ -54,6 +54,18 @@ runtime, `klukai-agent/src/broadcast/mod.rs:121-386`), with the member
 list bounded — the partial-view generalization follows the same design
 space as SWIM-with-partial-views gossip systems (HyParView/Scamp
 lineage), which is how membership scales past the full-view regime.
+
+r6 optimization round (this kernel's first — the dense kernel had
+three): `tick_mode="fused"` restructures the tick so every table
+reader materializes against the tick-start table ahead of ONE in-place
+merge scatter chain (kills the XLA whole-table copy that rejected
+1M×2048 on a single chip — see `tick_impl`); `gossip_mode="shift"`
+ports the dense kernel's sortless row-gather delivery; the
+buf_key/buf_sent/susp_inc lanes store int16 at rest (LANE_DTYPE); and
+`run_to_converged` is the device-resident convergence loop (the
+four-term bar evaluated on device, zero host round-trips).  The
+round-5 formulation stays selectable (`tick_mode="r5"`) as the
+bit-parity reference.
 """
 
 from __future__ import annotations
@@ -70,6 +82,7 @@ from corrosion_tpu.ops.swim import (
     PREC_DOWN,
     PREC_SUSPECT,
     INC_CAP,
+    _SENT_CLAMP,
     _buffer_merge,
     dispatch_inbox,
     finger_offsets,
@@ -84,6 +97,18 @@ _HASH_MULT = jnp.uint32(2654435761)  # Knuth multiplicative constant
 SLOT_DTYPE = jnp.int32  # packed (key*P + subj^mask) words need 31 bits;
 # int16 is NOT an option here (unlike the dense kernel's VIEW_DTYPE) —
 # the pack bound key*P < 2^31 already consumes the whole word
+
+LANE_DTYPE = jnp.int16  # at-rest dtype for the state lanes whose ranges
+# provably fit 15 bits: buf_key (keys < 2^15 — the INC_CAP invariant every
+# generation site enforces), buf_sent (clamped to _SENT_CLAMP = 2^15-1 by
+# the shared buffer merge; init writes the clamp directly instead of the
+# dense kernel's INT32_MAX sentinel — trajectory-identical, every consumer
+# only tests `sent < max_transmissions` or ordering), and susp_inc
+# (incarnations cap at INC_CAP = 8189).  The tick widens them to int32 on
+# entry and narrows on exit; subjects (up to n = 2M) and the packed slot
+# words (31 bits) stay int32.  The dense kernel applied the same lever to
+# its dominant array (the int16 view); here the table cannot narrow, so
+# the win is the carried gossip/suspicion lanes.
 
 
 class PViewParams(NamedTuple):
@@ -123,6 +148,28 @@ class PViewParams(NamedTuple):
     # scatter launches; the CPU tick is feed-scatter bound, PROFILE.md
     # r4 pview phase table)
     feed_mode: str = "seq"
+    # tick structure: "fused" (default — the r6 restructure: every
+    # pre-merge reader of the slot table materializes against the
+    # TICK-START table behind an optimization barrier, then ONE merge
+    # scatter chain updates it in place; this is what eliminates the
+    # XLA-inserted whole-table copy that rejected the 1M×2048 rung at
+    # compile time, PROFILE.md "Round 5: 1M on chip") or "r5" (the
+    # round-5 formulation: feeds merge sequentially and later phases
+    # read the already-merged table — required for the identity-hash
+    # bit-parity pin against the dense kernel, and the reference the
+    # fused tick's convergence is pinned against).  In "fused" mode
+    # feed partner picks read the pre-feed table (the "batched" feed
+    # semantics — one merge staler, convergence-equivalent); feed_mode
+    # is ignored.
+    tick_mode: str = "fused"
+    # gossip target selection, mirroring swim.SwimParams.gossip_mode:
+    # "shift" (default — the dense kernel's r5-decided lever): per-
+    # (tick, fanout-slot) random GLOBAL offsets make delivery an exact
+    # row gather of the send planes — no destination sort at all.
+    # "pick": per-member known-alive picks + the grouped-sort inbox
+    # build (the r5 path; the identity-hash parity pin uses it because
+    # the dense parity contract is pick-shaped).
+    gossip_mode: str = "shift"
 
 
 def _keycap(n: int) -> int:
@@ -226,14 +273,16 @@ class PViewState(NamedTuple):
     inc: jax.Array  # [N] int32 — own incarnation
     slot_packed: jax.Array  # [N, K] int32 — key*P + (subj^mask), 0 = empty
     buf_subj: jax.Array  # [N, B] int32 — gossip buffer (N = empty)
-    buf_key: jax.Array  # [N, B] int32
-    buf_sent: jax.Array  # [N, B] int32 (empty: INT32_MAX at init; subj==n is the real marker)
+    buf_key: jax.Array  # [N, B] LANE_DTYPE (int16) — keys < 2^15
+    buf_sent: jax.Array  # [N, B] LANE_DTYPE (int16) — empty slots hold
+    # _SENT_CLAMP (the post-merge normalization of the dense kernel's
+    # INT32_MAX sentinel; subj==n is the real empty marker)
     probe_phase: jax.Array  # [N] int32
     probe_subj: jax.Array  # [N] int32
     probe_deadline: jax.Array  # [N] int32
     probe_ok: jax.Array  # [N] bool
     susp_subj: jax.Array  # [N, S] int32 (N = empty)
-    susp_inc: jax.Array  # [N, S] int32
+    susp_inc: jax.Array  # [N, S] LANE_DTYPE (int16) — capped at INC_CAP
     susp_deadline: jax.Array  # [N, S] int32
     partition: jax.Array  # [N] int32 — network partition group (see
     # swim.SwimState.partition; same split-brain semantics)
@@ -308,8 +357,10 @@ def _init_impl(
     )
 
     buf_subj = jnp.full((n, b), n, dtype=jnp.int32)
-    buf_key = jnp.zeros((n, b), dtype=jnp.int32)
-    buf_sent = jnp.full((n, b), INT32_MAX, dtype=jnp.int32)
+    buf_key = jnp.zeros((n, b), dtype=LANE_DTYPE)
+    # _SENT_CLAMP, not INT32_MAX: the value every merge normalizes the
+    # dense sentinel to anyway (trajectory-identical, fits LANE_DTYPE)
+    buf_sent = jnp.full((n, b), _SENT_CLAMP, dtype=LANE_DTYPE)
     buf_subj = buf_subj.at[:, 0].set(idx)
     buf_key = buf_key.at[:, 0].set(alive_key)
     buf_sent = buf_sent.at[:, 0].set(0)
@@ -327,7 +378,7 @@ def _init_impl(
         probe_deadline=jnp.zeros(n, dtype=jnp.int32),
         probe_ok=jnp.zeros(n, dtype=bool),
         susp_subj=jnp.full((n, s), n, dtype=jnp.int32),
-        susp_inc=jnp.zeros((n, s), dtype=jnp.int32),
+        susp_inc=jnp.zeros((n, s), dtype=LANE_DTYPE),
         susp_deadline=jnp.zeros((n, s), dtype=jnp.int32),
         partition=jnp.zeros(n, dtype=jnp.int32),
     )
@@ -373,8 +424,34 @@ def tick_impl(
 ) -> PViewState:
     """One SWIM protocol period for every member, phase-for-phase the
     dense kernel (`swim.tick_impl`) with the view ops swapped for
-    hash-slot equivalents. Random draws match the dense kernel's shapes
-    and order exactly (parity contract)."""
+    hash-slot equivalents.
+
+    Two tick structures (``params.tick_mode``):
+
+    - ``"r5"``: the round-5 formulation — feeds merge into the table
+      sequentially, and every later phase (refutation diag, relay prev
+      gather) reads the already-merged table.  In ``gossip_mode="pick"``
+      its random draws match the dense kernel's shapes and order exactly
+      (the identity-hash parity contract).
+    - ``"fused"`` (default): every reader of the slot table — probe
+      lookups, target picks, anti-entropy lanes, ALL feed-window pulls,
+      the refutation diag and the relay's prev gather — reads the
+      TICK-START table; an optimization barrier then pins those reads
+      ahead of ONE merge scatter chain (feeds + inbox + own updates in a
+      single scatter-max, then the own-entry pin, then the tie-epoch
+      re-encode).  With no reader left that could observe the table
+      mid-mutation, XLA's copy insertion keeps the donated table fully
+      in place — this removes the whole-table HLO-temp copy that
+      rejected the 1M×2048 single-chip rung at compile time (PROFILE.md
+      "Round 5: 1M on chip").  Semantics vs "r5": feed partner picks and
+      the refutation diag are one merge staler (the "batched" feed
+      trade, convergence-pinned by tests/test_swim_pview.py).
+    """
+    if params.tick_mode not in ("fused", "r5"):
+        raise ValueError(f"unknown tick_mode: {params.tick_mode!r}")
+    if params.gossip_mode not in ("shift", "pick"):
+        raise ValueError(f"unknown gossip_mode: {params.gossip_mode!r}")
+    fused = params.tick_mode == "fused"
     n, k = params.n, params.slots
     idx = jnp.arange(n, dtype=jnp.int32)
     t = state.t
@@ -384,9 +461,12 @@ def tick_impl(
     inc = state.inc
     alive = state.alive
     part = state.partition
-    buf_subj, buf_key, buf_sent = state.buf_subj, state.buf_key, state.buf_sent
+    # narrowed at-rest lanes widen to int32 for the tick's arithmetic
+    buf_subj = state.buf_subj
+    buf_key = state.buf_key.astype(jnp.int32)
+    buf_sent = state.buf_sent.astype(jnp.int32)
     susp_subj = state.susp_subj
-    susp_inc = state.susp_inc
+    susp_inc = state.susp_inc.astype(jnp.int32)
     susp_deadline = state.susp_deadline
 
     # suspect / down / refute / periodic self-announce
@@ -468,15 +548,27 @@ def tick_impl(
 
     # ---- 3. gossip send --------------------------------------------------
     m, f = params.piggyback, params.fanout
-    tg = jnp.stack(
-        [
-            _pick_known_alive(
-                params, packed, idx, jax.random.fold_in(r_gossip, j), 2, t
-            )
-            for j in range(f)
-        ],
-        axis=1,
-    )
+    if params.gossip_mode == "shift":
+        # per-(tick, slot) random global offsets (the dense kernel's r5
+        # default): member i sends slot j's packet to (i + off_j) mod n,
+        # so delivery in step 4 is an exact row gather — no target-pick
+        # table scans, no destination sort.  Same fold_in constant as
+        # the dense kernel so the two shift modes draw identically.
+        shift_off = jax.random.randint(
+            jax.random.fold_in(r_gossip, 65537), (f,), 1, n,
+            dtype=jnp.int32,
+        )
+        tg = (idx[:, None] + shift_off[None, :]) % n  # [N, f]
+    else:
+        tg = jnp.stack(
+            [
+                _pick_known_alive(
+                    params, packed, idx, jax.random.fold_in(r_gossip, j), 2, t
+                )
+                for j in range(f)
+            ],
+            axis=1,
+        )
     send_subj = buf_subj[:, :m]
     send_key = buf_key[:, :m]
     sendable = (send_subj < n) & (buf_sent[:, :m] < params.max_transmissions)
@@ -512,18 +604,35 @@ def tick_impl(
     drop = jax.random.uniform(r_loss, msg_ok.shape) < params.loss
     msg_ok = msg_ok & ~drop
 
-    # ---- 4. inbox (shared grouped build, impl-dispatched) ----------------
+    # ---- 4. delivery: bounded per-member inboxes -------------------------
     subj_gm = jnp.broadcast_to(send_subj[:, None, :], msg_ok.shape)
     key_gm = jnp.broadcast_to(send_key[:, None, :], msg_ok.shape)
-    in_subj, in_key = dispatch_inbox(
-        params.inbox_impl,
-        n,
-        params.incoming_slots,
-        tg_safe.reshape(-1),
-        subj_gm.reshape(-1, m),
-        key_gm.reshape(-1, m),
-        msg_ok.reshape(-1, m),
-    )
+    if params.gossip_mode == "shift":
+        # receiver r's slot-j packet comes from sender (r - off_j) mod n:
+        # an exact [N, f] row gather of the masked send planes (see
+        # swim.tick_impl step 4 — identical contract incl. the bounded-
+        # mailbox compaction when f*m exceeds the inbox cap)
+        src = (idx[:, None] - shift_off[None, :]) % n  # [N, f]
+        sub_m = jnp.where(msg_ok, subj_gm, n)
+        key_m = jnp.where(msg_ok, key_gm, 0)
+        jj = jnp.arange(f, dtype=jnp.int32)[None, :]
+        in_subj = sub_m[src, jj].reshape(n, f * m)
+        in_key = key_m[src, jj].reshape(n, f * m)
+        if f * m > params.incoming_slots:
+            order = jnp.argsort(in_subj == n, axis=1, stable=True)
+            take = order[:, : params.incoming_slots]
+            in_subj = jnp.take_along_axis(in_subj, take, axis=1)
+            in_key = jnp.take_along_axis(in_key, take, axis=1)
+    else:
+        in_subj, in_key = dispatch_inbox(
+            params.inbox_impl,
+            n,
+            params.incoming_slots,
+            tg_safe.reshape(-1),
+            subj_gm.reshape(-1, m),
+            key_gm.reshape(-1, m),
+            msg_ok.reshape(-1, m),
+        )
 
     # ---- 4b. announce/feed exchange over SLOT space ----------------------
     # identical window/rng structure to the dense kernel, but the window
@@ -549,69 +658,98 @@ def tick_impl(
         pulled = jnp.where(has_partner[:, None], pulled, 0)
         return pulled, psafe
 
-    def _feed_merge(pk, pulled, prows):
+    def _feed_updates(pulled, prows):
+        """(repacked values, hash columns) for pulled windows — the
+        scatter-max operands, re-encoded into the receiver's rotation."""
         p_subj, p_key = _unpack(params, pulled, prows, t)
-        # re-encode into the receiver's rotation before comparing
         repacked = jnp.where(
             pulled > 0,
             _pack(params, p_subj, p_key, idx[:, None], t),
             0,
         )
-        cols = _hash(params, p_subj)
+        return repacked, _hash(params, p_subj)
+
+    def _feed_merge(pk, pulled, prows):
+        repacked, cols = _feed_updates(pulled, prows)
         return pk.at[idx[:, None], cols].max(repacked)
 
-    if fe > 0 and nfeeds > 0:
-        if params.feed_mode not in ("seq", "batched"):
-            raise ValueError(f"unknown feed_mode: {params.feed_mode!r}")
-        if params.feed_mode == "batched":
-            # all picks read the PRE-feed table; the nfeeds windows merge
-            # in a single [N, nfeeds*fe] scatter-max (intra-tick picks
-            # are one merge staler — convergence pinned by
-            # test_swim_pview.py::test_batched_feed_mode_converges)
-            pulls, rows = [], []
-            for fk in range(nfeeds):
-                pulled, psafe = _feed_pull(packed, fk)
-                pulls.append(pulled)
-                rows.append(
-                    jnp.broadcast_to(psafe[:, None], (n, fe))
-                )
-            packed = _feed_merge(
-                packed,
-                jnp.concatenate(pulls, axis=1),
-                jnp.concatenate(rows, axis=1),
-            )
-        else:
-
-            def one_feed(fk, pk):
-                pulled, psafe = _feed_pull(pk, fk)
-                return _feed_merge(pk, pulled, psafe[:, None])
-
-            # ALWAYS unrolled (nfeeds is static, default 4-8): a
-            # fori_loop here is an inner while carrying the [N, K]
-            # table inside tick_n's scan, and XLA's copy insertion
-            # answers that nesting by double-buffering the carried
-            # table (PROFILE.md "80k dense OOM" documents the dense
-            # sibling) — at K=2048 that rejects the 1M-member table
-            # (2 x 8.6 GiB) on a 16 GiB chip. A rolled fallback for
-            # large nfeeds would be a silent memory cliff one notch
-            # above the scripts' default of 8; unrolling instead costs
-            # compile time linear in nfeeds, which is the safer trade
-            # at any configuration this kernel realistically sees.
-            for _fk in range(nfeeds):
-                packed = one_feed(_fk, packed)
-
-    # ---- 4c. bootstrap-seed exchange (see swim.py 4c: the reference's
-    # always-running bootstrap announcer; without it a healed partition
-    # never re-merges) ------------------------------------------------------
-    if fe > 0:
+    def _seed_pull(pk):
+        """Bootstrap-seed window pull (see swim.py 4c: the reference's
+        always-running bootstrap announcer; without it a healed
+        partition never re-merges)."""
         seed_off = 1 + (t // jnp.int32(max(1, params.announce_period))) % 3
         sp = (idx + seed_off) % n
         seed_ok = alive & alive[sp] & (part[sp] == part)
         j = t % steps_per_sweep
         w = jnp.minimum(j * fe, k - fe)
-        vw = jax.lax.dynamic_slice(packed, (jnp.int32(0), w), (n, fe))
+        vw = jax.lax.dynamic_slice(pk, (jnp.int32(0), w), (n, fe))
         pulled = jnp.take(vw, sp, axis=0)
-        pulled = jnp.where(seed_ok[:, None], pulled, 0)
+        return jnp.where(seed_ok[:, None], pulled, 0), sp
+
+    feed_vals = feed_cols = None
+    if fused:
+        # every pull reads the TICK-START table ("batched" feed
+        # semantics); the windows merge later as part of the single
+        # post-barrier scatter chain (step 6)
+        pulls, prows = [], []
+        if fe > 0 and nfeeds > 0:
+            for fk in range(nfeeds):
+                pulled, psafe = _feed_pull(packed, fk)
+                pulls.append(pulled)
+                prows.append(jnp.broadcast_to(psafe[:, None], (n, fe)))
+        if fe > 0:
+            pulled, sp = _seed_pull(packed)
+            pulls.append(pulled)
+            prows.append(jnp.broadcast_to(sp[:, None], (n, fe)))
+        if pulls:
+            feed_vals, feed_cols = _feed_updates(
+                jnp.concatenate(pulls, axis=1),
+                jnp.concatenate(prows, axis=1),
+            )
+    elif fe > 0:
+        if nfeeds > 0:
+            if params.feed_mode not in ("seq", "batched"):
+                raise ValueError(f"unknown feed_mode: {params.feed_mode!r}")
+            if params.feed_mode == "batched":
+                # all picks read the PRE-feed table; the nfeeds windows
+                # merge in a single [N, nfeeds*fe] scatter-max
+                # (intra-tick picks are one merge staler — convergence
+                # pinned by test_swim_pview.py)
+                pulls, rows = [], []
+                for fk in range(nfeeds):
+                    pulled, psafe = _feed_pull(packed, fk)
+                    pulls.append(pulled)
+                    rows.append(
+                        jnp.broadcast_to(psafe[:, None], (n, fe))
+                    )
+                packed = _feed_merge(
+                    packed,
+                    jnp.concatenate(pulls, axis=1),
+                    jnp.concatenate(rows, axis=1),
+                )
+            else:
+
+                def one_feed(fk, pk):
+                    pulled, psafe = _feed_pull(pk, fk)
+                    return _feed_merge(pk, pulled, psafe[:, None])
+
+                # ALWAYS unrolled (nfeeds is static, default 4-8): a
+                # fori_loop here is an inner while carrying the [N, K]
+                # table inside tick_n's scan, and XLA's copy insertion
+                # answers that nesting by double-buffering the carried
+                # table (PROFILE.md "80k dense OOM" documents the dense
+                # sibling) — at K=2048 that rejects the 1M-member table
+                # (2 x 8.6 GiB) on a 16 GiB chip. A rolled fallback for
+                # large nfeeds would be a silent memory cliff one notch
+                # above the scripts' default of 8; unrolling instead
+                # costs compile time linear in nfeeds, which is the
+                # safer trade at any configuration this kernel
+                # realistically sees.
+                for _fk in range(nfeeds):
+                    packed = one_feed(_fk, packed)
+
+        # ---- 4c. bootstrap-seed exchange ---------------------------------
+        pulled, sp = _seed_pull(packed)
         packed = _feed_merge(packed, pulled, sp[:, None])
 
     # ---- 5. refutation (inbox + own slot) --------------------------------
@@ -650,14 +788,64 @@ def tick_impl(
     cols = _hash(params, safe)
     prev = jnp.take_along_axis(packed, cols, axis=1)
     improved = new_packed > prev
-    packed = packed.at[idx[:, None], cols].max(new_packed)
-    # own entry pinned: force-write (never evicted by a colliding squatter)
-    self_key = make_key(inc, PREC_ALIVE)
     self_col = _hash(params, idx)
-    cur_self = packed[idx, self_col]
-    packed = packed.at[idx, self_col].set(
-        jnp.where(alive, _pack(params, idx, self_key, idx, t), cur_self)
-    )
+    if fused:
+        # ---- the merge scatter chain -------------------------------------
+        # Everything the tick ever READS from the table now exists: the
+        # FSM lookups, target picks, anti-entropy lanes, feed pulls,
+        # refutation diag and the relay's prev gather all consumed the
+        # tick-start table above.  The optimization barrier makes that
+        # ordering a data dependence — the scatter below consumes the
+        # barriered table, so XLA must schedule every read (every other
+        # barrier operand) first, and copy insertion has no reader left
+        # that could justify a whole-table HLO-temp copy beside the
+        # donated buffer (the 8.0 GiB copy.326 that rejected 1M×2048,
+        # PROFILE.md "Round 5: 1M on chip").  Barrier operands include
+        # the packed-derived values that leave through the FSM state
+        # rather than the merge, so none of those gathers can slide
+        # past the in-place mutation either.
+        if feed_vals is None:
+            feed_vals = jnp.zeros((n, 0), dtype=SLOT_DTYPE)
+            feed_cols = jnp.zeros((n, 0), dtype=jnp.int32)
+        (packed, feed_vals, feed_cols, new_packed, cols, prev, improved,
+         phase, psubj, pdl, pok, susp_subj, susp_inc, susp_deadline, inc,
+         ) = jax.lax.optimization_barrier(
+            (packed, feed_vals, feed_cols, new_packed, cols, prev, improved,
+             phase, psubj, pdl, pok, susp_subj, susp_inc, susp_deadline, inc)
+        )
+        # two in-place scatters, not one concatenated [N, W_total] plane:
+        # the updates are all precomputed above, so ordering stays
+        # provable, while XLA:CPU's scatter cost scales with the widest
+        # single plane it has to re-materialize (PROFILE.md r4: one
+        # [N, 8·fe] scatter measured 30% WORSE than eight [N, fe] ones)
+        # and the TPU path keeps its launch count at two
+        fw = feed_vals.shape[1]
+        step = max(1, fe)
+        for w0 in range(0, fw, step):
+            w1 = min(w0 + step, fw)
+            packed = packed.at[
+                idx[:, None],
+                jax.lax.slice_in_dim(feed_cols, w0, w1, axis=1),
+            ].max(jax.lax.slice_in_dim(feed_vals, w0, w1, axis=1))
+        packed = packed.at[idx[:, None], cols].max(new_packed)
+        # own entry pinned: force-write (never evicted by a colliding
+        # squatter); dead members' writes are masked by scattering them
+        # out of bounds (dropped) instead of gathering-then-rewriting
+        # the current cell — the gather would be a post-merge reader.
+        self_key = make_key(inc, PREC_ALIVE)
+        pin_rows = jnp.where(alive, idx, n)
+        packed = packed.at[pin_rows, self_col].set(
+            _pack(params, idx, self_key, idx, t), mode="drop"
+        )
+    else:
+        packed = packed.at[idx[:, None], cols].max(new_packed)
+        # own entry pinned: force-write (never evicted by a colliding
+        # squatter)
+        self_key = make_key(inc, PREC_ALIVE)
+        cur_self = packed[idx, self_col]
+        packed = packed.at[idx, self_col].set(
+            jnp.where(alive, _pack(params, idx, self_key, idx, t), cur_self)
+        )
 
     relay_ok = improved & (all_subj != idx[:, None]) & (all_subj < n)
     bin_subj = jnp.concatenate(
@@ -688,14 +876,17 @@ def tick_impl(
         inc=inc,
         slot_packed=packed,
         buf_subj=buf_subj,
-        buf_key=buf_key,
-        buf_sent=buf_sent,
+        # narrow the at-rest lanes back down (ranges proven: see
+        # LANE_DTYPE — keys < 2^15, sent <= _SENT_CLAMP = 2^15-1,
+        # incarnations <= INC_CAP)
+        buf_key=buf_key.astype(LANE_DTYPE),
+        buf_sent=jnp.minimum(buf_sent, _SENT_CLAMP).astype(LANE_DTYPE),
         probe_phase=phase,
         probe_subj=psubj,
         probe_deadline=pdl,
         probe_ok=pok,
         susp_subj=susp_subj,
-        susp_inc=susp_inc,
+        susp_inc=susp_inc.astype(LANE_DTYPE),
         susp_deadline=susp_deadline,
         partition=part,
     )
@@ -855,6 +1046,72 @@ def _stats_impl(params: PViewParams, packed, alive, t):
     )
 
 
+def saturation_floor(n: int, slots: int) -> float:
+    """The mean-in-degree bar a converged table must clear: 85% of the
+    expected distinct-subject count of a FULL row.  A subject occupies
+    exactly one hash column per row, so a full row holds
+    K*(1-(1-1/K)^(n-1)) distinct subjects in expectation (≈ n-1 for
+    n << K, ≈ K for n >> K; at n ≈ K it dips to K(1-1/e), which
+    min(n-1, slots-1) would overshoot unreachably).  Single definition
+    shared by the convergence scripts and the device-resident loop —
+    the two predicates must agree or a device-loop "converged" could
+    read as a host-loop miss."""
+    return 0.85 * min(
+        n - 1, slots * (1.0 - (1.0 - 1.0 / slots) ** (n - 1))
+    )
+
+
+def _run_to_converged_impl(
+    state, rng, params, cov_target, quorum, check_every, max_ticks
+):
+    """Tick until the pview convergence bar holds, ENTIRELY on device
+    (the pview counterpart of `swim.run_to_coverage`): a lax.while_loop
+    of check_every-tick scans with the blocked stats pass as predicate.
+    Bar (same four terms as scripts/pview_converge.py): pv_coverage >=
+    cov_target, min_in_degree >= quorum, mean_in_degree >= the
+    saturation floor, false_positive == 0.
+
+    Zero host round-trips between dispatch and convergence — on a
+    tunneled chip every host-side stats check costs a full RTT (~85 ms
+    measured).  CAUTION for tunnel use: the whole loop is ONE device
+    dispatch, and the axon tunnel kills executions past ~45-60 s
+    (PROFILE.md) — callers behind the tunnel must keep the host-driven
+    chunked loop instead.  Returns (state, stats_vec) with stats_vec the
+    final `_stats_impl` row, so callers read the verdict without paying
+    another stats dispatch."""
+    sat = saturation_floor(params.n, params.slots)
+
+    def _ok(vals):
+        return (
+            (vals[0] >= cov_target)
+            & (vals[2] >= jnp.float32(quorum))
+            & (vals[1] >= jnp.float32(sat))
+            & (vals[4] == 0.0)
+        )
+
+    def cond(carry):
+        st, _, vals = carry
+        return ~_ok(vals) & (st.t + check_every <= max_ticks)
+
+    def body(carry):
+        st, rng, _ = carry
+        rng, key = jax.random.split(rng)
+        st = _tick_n_impl(st, key, params, check_every)
+        return st, rng, _stats_impl(params, st.slot_packed, st.alive, st.t)
+
+    init_vals = jnp.full((6,), -1.0, dtype=jnp.float32)
+    state, _, vals = jax.lax.while_loop(cond, body, (state, rng, init_vals))
+    return state, vals
+
+
+run_to_converged = functools.partial(
+    jax.jit,
+    static_argnames=("params", "cov_target", "quorum", "check_every",
+                     "max_ticks"),
+    donate_argnums=(0,),
+)(_run_to_converged_impl)
+
+
 def membership_stats(state: PViewState, params: PViewParams) -> dict:
     """Partial-view stability metrics, one stacked device→host readback.
 
@@ -886,11 +1143,14 @@ def memory_gb(n: int, slots: int) -> dict:
     hash-slot entries, sharded over a v5e-8. The single source for the
     scale scripts' recorded notes — sized from SLOT_DTYPE (the packed
     words need the full 31 bits, so unlike the dense kernel's VIEW_DTYPE
-    this cannot narrow) for the table, and int32 gossip buffers (3×16
-    columns + ~10 FSM fields per member — hard-coded int32 in
-    init_state, sized independently of the slot words here)."""
+    this cannot narrow) for the table, plus the gossip/FSM lanes: one
+    int32 subject column and two LANE_DTYPE (int16) columns per buffer
+    slot (buf_key/buf_sent narrowed in r6), and ~10 int32-equivalent FSM
+    fields per member."""
+    i32 = jnp.dtype(jnp.int32).itemsize
+    lane = jnp.dtype(LANE_DTYPE).itemsize
     table_gb = n * slots * jnp.dtype(SLOT_DTYPE).itemsize / 2**30
-    bufs_gb = n * (16 * 3 + 10) * jnp.dtype(jnp.int32).itemsize / 2**30
+    bufs_gb = n * (16 * (i32 + 2 * lane) + 10 * i32) / 2**30
     return {
         "slot_table_gb": round(table_gb, 2),
         "buffers_fsm_gb": round(bufs_gb, 2),

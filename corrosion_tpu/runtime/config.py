@@ -11,7 +11,11 @@ sizes and protocol knobs with the reference's defaults (config.rs:11-59,
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # stdlib only on 3.11+; tomli is API-identical
+    import tomli as tomllib
 from dataclasses import dataclass, field, fields, is_dataclass, replace
 from typing import List, Optional
 

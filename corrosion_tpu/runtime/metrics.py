@@ -125,6 +125,32 @@ class Registry:
 
 METRICS = Registry()
 
+# Kernel phase-timing series (r6): the SWIM kernel profilers and the
+# simulation drivers publish per-phase device seconds under one family,
+#     corro.kernel.phase.seconds{kernel="pview"|"dense", phase="..."}
+# so a dashboard shows where the tick goes the same way PROFILE.md's
+# phase tables do.  Canonical pview phase names (the profiler's rows):
+PVIEW_PHASES = (
+    "pick",       # probe/feed partner selection gathers
+    "inbox",      # gossip delivery (grouped sort or shift row-gather)
+    "feed",       # feed/seed window pulls
+    "merge",      # the merge scatter chain (+ own-entry pin, re-encode)
+    "bufmrg",     # gossip buffer merge sorts
+    "stats",      # blocked stats pass + readback
+    "tick",       # whole fused tick (scanned, per tick)
+)
+
+
+def record_phase_seconds(
+    kernel: str, phase: str, seconds: float, registry: Registry = METRICS
+) -> None:
+    """Publish one phase timing into the shared registry (gauge: latest
+    measurement wins — phase profiles are point-in-time tables, not
+    accumulating counters)."""
+    registry.gauge(
+        "corro.kernel.phase.seconds", kernel=kernel, phase=phase
+    ).set(seconds)
+
 
 async def serve_prometheus(addr: str, registry: Registry = METRICS):
     """Serve the registry at GET /metrics on `addr` ("host:port").

@@ -176,13 +176,20 @@ def _native_batch_enabled() -> bool:
 def _merge_engine() -> str:
     """Engine order for the batch decision plane (phase B).
 
-    "native" (default): C++ columnar loop, Python fallback.
+    "python" (default since r6): the pure-Python reference loop — the
+    measured end-to-end winner at EVERY banked batch size on both hosts
+    (CRDT_MERGE_AB.json: 28.4k vs 18.8k changes/s @512 ... 37.8k vs
+    37.7k @65k; CRDT_MERGE_AB_TPU.json agrees), and decision-only winner
+    at 3 of 4 rungs.  The old "native" default contradicted the repo's
+    own A/B (VERDICT r5 weak #1) — decision + revert criterion recorded
+    in COMPONENTS.md "CRDT engine placement".
+    "native": C++ columnar loop (ctypes), Python fallback.
     "array": jitted array kernel (ops/crdt_merge.py — SURVEY §7 step 1's
     device-resident form), then native, then Python; the kernel declines
-    batches with undecidable value ties.  "python": reference loop only.
+    batches with undecidable value ties.
     The A/B harness (scripts/bench_crdt_merge.py) flips this knob over
     identical inputs."""
-    eng = os.environ.get("CORRO_CRDT_ENGINE", "native")
+    eng = os.environ.get("CORRO_CRDT_ENGINE", "python")
     if eng not in ("native", "array", "python"):
         raise ValueError(
             f"unknown CORRO_CRDT_ENGINE {eng!r} "

@@ -1,0 +1,166 @@
+"""Per-PR bench smoke: a miniature pview convergence, banked per round.
+
+The repo's bench trajectory between chip windows had no CPU-comparable
+per-PR points (BENCH_r0*.json are driver-owned; the scale rungs are too
+heavy to re-run every PR).  This entry is tier-1-safe — CPU only, small
+n, seconds — and replays the SAME workload every PR: boot an
+n=2048 × K=256 partial-view cluster with finger bootstrap to the full
+four-term convergence bar via the device-resident loop
+(`swim_pview.run_to_converged`), then 1% churn to full detection.
+
+Each run writes `BENCH_PR<tag>.json` (tag = argv[1], else the next free
+integer), `code_sha`-stamped over the measured kernel + driver files at
+run START, so the series stays comparable and auditable the way the
+TPU bench records are (bench.py's replay-gate discipline).  The CPU
+platform is FORCED (plugin-stripped re-exec): a point that silently
+measured a live chip would not be comparable with its neighbors.
+
+Usage:  python scripts/bench_smoke.py [tag]
+Env:    BENCH_SMOKE_N (default 2048), BENCH_SMOKE_SLOTS (default 256),
+        BENCH_SMOKE_MAX_TICKS (default 600), BENCH_SMOKE_OUT (path
+        override), BENCH_SMOKE_SKIP_CHURN=1
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from corrosion_tpu.runtime import jaxenv  # noqa: E402
+
+# ALWAYS the stripped-CPU child (no prefer_inherited probe): per-PR
+# points must share a platform to be comparable
+jaxenv.reexec_under_cpu("BENCH_SMOKE_CHILD")
+jaxenv.enable_compilation_cache()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from corrosion_tpu.models.cluster import PViewClusterSim  # noqa: E402
+from corrosion_tpu.ops import swim_pview  # noqa: E402
+
+_MEASURED_FILES = (
+    "corrosion_tpu/ops/swim_pview.py",
+    "corrosion_tpu/ops/swim.py",
+    "corrosion_tpu/models/cluster.py",
+)
+
+
+def _code_fingerprint() -> dict:
+    import hashlib
+
+    out = {}
+    for rel in _MEASURED_FILES:
+        try:
+            with open(os.path.join(REPO, rel), "rb") as f:
+                out[rel] = hashlib.sha256(f.read()).hexdigest()[:12]
+        except OSError:
+            out[rel] = "missing"
+    return out
+
+
+def _next_tag() -> str:
+    taken = set()
+    for p in glob.glob(os.path.join(REPO, "BENCH_PR*.json")):
+        m = re.match(r"BENCH_PR(\d+)\.json$", os.path.basename(p))
+        if m:
+            taken.add(int(m.group(1)))
+    return f"{(max(taken) + 1) if taken else 1:02d}"
+
+
+def main() -> None:
+    tag = sys.argv[1] if len(sys.argv) > 1 else _next_tag()
+    n = int(os.environ.get("BENCH_SMOKE_N", "2048"))
+    slots = int(os.environ.get("BENCH_SMOKE_SLOTS", "256"))
+    max_ticks = int(os.environ.get("BENCH_SMOKE_MAX_TICKS", "600"))
+    code_sha = _code_fingerprint()  # at run START (bench.py discipline)
+
+    t0 = time.monotonic()
+    sim = PViewClusterSim(
+        n, slots=slots, seed=0, seed_mode="fingers",
+        feeds_per_tick=4, feed_entries=max(16, slots // 16), tie_epoch=512,
+    )
+    jax.block_until_ready(sim.state.slot_packed)
+    init_s = time.monotonic() - t0
+
+    # compile warm-up on a throwaway sim (same shapes/static args) so the
+    # measured run starts cold at tick 0 with a warm executable cache
+    t0 = time.monotonic()
+    warm = PViewClusterSim(
+        n, slots=slots, seed=1, seed_mode="fingers",
+        feeds_per_tick=4, feed_entries=max(16, slots // 16), tie_epoch=512,
+    )
+    warm.state = warm.state._replace(t=np.int32(max_ticks))  # cond-only pass
+    warm.ticks = max_ticks
+    warm.run_until_converged_device(max_ticks=0, check_every=25)
+    del warm
+    compile_s = time.monotonic() - t0
+
+    t0 = time.monotonic()
+    stable_tick = sim.run_until_converged_device(
+        max_ticks=max_ticks, check_every=25
+    )
+    boot_wall = time.monotonic() - t0
+    stats = sim.stats()
+
+    det_ticks = None
+    churn_wall = 0.0
+    n_kill = max(1, n // 100)
+    if os.environ.get("BENCH_SMOKE_SKIP_CHURN") == "1":
+        n_kill = 0
+    elif stable_tick is not None:
+        kill = np.random.default_rng(7).choice(n, size=n_kill, replace=False)
+        sim.crash_many(kill)
+        t0 = time.monotonic()
+        base = sim.ticks
+        while sim.ticks - base < max_ticks:
+            sim.step(25)
+            cs = sim.stats()
+            if cs["detected"] >= 1.0 and cs["false_positive"] == 0.0:
+                det_ticks = sim.ticks - base
+                break
+        churn_wall = time.monotonic() - t0
+
+    rec = {
+        "metric": f"pview_smoke_n{n}_k{slots}",
+        "value": round(boot_wall, 3),
+        "unit": "s",
+        "detail": {
+            "n": n,
+            "slots": slots,
+            "seed_mode": "fingers",
+            "tick_mode": sim.params.tick_mode,
+            "gossip_mode": sim.params.gossip_mode,
+            "init_s": round(init_s, 2),
+            "compile_s": round(compile_s, 2),
+            "stable_tick": stable_tick,
+            "boot_wall_s": round(boot_wall, 3),
+            "churn_killed": n_kill,
+            "churn_detect_all_ticks": det_ticks,
+            "churn_wall_s": round(churn_wall, 3),
+            "stats": {m: round(float(v), 6) for m, v in stats.items()},
+            "platform": jax.devices()[0].platform,
+            "code_sha": code_sha,
+            "measured_at": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
+        },
+    }
+    path = os.environ.get(
+        "BENCH_SMOKE_OUT", os.path.join(REPO, f"BENCH_PR{tag}.json")
+    )
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=2)
+        f.write("\n")
+    print(json.dumps(rec))
+    ok = stable_tick is not None and (n_kill == 0 or det_ticks is not None)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -95,9 +95,15 @@ def main() -> None:
     # bandwidth high for instantaneous coverage to cross 0.99
     tie_epoch = int(os.environ.get("PVIEW_TIE_EPOCH", "512"))
     feeds = int(os.environ.get("PVIEW_FEEDS", "8"))
+    # r6 restructure knobs: default to the kernel defaults (fused tick,
+    # shift gossip); PVIEW_TICK_MODE=r5 / PVIEW_GOSSIP_MODE=pick re-run
+    # the round-5 formulation for A/Bs against the banked rungs
+    tick_mode = os.environ.get("PVIEW_TICK_MODE", "fused")
+    gossip_mode = os.environ.get("PVIEW_GOSSIP_MODE", "shift")
     params = swim_pview.PViewParams(
         n=n, slots=slots, feeds_per_tick=feeds,
         feed_entries=max(16, slots // 16), tie_epoch=tie_epoch,
+        tick_mode=tick_mode, gossip_mode=gossip_mode,
     )
     t0 = time.monotonic()
     state = swim_pview.init_state(
@@ -163,16 +169,12 @@ def main() -> None:
         # satisfied the old three-term bar at tick 8 with 0.9%-occupied
         # tables. Convergence additionally requires the table to have
         # actually FILLED: mean in-degree at >= 85% of its saturation
-        # value. Saturation accounts for hash collisions — a subject
-        # occupies exactly one hash column per row, so a full row holds
-        # K*(1-(1-1/K)^(n-1)) distinct subjects in expectation (≈ n-1
-        # for n << K, ≈ K for n >> K; at n ≈ K it dips to K(1-1/e),
-        # which min(n-1, slots-1) would overshoot unreachably). Every
-        # previously banked rung clears this — the weakest, 512k, sits
-        # at 1846 vs the 1741 bar.
-        saturated = 0.85 * min(
-            n - 1, slots * (1.0 - (1.0 - 1.0 / slots) ** (n - 1))
-        )
+        # value (swim_pview.saturation_floor — the formula rationale
+        # lives there, shared with the device-resident loop). Every
+        # previously banked rung clears this — the weakest margins are
+        # the 1M/2M CPU boot rungs at ~1847 mean in-degree vs the 1741
+        # bar (the 512k TPU rung sits comfortably higher, 2026).
+        saturated = swim_pview.saturation_floor(n, slots)
         converged = (
             stats["pv_coverage"] >= 0.99
             and stats["min_in_degree"] >= quorum
@@ -225,6 +227,8 @@ def main() -> None:
         "platform": plat,
         "quorum_floor": quorum,
         "seed_mode": "fingers",
+        "tick_mode": tick_mode,
+        "gossip_mode": gossip_mode,
         "init_s": round(init_s, 2),
         "compile_s": round(compile_s, 2),
         "ticks": boot_ticks,

@@ -1,0 +1,337 @@
+"""Per-phase profile of the partial-view SWIM tick + live-buffer accounting.
+
+The dense kernel got a phase profiler in r3 (`scripts/profile_swim.py`,
+TPU_PROFILE_10k.txt); the pview kernel — the designated scaling path past
+512k members — never had one (VERDICT r5 weak #3).  This records:
+
+1. **Phase table**: device wall for the tick's phases, sliced to match
+   the r6 kernel structure (`ops/swim_pview.py`): partner/probe picks,
+   gossip delivery (shift row-gather vs grouped sort), feed-window
+   pulls, the merge scatter chain, buffer merge, stats — plus whole
+   ticks in both tick modes ("fused" = the r6 restructure, "r5" = the
+   round-5 formulation) so the restructure's end-to-end delta is one
+   table row apart.  Every sample follows the tunnel measurement
+   discipline of profile_swim.timeit (distinct inputs per dispatch,
+   per-sample blocking).
+
+2. **Live-buffer accounting** (the chipless AOT-compile loop): for a
+   ladder of (n, K) shapes, `jit(...).lower(shapes).compile()` the
+   donated scanned tick WITHOUT allocating, and report argument/alias/
+   temp bytes plus the count of whole-table copy instructions in the
+   optimized HLO, per tick mode.  Under JAX_PLATFORMS=cpu this measures
+   the XLA:CPU lowering — a conservative UPPER bound (XLA:CPU's scatter
+   expansion double-buffers even programs the TPU runs fully in place:
+   the dense kernel shows 3 view-sized CPU copies at shapes whose TPU
+   program has none, PROFILE.md r6) — so the meaningful chipless signal
+   is the RELATIVE fused-vs-r5 structure, pinned by
+   tests/test_pview_memguard.py.  On a live chip the same loop gives
+   the real HBM verdict.
+
+Writes the artifact `TPU_PROFILE_PVIEW_<n//1000>k.txt` (platform is
+recorded inside — the CPU fallback writes the same file the way
+BENCH_* artifacts do) and publishes the phase rows to the shared
+metrics registry (`corro.kernel.phase.seconds{kernel="pview"}`).
+
+Usage:  python scripts/pview_profile.py [n] [slots] [feeds]
+Env:    PVIEW_PROFILE_OUT (artifact path override),
+        PVIEW_PROFILE_AOT=0 (skip the AOT ladder),
+        PVIEW_PROFILE_AOT_SHAPES="n1xk1,n2xk2,..." (override the ladder)
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
+
+from corrosion_tpu.runtime import jaxenv  # noqa: E402
+
+jaxenv.reexec_under_cpu(
+    "PVIEW_PROFILE_CHILD", prefer_inherited_probe_s=20.0
+)
+jaxenv.enable_compilation_cache()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from corrosion_tpu.ops import swim, swim_pview  # noqa: E402
+from corrosion_tpu.runtime.metrics import record_phase_seconds  # noqa: E402
+from profile_swim import timeit, vary_add, vary_key  # noqa: E402
+
+
+def _code_sha() -> dict:
+    import hashlib
+
+    out = {}
+    for rel in ("corrosion_tpu/ops/swim_pview.py", "corrosion_tpu/ops/swim.py"):
+        with open(os.path.join(REPO, rel), "rb") as f:
+            out[rel] = hashlib.sha256(f.read()).hexdigest()[:12]
+    return out
+
+
+def table_copy_count(hlo: str, n: int, k: int) -> int:
+    """Whole-table copy instructions in an optimized HLO dump."""
+    tbl = f"s32[{n},{k}]"
+    return sum(
+        1
+        for line in hlo.splitlines()
+        if re.search(r"\bcopy\(", line) and tbl in line
+    )
+
+
+def aot_compile_scanned_tick(params, chunk: int = 2):
+    """Chipless AOT compile of the donated scanned tick: shapes only, no
+    allocation (the r5 probing loop, PROFILE.md '1M on chip')."""
+    state_shape = jax.eval_shape(
+        lambda: swim_pview.init_state(
+            params, jax.random.PRNGKey(0), seed_mode="fingers"
+        )
+    )
+    rng_shape = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    return (
+        jax.jit(
+            swim_pview._tick_n_impl,
+            static_argnames=("params", "k"),
+            donate_argnums=(0,),
+        )
+        .lower(state_shape, rng_shape, params, chunk)
+        .compile()
+    )
+
+
+def live_buffer_report(n: int, k: int, feeds: int, tick_mode: str) -> dict:
+    params = swim_pview.PViewParams(
+        n=n, slots=k, feeds_per_tick=feeds,
+        feed_entries=max(16, k // 16), tie_epoch=512, tick_mode=tick_mode,
+    )
+    t0 = time.monotonic()
+    compiled = aot_compile_scanned_tick(params)
+    compile_s = time.monotonic() - t0
+    ma = compiled.memory_analysis()
+    copies = table_copy_count(compiled.as_text(), n, k)
+    table_b = n * k * 4
+    return {
+        "n": n,
+        "slots": k,
+        "tick_mode": tick_mode,
+        "compile_s": round(compile_s, 1),
+        "table_gb": round(table_b / 2**30, 2),
+        "argument_gb": round(ma.argument_size_in_bytes / 2**30, 3),
+        "alias_gb": round(ma.alias_size_in_bytes / 2**30, 3),
+        "temp_gb": round(ma.temp_size_in_bytes / 2**30, 3),
+        "temp_over_table": round(ma.temp_size_in_bytes / table_b, 2),
+        "table_copies": copies,
+    }
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    k = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
+    feeds = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+    fe = max(16, k // 16)
+    plat = jax.devices()[0].platform
+    out = io.StringIO()
+
+    def emit(line: str = "") -> None:
+        print(line, flush=True)
+        out.write(line + "\n")
+
+    emit(f"# pview kernel phase profile (r6 restructure)")
+    emit(f"platform={plat} n={n} slots={k} feeds={feeds} fe={fe}")
+    emit(f"code_sha={json.dumps(_code_sha())}")
+    emit(f"measured_at={time.strftime('%Y-%m-%d %H:%M:%S', time.gmtime())} UTC")
+    emit()
+
+    mk = lambda tm, gm: swim_pview.PViewParams(  # noqa: E731
+        n=n, slots=k, feeds_per_tick=feeds, feed_entries=fe,
+        tie_epoch=512, tick_mode=tm, gossip_mode=gm,
+    )
+    params = mk("fused", "shift")
+    rng = jax.random.PRNGKey(0)
+    state = swim_pview.init_state(params, rng, seed_mode="fingers")
+    state = swim_pview.tick(state, jax.random.PRNGKey(1), params)  # populate
+    jax.block_until_ready(state.slot_packed)
+    idx = jnp.arange(n, dtype=jnp.int32)
+
+    rows = []
+    # sample count scales down with n: every phase-row dispatch at
+    # n=100k moves hundreds of MB on a 1-core host
+    it = 20 if n <= 25_000 else 6
+
+    # whole ticks, all four structure combinations: the restructure's
+    # end-to-end delta is the (fused,shift) vs (r5,pick) pair
+    for tm, gm in (("fused", "shift"), ("fused", "pick"),
+                   ("r5", "shift"), ("r5", "pick")):
+        p_i = mk(tm, gm)
+        rows.append((f"tick(1)[{tm},{gm}]", timeit(
+            lambda s, kk, p_i=p_i: swim_pview.tick(s, kk, p_i), state, rng,
+            iters=3, warmup=1, vary=vary_key(1))))
+    chunk = 5
+    t5 = timeit(
+        lambda s, kk: swim_pview.tick_n(s, kk, params, chunk), state, rng,
+        iters=2, warmup=1, vary=vary_key(1))
+    rows.append((f"tick_n({chunk})/{chunk} [fused,shift]", t5 / chunk))
+
+    # ---- phase slices (fused structure) ----------------------------------
+    @jax.jit
+    def ph_pick(packed, key):
+        return swim_pview._pick_known_alive(params, packed, idx, key, 4, 0)
+
+    rows.append(("pick x1", timeit(ph_pick, state.slot_packed, rng,
+                                   iters=it, vary=vary_key(1))))
+
+    @jax.jit
+    def ph_lookup(packed, subjs):
+        return swim_pview._lookup(params, packed, subjs, 0)
+
+    subjs = jax.random.randint(rng, (n, 4), 0, n, dtype=jnp.int32)
+    rows.append(("lookup [N,4]", timeit(ph_lookup, state.slot_packed, subjs,
+                                        iters=it, vary=vary_add(1))))
+
+    # gossip delivery: shift row-gather vs grouped sort, same widths
+    f, m = params.fanout, params.piggyback + params.antientropy
+    r2 = jax.random.PRNGKey(2)
+    subj_gm = jax.random.randint(r2, (n, f, m), 0, n, dtype=jnp.int32)
+    key_gm = jax.random.randint(jax.random.fold_in(r2, 1), (n, f, m), 1, 40,
+                                dtype=jnp.int32)
+    ok_gm = jax.random.uniform(jax.random.fold_in(r2, 2), (n, f, m)) < 0.8
+    slots_in = params.incoming_slots
+
+    @jax.jit
+    def ph_inbox_shift(subj_gm, key_gm, ok_gm, off):
+        src = (idx[:, None] - off[None, :]) % n
+        sub_m = jnp.where(ok_gm, subj_gm, n)
+        key_m = jnp.where(ok_gm, key_gm, 0)
+        jj = jnp.arange(f, dtype=jnp.int32)[None, :]
+        in_subj = sub_m[src, jj].reshape(n, f * m)
+        in_key = key_m[src, jj].reshape(n, f * m)
+        if f * m > slots_in:
+            order = jnp.argsort(in_subj == n, axis=1, stable=True)
+            take = order[:, :slots_in]
+            in_subj = jnp.take_along_axis(in_subj, take, axis=1)
+            in_key = jnp.take_along_axis(in_key, take, axis=1)
+        return in_subj, in_key
+
+    off = jnp.array([3, 1709], dtype=jnp.int32) % n
+    rows.append((f"inbox[shift] f*m={f * m}", timeit(
+        ph_inbox_shift, subj_gm, key_gm, ok_gm, off, iters=it,
+        vary=vary_add(1))))
+
+    gdst = jax.random.randint(jax.random.fold_in(r2, 3), (n * f,), 0, n,
+                              dtype=jnp.int32)
+
+    @jax.jit
+    def ph_inbox_gsort(d, s, kk, o):
+        return swim.dispatch_inbox("gsort", n, slots_in, d,
+                                   s.reshape(-1, m), kk.reshape(-1, m),
+                                   o.reshape(-1, m))
+
+    rows.append((f"inbox[gsort] G={n * f}", timeit(
+        ph_inbox_gsort, gdst, subj_gm, key_gm, ok_gm, iters=it,
+        vary=vary_add(1))))
+
+    # one feed-window pull (gather side only — the merge is its own row)
+    @jax.jit
+    def ph_feedpull(packed, key):
+        partner = swim_pview._pick_known_alive(params, packed, idx, key, 2, 0)
+        psafe = jnp.clip(partner, 0, n - 1)
+        vw = jax.lax.dynamic_slice(packed, (jnp.int32(0), jnp.int32(0)),
+                                   (n, fe))
+        return jnp.take(vw, psafe, axis=0)
+
+    rows.append(("feedpull x1", timeit(ph_feedpull, state.slot_packed, rng,
+                                       iters=it, vary=vary_key(1))))
+
+    # the merge scatter chain at the fused tick's full width
+    wtot = (feeds + 1) * fe
+    mvals = jax.random.randint(jax.random.fold_in(r2, 4), (n, wtot), 0,
+                               2**30, dtype=jnp.int32)
+    mcols = jax.random.randint(jax.random.fold_in(r2, 5), (n, wtot), 0, k,
+                               dtype=jnp.int32)
+
+    @jax.jit
+    def ph_merge(packed, mvals, mcols):
+        out = packed
+        for w0 in range(0, wtot, fe):
+            out = out.at[
+                idx[:, None], jax.lax.slice_in_dim(mcols, w0, w0 + fe, axis=1)
+            ].max(jax.lax.slice_in_dim(mvals, w0, w0 + fe, axis=1))
+        return out
+
+    rows.append((f"merge scatter [N,{wtot}]", timeit(
+        ph_merge, state.slot_packed, mvals, mcols, iters=it,
+        vary=vary_add(1))))
+
+    bw = slots_in + 4
+    bin_subj = jax.random.randint(r2, (n, bw), 0, n + 1, dtype=jnp.int32)
+    bin_key = jax.random.randint(r2, (n, bw), 0, 40, dtype=jnp.int32)
+
+    @jax.jit
+    def ph_bufmrg(bs, bk, bt, isub, ikey):
+        return swim._buffer_merge(
+            params, bs, bk.astype(jnp.int32), bt.astype(jnp.int32), isub, ikey
+        )
+
+    rows.append(("bufmrg", timeit(
+        ph_bufmrg, state.buf_subj, state.buf_key, state.buf_sent, bin_subj,
+        bin_key, iters=it, vary=vary_add(4))))
+
+    def vary_alive(i, args):
+        (s,) = args
+        return (s._replace(alive=s.alive.at[i % n].set(False)),)
+
+    rows.append(("stats", timeit(
+        lambda s: swim_pview.membership_stats(s, params), state, iters=3,
+        vary=vary_alive)))
+
+    emit(f"{'phase':<32} {'ms':>12}")
+    for name, secs in rows:
+        emit(f"{name:<32} {secs * 1e3:>12.3f}")
+        record_phase_seconds("pview", name, secs)
+    emit()
+
+    # ---- live-buffer accounting (chipless AOT ladder) --------------------
+    if os.environ.get("PVIEW_PROFILE_AOT", "1") != "0":
+        shapes_env = os.environ.get("PVIEW_PROFILE_AOT_SHAPES")
+        if shapes_env:
+            shapes = [
+                tuple(int(x) for x in s.split("x"))
+                for s in shapes_env.split(",")
+            ]
+        else:
+            shapes = [(n, k)]
+        emit("# live-buffer accounting: donated scanned tick, AOT "
+             "(no allocation)")
+        emit("# CPU lowering OVERCOUNTS copies vs TPU (see module "
+             "docstring); compare tick modes, not absolutes")
+        hdr = (f"{'n':>9} {'K':>5} {'mode':<6} {'tbl_gb':>7} {'arg_gb':>7} "
+               f"{'temp_gb':>8} {'t/tbl':>6} {'copies':>6} {'compile_s':>9}")
+        emit(hdr)
+        for (sn, sk) in shapes:
+            for tm in ("fused", "r5"):
+                r = live_buffer_report(sn, sk, feeds, tm)
+                emit(
+                    f"{r['n']:>9} {r['slots']:>5} {tm:<6} "
+                    f"{r['table_gb']:>7.2f} {r['argument_gb']:>7.3f} "
+                    f"{r['temp_gb']:>8.3f} {r['temp_over_table']:>6.2f} "
+                    f"{r['table_copies']:>6} {r['compile_s']:>9.1f}"
+                )
+
+    path = os.environ.get(
+        "PVIEW_PROFILE_OUT",
+        os.path.join(REPO, f"TPU_PROFILE_PVIEW_{n // 1000}k.txt"),
+    )
+    with open(path, "w") as fh:
+        fh.write(out.getvalue())
+    print(f"wrote {path}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

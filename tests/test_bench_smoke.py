@@ -1,0 +1,50 @@
+"""The per-PR bench smoke entry stays runnable and honest.
+
+`scripts/bench_smoke.py` is the tier-1-safe bench point each PR banks
+(BENCH_PR*.json): CPU-forced, miniature pview convergence, sha-stamped.
+This drives it end-to-end at a sub-second shape and checks the contract
+the trajectory depends on: exit 0 only with a converged record, the
+artifact carries a code fingerprint matching the tree NOW, the platform
+is the forced CPU, and the convergence stats clear the four-term bar.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_smoke_writes_converged_sha_stamped_record(tmp_path):
+    out = tmp_path / "BENCH_PRtest.json"
+    env = dict(
+        os.environ,
+        BENCH_SMOKE_N="512",
+        BENCH_SMOKE_SLOTS="64",
+        BENCH_SMOKE_MAX_TICKS="400",
+        BENCH_SMOKE_SKIP_CHURN="1",
+        BENCH_SMOKE_OUT=str(out),
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_smoke.py"),
+         "test"],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    det = rec["detail"]
+    assert det["platform"] == "cpu"  # forced: points must be comparable
+    assert det["stable_tick"] is not None
+    assert det["stats"]["false_positive"] == 0.0
+    assert det["stats"]["pv_coverage"] >= 0.99
+
+    # fingerprint discipline: stamped over the measured files, matching
+    # the tree at test time (same check bench.py's replay gate applies)
+    import hashlib
+
+    for rel, short in det["code_sha"].items():
+        with open(os.path.join(REPO, rel), "rb") as f:
+            assert hashlib.sha256(f.read()).hexdigest()[:12] == short, rel

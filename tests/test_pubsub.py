@@ -38,6 +38,30 @@ def make_store():
     return store
 
 
+def test_sql_hash_is_reference_seahash():
+    """`corro-query-hash` wire parity (r6): the subscription hash is
+    seahash over the SQL bytes, 16 lower-hex chars — exactly what a
+    reference client computes from `klukai-types/src/pubsub.rs:565`
+    (`seahash::hash(sql.as_bytes())` formatted `{:016x}`).  Pinned
+    against the crate-vector-validated `net/seahash.py` plus one
+    concrete vector so a regression to the pre-r6 truncated sha256
+    (or a formatting drift) cannot pass."""
+    from corrosion_tpu.net.seahash import hash_bytes
+    from corrosion_tpu.pubsub.matcher import sql_hash
+
+    sql = "SELECT id, name FROM users"
+    assert sql_hash(sql) == format(hash_bytes(sql.encode("utf-8")), "016x")
+    # the crate's published vector, formatted as the header value
+    assert (
+        format(hash_bytes(b"to be or not to be"), "016x")
+        == format(1988685042348123509, "016x")
+    )
+    # 16 lower-hex chars, zero-padded (a u64 with leading zero nibbles
+    # must not shrink the header)
+    h = sql_hash(sql)
+    assert len(h) == 16 and h == h.lower()
+
+
 def write(store, sql, params=()):
     with store.write_tx(Timestamp(0)) as tx:
         tx.execute(sql, params)

@@ -1,0 +1,123 @@
+"""No-whole-table-copy guard: chipless AOT live-buffer accounting of the
+scanned pview tick.
+
+The 1M×2048 single-chip rung was rejected at compile time because XLA's
+copy insertion kept ONE whole-table (8.0 GiB) copy alive in the scanned
+r5 tick (`copy.326 = copy(state_slot_packed.1)` — PROFILE.md "Round 5:
+1M on chip").  The r6 "fused" tick restructure makes every pre-merge
+reader materialize against the tick-start table behind an optimization
+barrier, then merges in one in-place scatter chain.
+
+What a CPU-only environment can and cannot pin (measured, PROFILE.md
+r6): XLA:CPU's scatter expansion double-buffers even programs the TPU
+runs fully in place — the DENSE kernel shows 3 view-sized CPU copies at
+shapes whose TPU program has none ("Output size 11.94G; shares 11.94G
+with arguments").  So "zero copies on CPU" is not assertable; what IS
+assertable chiplessly:
+
+1. donation aliasing survives (the output state shares the input's
+   buffers — if a change breaks donation, nothing fits anywhere);
+2. the fused structure stays STRICTLY better than the r5 formulation
+   the chip rejected (fewer whole-table copy instructions in the
+   optimized HLO), and its copy count does not regress past the
+   measured-good baseline;
+3. the analytic live-set model that has to hold on a chip: donated
+   table (in place) + feed pull planes + gossip/FSM state + inbox
+   planes fits the v5e's 15.75 GB at 1M×2048.
+
+These run via `jit(...).lower(shapes).compile()` — no arrays are ever
+allocated, so the 1M-shape case needs compile time, not memory.
+`scripts/pview_profile.py` prints the same accounting as a table.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from corrosion_tpu.ops import swim_pview  # noqa: E402
+
+V5E_HBM_BYTES = int(15.75 * 2**30)
+
+
+def _aot(n, k, feeds, tick_mode, chunk=2):
+    params = swim_pview.PViewParams(
+        n=n, slots=k, feeds_per_tick=feeds,
+        feed_entries=max(16, k // 16), tie_epoch=512, tick_mode=tick_mode,
+    )
+    state_shape = jax.eval_shape(
+        lambda: swim_pview.init_state(
+            params, jax.random.PRNGKey(0), seed_mode="fingers"
+        )
+    )
+    rng_shape = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    compiled = (
+        jax.jit(
+            swim_pview._tick_n_impl,
+            static_argnames=("params", "k"),
+            donate_argnums=(0,),
+        )
+        .lower(state_shape, rng_shape, params, chunk)
+        .compile()
+    )
+    ma = compiled.memory_analysis()
+    copies = sum(
+        1
+        for line in compiled.as_text().splitlines()
+        if "copy(" in line and f"s32[{n},{k}]" in line
+    )
+    return ma, copies
+
+
+@pytest.mark.parametrize("n,k,feeds", [(16384, 1024, 8)])
+def test_fused_tick_structurally_beats_r5_and_keeps_donation(n, k, feeds):
+    table_b = n * k * 4
+    ma_f, copies_f = _aot(n, k, feeds, "fused")
+    ma_r, copies_r = _aot(n, k, feeds, "r5")
+
+    # 1. donation aliasing: the whole input state (including the table)
+    # is shared with the output — alias covers at least the table
+    assert ma_f.alias_size_in_bytes >= table_b, (
+        "donated slot table no longer aliases its output buffer"
+    )
+    # everything but the rng key should alias
+    assert ma_f.argument_size_in_bytes - ma_f.alias_size_in_bytes <= 64
+
+    # 2. the restructure's structural edge over the formulation the chip
+    # rejected: strictly fewer whole-table copy instructions, and no
+    # regression past the measured-good fused baseline (2 on XLA:CPU —
+    # both belong to the CPU-only scatter expansion, see module doc)
+    assert copies_f < copies_r, (copies_f, copies_r)
+    assert copies_f <= 2, (
+        f"fused tick grew whole-table copies: {copies_f} > 2 — a reader "
+        "of the table was likely reintroduced after the merge barrier"
+    )
+
+    # 3. temp footprint stays bounded relative to the table even under
+    # the CPU overcount (catches an accidental third table-sized temp)
+    assert ma_f.temp_size_in_bytes <= 3 * table_b + 64 * n
+
+
+@pytest.mark.slow
+def test_1m_2048_live_set_fits_single_chip_budget():
+    """The blocker pin at the REAL shape: AOT-compile the fused scanned
+    tick at 1M×2048 (the rung the chip rejected) and check the live-set
+    model against the v5e budget.  The CPU-only scatter-expansion copies
+    are subtracted per the dense-kernel calibration (PROFILE.md r6);
+    what remains — donated table + pull planes + state + inbox temps —
+    is the set a chip must hold."""
+    n, k, feeds = 1_048_576, 2048, 8
+    table_b = n * k * 4
+    ma, copies = _aot(n, k, feeds, "fused", chunk=1)
+    assert copies <= 2, copies
+    adjusted_live = (
+        ma.argument_size_in_bytes
+        + ma.temp_size_in_bytes
+        - copies * table_b
+    )
+    assert adjusted_live < V5E_HBM_BYTES, (
+        f"live set {adjusted_live / 2**30:.2f} GiB exceeds the v5e budget "
+        f"({copies} CPU-only table copies already excluded)"
+    )

@@ -46,14 +46,32 @@ def _dense_reference(view, alive):
     )
 
 
+@pytest.fixture
+def block64(monkeypatch):
+    """Shrink both kernels' stats block sizes to 64 for the duration of
+    a test, clearing the compiled stats traces on BOTH sides of it.
+
+    The clear-before makes the patched global take effect (the jitted
+    fns may hold traces compiled at the default block size for these
+    shapes).  The clear-AFTER is the leak fix (ADVICE): without it,
+    block-64 compiled traces for these (n, slots) shapes outlive the
+    monkeypatch — any later compile request for the same shapes would
+    silently reuse a stats pass whose block size no longer matches the
+    restored globals.  Scoped clears: jax.clear_caches() would evict
+    every compiled kernel in the session."""
+    monkeypatch.setattr(swim, "_STATS_BLOCK", 64)
+    monkeypatch.setattr(swim_pview, "_STATS_BLOCK_ROWS", 64)
+    swim._stats_impl.clear_cache()
+    swim_pview._stats_impl.clear_cache()
+    yield
+    swim._stats_impl.clear_cache()
+    swim_pview._stats_impl.clear_cache()
+
+
 @pytest.mark.parametrize("n", [96, 193])
-def test_dense_stats_match_whole_view_reference(monkeypatch, n):
+def test_dense_stats_match_whole_view_reference(block64, n):
     # block far smaller than n and NOT dividing it: the final block
     # clamps and overlaps, exercising the fresh-row dedupe mask
-    monkeypatch.setattr(swim, "_STATS_BLOCK", 64)
-    # scoped: only this function captured the patched block-size global;
-    # jax.clear_caches() would evict every compiled kernel in the session
-    swim._stats_impl.clear_cache()
     params = swim.SwimParams(n=n)
     state = swim.init_state(params, jax.random.PRNGKey(0), 3, "fingers")
     rng = jax.random.PRNGKey(1)
@@ -111,9 +129,7 @@ def _pview_reference(params, packed, alive, t):
 
 
 @pytest.mark.parametrize("n,slots", [(193, 64), (520, 96)])
-def test_pview_stats_match_whole_table_reference(monkeypatch, n, slots):
-    monkeypatch.setattr(swim_pview, "_STATS_BLOCK_ROWS", 64)
-    swim_pview._stats_impl.clear_cache()
+def test_pview_stats_match_whole_table_reference(block64, n, slots):
     params = swim_pview.PViewParams(
         n=n, slots=slots, feeds_per_tick=4, feed_entries=16
     )
