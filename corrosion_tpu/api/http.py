@@ -8,6 +8,10 @@ Counterpart of the axum router in `klukai-agent/src/agent/util.rs:181-351`:
   - POST /v1/table_stats    (concurrency 4)
   - POST /v1/subscriptions, GET /v1/subscriptions/{id}
   - POST /v1/updates/{table}
+  - GET  /v1/status         (r7: cluster status plane — one JSON
+    snapshot of membership census, kernel event telemetry, loop lag and
+    sync backlog, read non-mutatingly from the shared registry; the
+    machine-readable sibling of /metrics for dashboards and obs_report)
   - bearer-token authz middleware (`util.rs:330-351`), load-shed → 503
 """
 
@@ -33,7 +37,10 @@ from corrosion_tpu.api.types import (
     exec_response,
     parse_statement,
 )
-from corrosion_tpu.runtime.metrics import METRICS
+from corrosion_tpu.runtime.metrics import (
+    METRICS,
+    kernel_event_totals,
+)
 from corrosion_tpu.store.schema import SchemaError
 
 
@@ -73,6 +80,7 @@ class ApiServer:
         app.router.add_post("/v1/subscriptions", self.h_subscribe)
         app.router.add_get("/v1/subscriptions/{id}", self.h_subscription_by_id)
         app.router.add_post("/v1/updates/{table}", self.h_updates)
+        app.router.add_get("/v1/status", self.h_status)
         return app
 
     async def start(self) -> None:
@@ -362,6 +370,69 @@ class ApiServer:
             return web.json_response(
                 {"total_row_count": total, "invalid_tables": invalid}
             )
+
+    async def h_status(self, request: web.Request) -> web.Response:
+        """Cluster status plane: one JSON snapshot of what an operator
+        asks first — who is in the cluster, what the kernels did, is the
+        event loop healthy, is sync keeping up.  Every value is either
+        host state readable without I/O or a non-mutating registry peek
+        (`Registry.snapshot`), so the endpoint is safe to poll."""
+        agent = self.agent
+        from corrosion_tpu.agent.membership import MemberState
+
+        by_state = {s.name: 0 for s in MemberState}
+        # worker-thread rule from agent_metrics.collect_once: copy the
+        # dict under the GIL before iterating
+        for m in list(agent.membership.members.values()):
+            by_state[m.state.name] = by_state.get(m.state.name, 0) + 1
+
+        # one registry pass feeds every metric-derived field below
+        snap = METRICS.snapshot()
+
+        def peek(name: str, default: float = 0.0, **labels) -> float:
+            for _kind, sname, slabels, value in snap:
+                if sname == name and slabels == labels:
+                    return value
+            return default
+
+        phase_seconds: dict = {}
+        for kind, name, labels, value in snap:
+            if kind == "gauge" and name == "corro.kernel.phase.seconds":
+                phase_seconds.setdefault(labels.get("kernel", "?"), {})[
+                    labels.get("phase", "?")
+                ] = value
+
+        status = {
+            "actor_id": str(agent.actor_id),
+            "cluster": {
+                "size": agent.membership.cluster_size,
+                "member_states": by_state,
+                "members_tracked": len(agent.members.states),
+                "bookie_actors": len(agent.bookie.items()),
+            },
+            "kernel_events": kernel_event_totals(METRICS),
+            "kernel_phase_seconds": phase_seconds,
+            "loop": {
+                "lag_max_seconds": peek(
+                    "corro.runtime.loop.lag.max.seconds"
+                ),
+                "tasks_alive": peek("corro.runtime.loop.tasks.alive"),
+                "monitor_ticks": peek("corro.runtime.loop.ticks"),
+            },
+            "sync": {
+                "changes_in_queue": peek("corro.agent.changes.in_queue"),
+                "gaps": peek("corro.db.gaps.count"),
+                "gap_versions": peek("corro.db.gaps.versions"),
+                "buffered_change_versions": peek(
+                    "corro.db.buffered_changes.versions"
+                ),
+                "client_rounds": peek("corro.sync.client.rounds"),
+                "server_permits_available": getattr(
+                    agent.sync_serve_sem, "_value", 0
+                ),
+            },
+        }
+        return web.json_response(status)
 
     # -- pubsub routes (wired when managers are attached) ------------------
 

@@ -18,7 +18,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from corrosion_tpu.ops import swim, swim_pview
-from corrosion_tpu.runtime.metrics import record_phase_seconds
+from corrosion_tpu.runtime.metrics import (
+    record_kernel_events,
+    record_phase_seconds,
+)
 
 
 @dataclass
@@ -28,6 +31,19 @@ class TickMetrics:
     detected: float
     false_positive: float
     wall_s: float
+
+
+def _publish_event_deltas(
+    kernel: str, prev: np.ndarray, cur: np.ndarray
+) -> np.ndarray:
+    """Publish the device telemetry lane's growth since the last drain
+    as `corro.kernel.events.total{kernel=,event=}` counter increments.
+    The device totals wrap mod 2^32 (int32 lane); uint32 subtraction
+    makes the delta wrap-safe as long as one drain window stays under
+    2^32 events — every driver drains at least once per stats check."""
+    delta = (cur - prev).astype(np.uint32)
+    record_kernel_events(kernel, delta.tolist())
+    return cur
 
 
 class ClusterSim:
@@ -50,6 +66,7 @@ class ClusterSim:
         )
         self.history: List[TickMetrics] = []
         self.ticks = 0  # host-side mirror of state.t (no device readback)
+        self._ev_prev = np.zeros(swim.N_EVENTS, dtype=np.uint32)
 
     def step(self, ticks: int = 1) -> None:
         """Advance `ticks` protocol periods in ONE device dispatch
@@ -71,7 +88,12 @@ class ClusterSim:
         self.state = swim.set_alive(self.state, member, True)
 
     def stats(self) -> Dict[str, float]:
-        return swim.membership_stats(self.state)
+        """Convergence stats; the device telemetry lane drains in the
+        SAME readback and its per-window deltas are published to the
+        shared registry (`corro.kernel.events.total{kernel="dense"}`)."""
+        s, ev = swim.stats_and_events(self.state)
+        self._ev_prev = _publish_event_deltas("dense", self._ev_prev, ev)
+        return s
 
     def run_until_stable(
         self,
@@ -137,10 +159,16 @@ class ClusterSim:
             float(coverage_target), int(check_every), int(limit),
         )
         self.ticks = int(self.state.t)
+        # one readback: the loop verdict + the telemetry lane the device
+        # loop accumulated while it ran unobserved
+        cov_v, ev = jax.device_get((cov, self.state.events))
+        self._ev_prev = _publish_event_deltas(
+            "dense", self._ev_prev, np.asarray(ev).astype(np.uint32)
+        )
         # verdict must use the same precision the on-device predicate
         # compared at (f32), else a loop-satisfied coverage in
         # [f32(target), f64(target)) reads as a false non-convergence
-        return self.ticks if float(cov) >= np.float32(coverage_target) else None
+        return self.ticks if float(cov_v) >= np.float32(coverage_target) else None
 
     def warm_device_loop(
         self,
@@ -186,7 +214,11 @@ class PViewClusterSim:
     Wall-clock per step() is published to the shared metrics registry
     (`corro.kernel.phase.seconds{kernel="pview", phase="tick"}`), so an
     agent embedding a simulation exposes tick cost on /metrics the same
-    way its loops expose lag."""
+    way its loops expose lag.  Every stats() readback also drains the
+    kernel's device telemetry lane into
+    `corro.kernel.events.total{kernel="pview", event=...}` counters —
+    the event-level visibility (drops, overflows, suspicion churn) that
+    makes a perf investigation diagnosable without code changes."""
 
     def __init__(
         self,
@@ -203,6 +235,7 @@ class PViewClusterSim:
             self.params, init_key, seed_mode=seed_mode
         )
         self.ticks = 0  # host-side mirror of state.t (no device readback)
+        self._ev_prev = np.zeros(swim.N_EVENTS, dtype=np.uint32)
 
     def step(self, ticks: int = 1) -> None:
         """Advance `ticks` protocol periods in ONE donated dispatch."""
@@ -224,7 +257,11 @@ class PViewClusterSim:
         self.state = swim_pview.set_alive_many(self.state, members, True)
 
     def stats(self) -> Dict[str, float]:
-        return swim_pview.membership_stats(self.state, self.params)
+        """Four-term-bar stats; drains + publishes the telemetry lane in
+        the same readback (see class docstring)."""
+        s, ev = swim_pview.stats_and_events(self.state, self.params)
+        self._ev_prev = _publish_event_deltas("pview", self._ev_prev, ev)
+        return s
 
     def converged(self, stats: Dict[str, float], cov_target: float = 0.99,
                   quorum: int = 8) -> bool:
@@ -273,7 +310,12 @@ class PViewClusterSim:
             float(cov_target), int(quorum), int(check_every), int(limit),
         )
         self.ticks = int(self.state.t)
-        vals = np.asarray(jax.device_get(vals))
+        # one readback: the four-term verdict + the device loop's lane
+        vals, ev = jax.device_get((vals, self.state.events))
+        self._ev_prev = _publish_event_deltas(
+            "pview", self._ev_prev, np.asarray(ev).astype(np.uint32)
+        )
+        vals = np.asarray(vals)
         sat = swim_pview.saturation_floor(self.params.n, self.params.slots)
         ok = (
             vals[0] >= np.float32(cov_target)

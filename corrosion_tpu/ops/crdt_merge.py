@@ -45,6 +45,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from corrosion_tpu.runtime.metrics import record_kernel_events
+
 SENTINEL = "-1"
 
 _F64_EXACT = 1 << 53
@@ -302,9 +304,23 @@ def _merge_kernel(
     cell_winner = seg_arglexmax(cell_cand)
     clock_winner = seg_arglexmax(clock_cand)
 
+    # telemetry lane (CRDT_MERGE_EVENTS order, runtime/metrics.py):
+    # per-batch decision outcomes, computed on-device from masks the
+    # kernel already holds and drained by the host wrapper in the same
+    # readback as the decisions themselves
+    is_change = valid & (pos >= 0)
+    events = jnp.stack(
+        [
+            jnp.sum(win, dtype=jnp.int32),          # decide_won
+            jnp.sum(transition, dtype=jnp.int32),   # decide_transition
+            jnp.sum(is_change & ~win, dtype=jnp.int32),  # decide_stale
+            jnp.sum(tie_risk, dtype=jnp.int32),     # decide_ambiguous
+        ]
+    )
+
     return (
         win, transition, final_cl, any_transition, any_delete, max_erase,
-        cell_winner, clock_winner, ambiguous,
+        cell_winner, clock_winner, ambiguous, events,
     )
 
 
@@ -442,11 +458,18 @@ def merge_table_array(
         num_groups=num_groups, num_cells=num_cells,
     )
     (win, transition, final_cl, any_tr, any_del, _max_erase,
-     cell_winner, clock_winner, ambiguous) = (
+     cell_winner, clock_winner, ambiguous, events) = (
         np.asarray(x) for x in out
     )
     if bool(ambiguous):
+        # the batch falls back to a host engine: only the ambiguity
+        # count is real telemetry (the win/stale decisions are discarded
+        # and re-made by the fallback — recording them would double-book)
+        record_kernel_events(
+            "crdt_merge", [0, 0, 0, int(events[3])]
+        )
         return None
+    record_kernel_events("crdt_merge", events)
 
     # ---- rebuild the engine-contract flush plans -------------------------
     wins = [bool(win[j]) for j in range(n)]

@@ -78,12 +78,16 @@ import jax.numpy as jnp
 
 from corrosion_tpu.ops.swim import (
     INT32_MAX,
+    N_EVENTS,
     PREC_ALIVE,
     PREC_DOWN,
     PREC_SUSPECT,
     INC_CAP,
     _SENT_CLAMP,
+    _EV_IDX,
+    _bsum,
     _buffer_merge,
+    _event_vector,
     dispatch_inbox,
     finger_offsets,
     key_inc,
@@ -286,6 +290,9 @@ class PViewState(NamedTuple):
     susp_deadline: jax.Array  # [N, S] int32
     partition: jax.Array  # [N] int32 — network partition group (see
     # swim.SwimState.partition; same split-brain semantics)
+    events: jax.Array  # [N_EVENTS] int32 — cumulative on-device event
+    # telemetry, KERNEL_EVENTS order (see swim.py lane note; replicated
+    # under sharding, wrap-mod-2^32 totals drained as uint32 deltas)
 
 
 def init_state(
@@ -381,6 +388,7 @@ def _init_impl(
         susp_inc=jnp.zeros((n, s), dtype=LANE_DTYPE),
         susp_deadline=jnp.zeros((n, s), dtype=jnp.int32),
         partition=jnp.zeros(n, dtype=jnp.int32),
+        events=jnp.zeros(N_EVENTS, dtype=jnp.int32),
     )
 
 
@@ -602,6 +610,10 @@ def tick_impl(
         & (part[tg_safe] == part[:, None])[:, :, None]
     )
     drop = jax.random.uniform(r_loss, msg_ok.shape) < params.loss
+    # telemetry (see swim.py): emitted = deliverable sends, lost = the
+    # loss-injection slice; both from masks already materialized
+    ev_emitted = _bsum(msg_ok)
+    ev_lost = _bsum(msg_ok & drop)
     msg_ok = msg_ok & ~drop
 
     # ---- 4. delivery: bounded per-member inboxes -------------------------
@@ -633,6 +645,7 @@ def tick_impl(
             key_gm.reshape(-1, m),
             msg_ok.reshape(-1, m),
         )
+    ev_delivered = _bsum(in_subj < n)
 
     # ---- 4b. announce/feed exchange over SLOT space ----------------------
     # identical window/rng structure to the dense kernel, but the window
@@ -644,7 +657,8 @@ def tick_impl(
     spacing = max(1, steps_per_sweep // nfeeds) if nfeeds > 0 else 1
 
     def _feed_pull(pk, fk):
-        """One feed's gathered window ([N, fe] packed) + partner rows."""
+        """One feed's gathered window ([N, fe] packed) + partner rows
+        + successful-exchange count (telemetry)."""
         r_feed = jax.random.fold_in(r_gossip, 104729 + fk)
         partner = _pick_known_alive(params, pk, idx, r_feed, 2, t)
         psafe = jnp.clip(partner, 0, n - 1)
@@ -656,7 +670,7 @@ def tick_impl(
         vw = jax.lax.dynamic_slice(pk, (jnp.int32(0), w), (n, fe))
         pulled = jnp.take(vw, psafe, axis=0)
         pulled = jnp.where(has_partner[:, None], pulled, 0)
-        return pulled, psafe
+        return pulled, psafe, _bsum(has_partner)
 
     def _feed_updates(pulled, prows):
         """(repacked values, hash columns) for pulled windows — the
@@ -684,8 +698,10 @@ def tick_impl(
         w = jnp.minimum(j * fe, k - fe)
         vw = jax.lax.dynamic_slice(pk, (jnp.int32(0), w), (n, fe))
         pulled = jnp.take(vw, sp, axis=0)
-        return jnp.where(seed_ok[:, None], pulled, 0), sp
+        return jnp.where(seed_ok[:, None], pulled, 0), sp, _bsum(seed_ok)
 
+    ev_feed = jnp.int32(0)
+    ev_seed = jnp.int32(0)
     feed_vals = feed_cols = None
     if fused:
         # every pull reads the TICK-START table ("batched" feed
@@ -694,11 +710,12 @@ def tick_impl(
         pulls, prows = [], []
         if fe > 0 and nfeeds > 0:
             for fk in range(nfeeds):
-                pulled, psafe = _feed_pull(packed, fk)
+                pulled, psafe, np_f = _feed_pull(packed, fk)
+                ev_feed = ev_feed + np_f
                 pulls.append(pulled)
                 prows.append(jnp.broadcast_to(psafe[:, None], (n, fe)))
         if fe > 0:
-            pulled, sp = _seed_pull(packed)
+            pulled, sp, ev_seed = _seed_pull(packed)
             pulls.append(pulled)
             prows.append(jnp.broadcast_to(sp[:, None], (n, fe)))
         if pulls:
@@ -717,7 +734,8 @@ def tick_impl(
                 # pinned by test_swim_pview.py)
                 pulls, rows = [], []
                 for fk in range(nfeeds):
-                    pulled, psafe = _feed_pull(packed, fk)
+                    pulled, psafe, np_f = _feed_pull(packed, fk)
+                    ev_feed = ev_feed + np_f
                     pulls.append(pulled)
                     rows.append(
                         jnp.broadcast_to(psafe[:, None], (n, fe))
@@ -729,9 +747,11 @@ def tick_impl(
                 )
             else:
 
-                def one_feed(fk, pk):
-                    pulled, psafe = _feed_pull(pk, fk)
-                    return _feed_merge(pk, pulled, psafe[:, None])
+                def one_feed(fk, pk, n_pulls):
+                    pulled, psafe, np_f = _feed_pull(pk, fk)
+                    return _feed_merge(pk, pulled, psafe[:, None]), (
+                        n_pulls + np_f
+                    )
 
                 # ALWAYS unrolled (nfeeds is static, default 4-8): a
                 # fori_loop here is an inner while carrying the [N, K]
@@ -746,10 +766,10 @@ def tick_impl(
                 # safer trade at any configuration this kernel
                 # realistically sees.
                 for _fk in range(nfeeds):
-                    packed = one_feed(_fk, packed)
+                    packed, ev_feed = one_feed(_fk, packed, ev_feed)
 
         # ---- 4c. bootstrap-seed exchange ---------------------------------
-        pulled, sp = _seed_pull(packed)
+        pulled, sp, ev_seed = _seed_pull(packed)
         packed = _feed_merge(packed, pulled, sp[:, None])
 
     # ---- 5. refutation (inbox + own slot) --------------------------------
@@ -771,12 +791,33 @@ def tick_impl(
 
     # ---- 5b. periodic self-announce (staggered by member id) -------------
     # the bounded table's anti-extinction mechanism: see module docstring
+    ev_announce = jnp.int32(0)
     if params.announce_period > 0:
         due = ((t + idx) % params.announce_period == 0) & alive
         own_upd_subj = own_upd_subj.at[:, 3].set(jnp.where(due, idx, n))
         own_upd_key = own_upd_key.at[:, 3].set(
             jnp.where(due, make_key(inc, PREC_ALIVE), 0)
         )
+        ev_announce = _bsum(due)
+
+    # telemetry lane, merge_won still pending: every term below reads
+    # only masks computed against the tick-start table, so the vector is
+    # a legitimate barrier operand in fused mode (it pins the table-
+    # derived reads it consumes ahead of the in-place merge, like the
+    # FSM lanes)
+    ev_vec = _event_vector(
+        gossip_emitted=ev_emitted,
+        gossip_lost=ev_lost,
+        inbox_delivered=ev_delivered,
+        inbox_overflowed=ev_emitted - ev_lost - ev_delivered,
+        merge_won=jnp.int32(0),
+        feed_pulls=ev_feed,
+        seed_pulls=ev_seed,
+        suspect_raised=_bsum(fail2),
+        down_declared=_bsum(fire),
+        refuted=_bsum(refute),
+        self_announced=ev_announce,
+    )
 
     # ---- 6. row-aligned slot update + relay ------------------------------
     all_subj = jnp.concatenate([in_subj, own_upd_subj], axis=1)
@@ -809,9 +850,11 @@ def tick_impl(
             feed_cols = jnp.zeros((n, 0), dtype=jnp.int32)
         (packed, feed_vals, feed_cols, new_packed, cols, prev, improved,
          phase, psubj, pdl, pok, susp_subj, susp_inc, susp_deadline, inc,
+         ev_vec,
          ) = jax.lax.optimization_barrier(
             (packed, feed_vals, feed_cols, new_packed, cols, prev, improved,
-             phase, psubj, pdl, pok, susp_subj, susp_inc, susp_deadline, inc)
+             phase, psubj, pdl, pok, susp_subj, susp_inc, susp_deadline, inc,
+             ev_vec)
         )
         # two in-place scatters, not one concatenated [N, W_total] plane:
         # the updates are all precomputed above, so ordering stays
@@ -846,6 +889,12 @@ def tick_impl(
         packed = packed.at[idx, self_col].set(
             jnp.where(alive, _pack(params, idx, self_key, idx, t), cur_self)
         )
+
+    # merge_won lands now that `improved` is settled (post-barrier in
+    # fused mode); the counter sums a mask, never re-reads the table
+    events = state.events + ev_vec.at[_EV_IDX["merge_won"]].add(
+        _bsum(improved)
+    )
 
     relay_ok = improved & (all_subj != idx[:, None]) & (all_subj < n)
     bin_subj = jnp.concatenate(
@@ -889,6 +938,7 @@ def tick_impl(
         susp_inc=susp_inc.astype(LANE_DTYPE),
         susp_deadline=susp_deadline,
         partition=part,
+        events=events,
     )
 
 
@@ -1112,6 +1162,29 @@ run_to_converged = functools.partial(
 )(_run_to_converged_impl)
 
 
+def stats_and_events(state: PViewState, params: PViewParams):
+    """(stats dict, [N_EVENTS] uint32 event totals) in ONE device→host
+    readback — the telemetry lane piggybacks on the stats transfer."""
+    import numpy as np
+
+    vals, ev = jax.device_get(
+        (
+            _stats_impl(params, state.slot_packed, state.alive, state.t),
+            state.events,
+        )
+    )
+    vals = np.asarray(vals)
+    stats = {
+        "pv_coverage": float(vals[0]),
+        "mean_in_degree": float(vals[1]),
+        "min_in_degree": float(vals[2]),
+        "occupancy": float(vals[3]),
+        "false_positive": float(vals[4]),
+        "detected": float(vals[5]),
+    }
+    return stats, np.asarray(ev).astype(np.uint32)
+
+
 def membership_stats(state: PViewState, params: PViewParams) -> dict:
     """Partial-view stability metrics, one stacked device→host readback.
 
@@ -1121,21 +1194,7 @@ def membership_stats(state: PViewState, params: PViewParams) -> dict:
     occupancy: live members' slot-fill fraction. false_positive: live
     subject entries marked suspect/down, per live observer pair.
     """
-    import numpy as np
-
-    vals = np.asarray(
-        jax.device_get(
-            _stats_impl(params, state.slot_packed, state.alive, state.t)
-        )
-    )
-    return {
-        "pv_coverage": float(vals[0]),
-        "mean_in_degree": float(vals[1]),
-        "min_in_degree": float(vals[2]),
-        "occupancy": float(vals[3]),
-        "false_positive": float(vals[4]),
-        "detected": float(vals[5]),
-    }
+    return stats_and_events(state, params)[0]
 
 
 def memory_gb(n: int, slots: int) -> dict:

@@ -2,13 +2,26 @@
 text exposition, standing in for the reference's `metrics` facade +
 Prometheus exporter (`klukai/src/command/agent.rs:29-63`). ~Same series
 names are emitted by the runtime so dashboards translate directly.
+
+Thread model (r7): instruments are handed out by `Registry.counter/
+gauge/histogram` under the registry lock, but the returned objects are
+then mutated from arbitrary threads — the agent metrics loop runs
+`collect_once` on a worker thread while the event loop serves requests,
+and the simulation drivers publish from whatever thread steps them.
+Each instrument therefore carries its OWN lock: `value += x` is a
+read-modify-write that the GIL does not make atomic (bytecode
+interleaving between LOAD and STORE drops increments), and a histogram
+observe mutates three fields that must stay consistent with each other.
+The per-instrument lock is never held together with the registry lock
+except inside `render_prometheus`/`snapshot` (registry → instrument
+order, the only nesting direction used anywhere).
 """
 
 from __future__ import annotations
 
 import threading
 from bisect import bisect_right
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 LabelKey = Tuple[Tuple[str, str], ...]
 
@@ -18,26 +31,31 @@ def _labels_key(labels: Dict[str, str]) -> LabelKey:
 
 
 class Counter:
-    __slots__ = ("value",)
+    __slots__ = ("value", "_lock")
 
     def __init__(self):
         self.value = 0.0
+        self._lock = threading.Lock()
 
     def inc(self, amount: float = 1.0) -> None:
-        self.value += amount
+        with self._lock:
+            self.value += amount
 
 
 class Gauge:
-    __slots__ = ("value",)
+    __slots__ = ("value", "_lock")
 
     def __init__(self):
         self.value = 0.0
+        self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
-        self.value = v
+        with self._lock:
+            self.value = v
 
     def add(self, v: float) -> None:
-        self.value += v
+        with self._lock:
+            self.value += v
 
 
 _DEFAULT_BUCKETS = (
@@ -46,18 +64,32 @@ _DEFAULT_BUCKETS = (
 
 
 class Histogram:
-    __slots__ = ("buckets", "counts", "total", "count")
+    __slots__ = ("buckets", "counts", "total", "count", "_lock")
 
     def __init__(self, buckets=_DEFAULT_BUCKETS):
         self.buckets = buckets
         self.counts = [0] * (len(buckets) + 1)
         self.total = 0.0
         self.count = 0
+        self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
-        self.counts[bisect_right(self.buckets, v)] += 1
-        self.total += v
-        self.count += 1
+        with self._lock:
+            self.counts[bisect_right(self.buckets, v)] += 1
+            self.total += v
+            self.count += 1
+
+
+def _escape_label_value(v: str) -> str:
+    """Prometheus text format 0.0.4 label-value escaping: backslash,
+    double quote, and line feed must be escaped or a hostile value (a
+    table name, an endpoint path) corrupts the whole exposition."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
 
 
 class Registry:
@@ -91,15 +123,43 @@ class Registry:
                 h = self._histograms[key] = Histogram()
             return h
 
+    def snapshot(self) -> List[Tuple[str, str, Dict[str, str], float]]:
+        """Point-in-time read of every series as (kind, name, labels,
+        value) rows — the non-mutating peek the status plane renders
+        (`api/http.py` GET /v1/status, `scripts/obs_report.py`).
+        Histograms surface as two rows (`<name>_count`, `<name>_sum`);
+        reading through `counter()`/`gauge()` instead would MINT empty
+        series as a side effect of looking."""
+        out: List[Tuple[str, str, Dict[str, str], float]] = []
+        with self._lock:
+            counters = list(self._counters.items())
+            gauges = list(self._gauges.items())
+            hists = list(self._histograms.items())
+        for (name, labels), c in counters:
+            out.append(("counter", name, dict(labels), c.value))
+        for (name, labels), g in gauges:
+            out.append(("gauge", name, dict(labels), g.value))
+        for (name, labels), h in hists:
+            with h._lock:
+                cnt, tot = h.count, h.total
+            out.append(("histogram", name + "_count", dict(labels), cnt))
+            out.append(("histogram", name + "_sum", dict(labels), tot))
+        return out
+
     def render_prometheus(self) -> str:
         """Prometheus text format 0.0.4."""
         out: List[str] = []
 
-        def fmt(name: str, labels: LabelKey, extra: Dict[str, str] = ()) -> str:
+        def fmt(
+            name: str, labels: LabelKey,
+            extra: Optional[Dict[str, str]] = None,
+        ) -> str:
             norm = name.replace(".", "_").replace("-", "_")
-            items = list(labels) + list(dict(extra).items() if extra else [])
+            items = list(labels) + (list(extra.items()) if extra else [])
             if items:
-                lbl = ",".join(f'{k}="{v}"' for k, v in items)
+                lbl = ",".join(
+                    f'{k}="{_escape_label_value(v)}"' for k, v in items
+                )
                 return f"{norm}{{{lbl}}}"
             return norm
 
@@ -109,17 +169,20 @@ class Registry:
             for (name, labels), g in sorted(self._gauges.items()):
                 out.append(f"{fmt(name, labels)} {g.value}")
             for (name, labels), h in sorted(self._histograms.items()):
+                with h._lock:
+                    counts = list(h.counts)
+                    total, count = h.total, h.count
                 cum = 0
                 for i, b in enumerate(h.buckets):
-                    cum += h.counts[i]
+                    cum += counts[i]
                     out.append(
                         f"{fmt(name + '_bucket', labels, {'le': str(b)})} {cum}"
                     )
                 out.append(
-                    f"{fmt(name + '_bucket', labels, {'le': '+Inf'})} {h.count}"
+                    f"{fmt(name + '_bucket', labels, {'le': '+Inf'})} {count}"
                 )
-                out.append(f"{fmt(name + '_sum', labels)} {h.total}")
-                out.append(f"{fmt(name + '_count', labels)} {h.count}")
+                out.append(f"{fmt(name + '_sum', labels)} {total}")
+                out.append(f"{fmt(name + '_count', labels)} {count}")
         return "\n".join(out) + "\n"
 
 
@@ -140,6 +203,49 @@ PVIEW_PHASES = (
     "tick",       # whole fused tick (scanned, per tick)
 )
 
+# Kernel event-telemetry series (r7): what happened ON DEVICE, counted
+# inside the jitted tick and drained in one readback alongside the
+# existing stats —
+#     corro.kernel.events.total{kernel="dense"|"pview"|"crdt_merge",
+#                               event="..."}
+# This tuple is the single source of truth for the SWIM kernels' lane
+# layout: `SwimState.events` / `PViewState.events` is an int32 vector
+# indexed in THIS order (ops/swim.py builds it via `_event_vector`),
+# the simulation drivers zip deltas against it, and `scripts/
+# obs_report.py` renders it.  Reordering is a wire-format change for
+# any state snapshot that carries the lane.
+KERNEL_EVENTS = (
+    "gossip_emitted",     # gossip messages sent (sender+receiver up,
+    #                       same partition; includes anti-entropy lanes)
+    "gossip_lost",        # of those, dropped by iid loss injection
+    "inbox_delivered",    # messages that landed in a bounded inbox
+    "inbox_overflowed",   # messages dropped at the inbox cap
+    "merge_won",          # inbox/own-update entries that improved the
+    #                       receiver's view (feed merges count as pulls)
+    "feed_pulls",         # successful feed-window partner exchanges
+    "seed_pulls",         # bootstrap-seed window exchanges
+    "suspect_raised",     # failed indirect probes → new suspicions
+    "down_declared",      # suspicion timers fired un-refuted
+    "refuted",            # members that refuted by bumping incarnation
+    "self_announced",     # periodic self-announces entering gossip
+)
+
+# The CRDT merge kernel's lane (ops/crdt_merge.py `_merge_kernel`):
+# per-batch decision outcomes, drained by the host wrapper in the same
+# readback as the decision outputs.
+CRDT_MERGE_EVENTS = (
+    "decide_won",         # changes that won their cell/row decision
+    "decide_transition",  # causal-length transitions among the wins
+    "decide_stale",       # changes beaten by local state or the batch
+    "decide_ambiguous",   # undecidable digest ties (host-engine fallback)
+)
+
+EVENTS_BY_KERNEL = {
+    "dense": KERNEL_EVENTS,
+    "pview": KERNEL_EVENTS,
+    "crdt_merge": CRDT_MERGE_EVENTS,
+}
+
 
 def record_phase_seconds(
     kernel: str, phase: str, seconds: float, registry: Registry = METRICS
@@ -150,6 +256,35 @@ def record_phase_seconds(
     registry.gauge(
         "corro.kernel.phase.seconds", kernel=kernel, phase=phase
     ).set(seconds)
+
+
+def record_kernel_events(
+    kernel: str, deltas, registry: Registry = METRICS
+) -> None:
+    """Publish one drained batch of device event counts: `deltas` is a
+    sequence aligned with `EVENTS_BY_KERNEL[kernel]`.  Zero deltas are
+    skipped so idle kernels do not mint series."""
+    names = EVENTS_BY_KERNEL[kernel]
+    for name, d in zip(names, deltas):
+        d = float(d)
+        if d:
+            registry.counter(
+                "corro.kernel.events.total", kernel=kernel, event=name
+            ).inc(d)
+
+
+def kernel_event_totals(
+    registry: Registry = METRICS,
+) -> Dict[str, Dict[str, float]]:
+    """{kernel: {event: total}} view of the event-counter family — the
+    shape `/v1/status` and `obs_report.py` serve."""
+    out: Dict[str, Dict[str, float]] = {}
+    for kind, name, labels, value in registry.snapshot():
+        if kind == "counter" and name == "corro.kernel.events.total":
+            out.setdefault(labels.get("kernel", "?"), {})[
+                labels.get("event", "?")
+            ] = value
+    return out
 
 
 async def serve_prometheus(addr: str, registry: Registry = METRICS):

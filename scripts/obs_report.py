@@ -1,0 +1,146 @@
+"""Registry → per-PR observability report (the trend-tracking render).
+
+`scripts/pview_profile.py` banks phase timings and BENCH_PR*.json banks
+the wall-clock trajectory; this entry banks the EVENT trajectory — the
+device telemetry lane (r7) rendered from the shared metrics registry in
+the same table format as PROFILE.md's phase tables, so per-PR diffs of
+"what the kernel did" (drops, overflows, suspicion churn, feed volume)
+are one `git diff OBS_REPORT.md` away.
+
+It boots a small `PViewClusterSim` to the convergence bar (the same
+workload family as `bench_smoke.py`, tier-1-safe sizes), drains the lane
+through the sim's stats readbacks, then renders every observability
+family the status plane serves: kernel event totals, kernel phase
+gauges, and a per-tick event-rate digest.  The CPU platform is FORCED
+(plugin-stripped re-exec) for the same reason bench_smoke forces it —
+points must share a platform to be comparable.
+
+Usage:  python scripts/obs_report.py
+Env:    OBS_REPORT_N (default 2048), OBS_REPORT_SLOTS (default 256),
+        OBS_REPORT_MAX_TICKS (default 600), OBS_REPORT_OUT (path
+        override, default OBS_REPORT.md)
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from corrosion_tpu.runtime import jaxenv  # noqa: E402
+
+jaxenv.reexec_under_cpu("OBS_REPORT_CHILD")
+jaxenv.enable_compilation_cache()
+
+import jax  # noqa: E402
+
+from corrosion_tpu.models.cluster import PViewClusterSim  # noqa: E402
+from corrosion_tpu.runtime.metrics import (  # noqa: E402
+    EVENTS_BY_KERNEL,
+    METRICS,
+    kernel_event_totals,
+)
+
+
+def _code_sha() -> dict:
+    import hashlib
+
+    out = {}
+    for rel in (
+        "corrosion_tpu/ops/swim_pview.py",
+        "corrosion_tpu/ops/swim.py",
+        "corrosion_tpu/runtime/metrics.py",
+    ):
+        with open(os.path.join(REPO, rel), "rb") as f:
+            out[rel] = hashlib.sha256(f.read()).hexdigest()[:12]
+    return out
+
+
+def render_registry_tables(emit, ticks_run: int) -> None:
+    """Render the observability families from the live registry in
+    PROFILE.md's fixed-width table style (shared by the report CLI and
+    its test)."""
+    totals = kernel_event_totals(METRICS)
+    emit("## kernel event totals (corro.kernel.events.total)")
+    emit(f"{'kernel':<12} {'event':<20} {'total':>14} {'per_tick':>12}")
+    for kernel in sorted(totals):
+        order = {n: i for i, n in enumerate(EVENTS_BY_KERNEL.get(kernel, ()))}
+        for event in sorted(totals[kernel], key=lambda e: order.get(e, 99)):
+            v = totals[kernel][event]
+            per_tick = v / ticks_run if ticks_run else 0.0
+            emit(
+                f"{kernel:<12} {event:<20} {v:>14.0f} {per_tick:>12.2f}"
+            )
+    emit()
+
+    emit("## kernel phase gauges (corro.kernel.phase.seconds)")
+    emit(f"{'kernel':<12} {'phase':<32} {'ms':>12}")
+    for kind, name, labels, value in sorted(
+        METRICS.snapshot(), key=lambda r: (r[1], sorted(r[2].items()))
+    ):
+        if kind == "gauge" and name == "corro.kernel.phase.seconds":
+            emit(
+                f"{labels.get('kernel', '?'):<12} "
+                f"{labels.get('phase', '?'):<32} {value * 1e3:>12.3f}"
+            )
+    emit()
+
+
+def main() -> None:
+    n = int(os.environ.get("OBS_REPORT_N", "2048"))
+    slots = int(os.environ.get("OBS_REPORT_SLOTS", "256"))
+    max_ticks = int(os.environ.get("OBS_REPORT_MAX_TICKS", "600"))
+
+    out = io.StringIO()
+
+    def emit(line: str = "") -> None:
+        print(line, flush=True)
+        out.write(line + "\n")
+
+    emit("# observability report (device telemetry lane → registry render)")
+    emit(
+        f"platform={jax.devices()[0].platform} n={n} slots={slots} "
+        f"max_ticks={max_ticks}"
+    )
+    emit(f"code_sha={json.dumps(_code_sha())}")
+    emit(
+        "measured_at="
+        + time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime())
+        + " UTC"
+    )
+    emit()
+
+    t0 = time.monotonic()
+    sim = PViewClusterSim(
+        n, slots=slots, seed=0, seed_mode="fingers",
+        feeds_per_tick=4, feed_entries=max(16, slots // 16), tie_epoch=512,
+    )
+    stable_tick = sim.run_until_converged(max_ticks=max_ticks, check_every=25)
+    wall = time.monotonic() - t0
+    stats = sim.stats()  # final drain of the lane
+
+    emit(
+        f"workload: pview boot to the four-term bar — stable_tick="
+        f"{stable_tick} wall={wall:.2f}s "
+        f"pv_coverage={stats['pv_coverage']:.4f} "
+        f"fp={stats['false_positive']:.0f}"
+    )
+    emit()
+    render_registry_tables(emit, sim.ticks)
+
+    path = os.environ.get(
+        "OBS_REPORT_OUT", os.path.join(REPO, "OBS_REPORT.md")
+    )
+    with open(path, "w") as fh:
+        fh.write(out.getvalue())
+    print(f"wrote {path}", flush=True)
+    sys.exit(0 if stable_tick is not None else 1)
+
+
+if __name__ == "__main__":
+    main()

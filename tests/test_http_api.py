@@ -130,6 +130,50 @@ def test_bearer_authz():
     asyncio.run(main())
 
 
+def test_status_endpoint_serves_cluster_plane():
+    """GET /v1/status (r7): the JSON snapshot must surface the device
+    kernel telemetry accumulated by a PViewClusterSim in this process —
+    the acceptance path: kernel lane → registry → status plane."""
+    import aiohttp
+
+    from corrosion_tpu.models.cluster import PViewClusterSim
+
+    # populate the process-global registry the way an embedding agent
+    # would: a simulation stepping + draining through stats()
+    sim = PViewClusterSim(128, slots=32, feeds_per_tick=2, feed_entries=16)
+    sim.step(3)
+    sim.stats()
+
+    async def main():
+        net = MemNetwork(seed=41)
+        a, api, client = await boot_with_api(net, "agent-a")
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await s.get(f"http://{api.addrs[0]}/v1/status")
+                assert r.status == 200
+                body = await r.json()
+            assert body["actor_id"] == str(a.actor_id)
+            assert body["cluster"]["size"] >= 1
+            assert "member_states" in body["cluster"]
+            pv = body["kernel_events"]["pview"]
+            assert pv["gossip_emitted"] > 0
+            assert pv["merge_won"] > 0
+            # phase gauges ride along (PViewClusterSim.step publishes)
+            assert body["kernel_phase_seconds"]["pview"]["tick"] > 0
+            assert set(body["loop"]) == {
+                "lag_max_seconds", "tasks_alive", "monitor_ticks"
+            }
+            assert body["sync"]["server_permits_available"] == 3
+        finally:
+            await client.close()
+            await api.stop()
+            from corrosion_tpu.agent.run import shutdown
+
+            await shutdown(a)
+
+    asyncio.run(main())
+
+
 def test_http_write_gossips_to_peer():
     async def main():
         net = MemNetwork(seed=37)

@@ -1,0 +1,300 @@
+"""Device telemetry lane (r7): the [N_EVENTS] counter vector carried in
+the SWIM scan state + the CRDT merge kernel's decision counts.
+
+The lane's contract:
+  1. it never perturbs the kernel — trajectories with the lane are the
+     trajectories without it (same rng stream, pure mask reductions);
+  2. both tick formulations count identically where they are the same
+     computation — `tick_mode="fused"` vs the r5 reference is BIT-equal
+     (events included) once the one semantic difference between them
+     (feed staleness) is configured away, and the identity-hash pview
+     tick counts exactly what the dense tick counts;
+  3. the accounting is internally consistent (emitted = lost +
+     delivered + overflowed) and monotone;
+  4. it adds no host syncs: the fused tick still lowers to ONE scan and
+     the lane drains inside the existing stats readback;
+  5. the drivers publish per-window deltas to the shared registry
+     (`corro.kernel.events.total`) without double counting.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corrosion_tpu.ops import swim, swim_pview
+from corrosion_tpu.runtime.metrics import (
+    CRDT_MERGE_EVENTS,
+    KERNEL_EVENTS,
+    METRICS,
+    Registry,
+    kernel_event_totals,
+)
+
+EV = {name: i for i, name in enumerate(KERNEL_EVENTS)}
+
+
+def _run(params, state, ticks, seed=7, module=swim):
+    # scanned ticks: one small compile per (params, ticks) bucket — an
+    # unrolled per-tick trace at these tick counts is minutes of XLA:CPU
+    # compile on the 1-core CI host
+    return module.tick_n(state, jax.random.PRNGKey(seed), params, ticks)
+
+
+# ---------------------------------------------------------------------------
+# accounting invariants
+# ---------------------------------------------------------------------------
+
+
+def test_dense_events_accounting_identity_under_loss():
+    """emitted = lost + delivered + overflowed, with loss injection on
+    and the inbox cap binding (piggyback+antientropy wide sends)."""
+    params = swim.SwimParams(n=64, loss=0.1, incoming_slots=8)
+    state = swim.init_state(params, jax.random.PRNGKey(0))
+    assert int(jnp.sum(jnp.abs(state.events))) == 0  # lane starts clean
+    state = _run(params, state, 10)
+    ev = np.asarray(state.events)
+    assert ev[EV["gossip_emitted"]] > 0
+    assert ev[EV["gossip_lost"]] > 0  # loss=0.1 over ~10k messages
+    assert ev[EV["inbox_overflowed"]] > 0  # cap 8 < fanout*(piggyback+ae)
+    assert (
+        ev[EV["gossip_emitted"]]
+        == ev[EV["gossip_lost"]]
+        + ev[EV["inbox_delivered"]]
+        + ev[EV["inbox_overflowed"]]
+    )
+    assert ev[EV["feed_pulls"]] > 0 and ev[EV["seed_pulls"]] > 0
+    assert ev[EV["merge_won"]] > 0
+    assert np.all(ev >= 0)
+
+
+def test_pview_events_accounting_identity():
+    params = swim_pview.PViewParams(
+        n=128, slots=32, loss=0.05, feeds_per_tick=2, feed_entries=16
+    )
+    state = swim_pview.init_state(params, jax.random.PRNGKey(0))
+    state = _run(params, state, 10, module=swim_pview)
+    ev = np.asarray(state.events)
+    assert (
+        ev[EV["gossip_emitted"]]
+        == ev[EV["gossip_lost"]]
+        + ev[EV["inbox_delivered"]]
+        + ev[EV["inbox_overflowed"]]
+    )
+    assert ev[EV["gossip_lost"]] > 0
+    assert ev[EV["merge_won"]] > 0
+    assert np.all(ev >= 0)
+
+
+def test_suspicion_lifecycle_events_fire():
+    """A crash must eventually show up in the lane as suspect_raised +
+    down_declared; a restart as refuted (the alive↔suspect↔dead
+    transition visibility Lifeguard-style work needs)."""
+    params = swim.SwimParams(n=32, suspicion_ticks=3)
+    state = swim.init_state(params, jax.random.PRNGKey(0))
+    state = _run(params, state, 10)
+    state = swim.set_alive(state, 5, False)
+    state = _run(params, state, 20, seed=11)
+    ev = np.asarray(state.events)
+    assert ev[EV["suspect_raised"]] > 0
+    assert ev[EV["down_declared"]] > 0
+    # restart + more ticks: the lane is cumulative/monotone
+    state = swim.set_alive(state, 5, True)
+    state = _run(params, state, 20, seed=13)
+    ev2 = np.asarray(state.events)
+    assert np.all(ev2 >= ev)
+
+    # refutation needs a LIVE member to hear itself suspected at its
+    # current incarnation (a restart pre-empts it by bumping inc), so
+    # drive it with heavy loss: failed probes suspect live members, the
+    # suspect gossip reaches them, they refute
+    lossy = swim.SwimParams(n=32, suspicion_ticks=6, loss=0.35)
+    st2 = swim.init_state(lossy, jax.random.PRNGKey(2))
+    st2 = _run(lossy, st2, 40, seed=17)
+    assert np.asarray(st2.events)[EV["refuted"]] > 0
+
+
+# ---------------------------------------------------------------------------
+# the lane counts identically across formulations
+# ---------------------------------------------------------------------------
+
+
+def test_fused_tick_bit_equal_r5_with_feeds_disabled():
+    """With the feed/seed exchange off, "fused" and "r5" are the SAME
+    computation (the restructure only changes feed-read staleness) — so
+    the whole state INCLUDING the telemetry lane must be bit-identical.
+    This is the exactness half of the fused↔r5 telemetry parity pin;
+    the with-feeds half is statistical (test_swim_pview.py)."""
+    mk = lambda tm: swim_pview.PViewParams(  # noqa: E731
+        n=128, slots=32, feed_entries=0, loss=0.05, tick_mode=tm
+    )
+    sf = swim_pview.init_state(mk("fused"), jax.random.PRNGKey(0))
+    sr = swim_pview.init_state(mk("r5"), jax.random.PRNGKey(0))
+    for i in range(12):
+        key = jax.random.fold_in(jax.random.PRNGKey(3), i)
+        if i == 6:  # exercise the suspicion lanes too
+            sf = swim_pview.set_alive(sf, 9, False)
+            sr = swim_pview.set_alive(sr, 9, False)
+        sf = swim_pview.tick(sf, key, mk("fused"))
+        sr = swim_pview.tick(sr, key, mk("r5"))
+    for name, a in sf._asdict().items():
+        assert jnp.array_equal(a, getattr(sr, name)), f"field {name}"
+    assert int(np.asarray(sf.events)[EV["gossip_emitted"]]) > 0
+
+
+def test_identity_hash_pview_events_equal_dense():
+    """In the dense-equivalence configuration (slots == n, identity
+    hash, r5/pick) the pview tick IS the dense tick — so the two lanes
+    must agree event for event, tick for tick."""
+    n = 48
+    dp = swim.SwimParams(
+        n=n, feeds_per_tick=2, feed_entries=16, announce_period=8,
+        antientropy=2, gossip_mode="pick", loss=0.1,
+    )
+    pp = swim_pview.PViewParams(
+        n=n, slots=n, identity_hash=True, feeds_per_tick=2,
+        feed_entries=16, announce_period=8, antientropy=2,
+        tick_mode="r5", gossip_mode="pick", loss=0.1,
+    )
+    ds = swim.init_state(dp, jax.random.PRNGKey(0))
+    ps = swim_pview.init_state(pp, jax.random.PRNGKey(0))
+    for i in range(15):
+        key = jax.random.fold_in(jax.random.PRNGKey(7), i)
+        if i == 5:
+            ds = swim.set_alive(ds, 5, False)
+            ps = swim_pview.set_alive(ps, 5, False)
+        ds = swim.tick(ds, key, dp)
+        ps = swim_pview.tick(ps, key, pp)
+        assert jnp.array_equal(ds.events, ps.events), (
+            i,
+            dict(zip(KERNEL_EVENTS, np.asarray(ds.events))),
+            dict(zip(KERNEL_EVENTS, np.asarray(ps.events))),
+        )
+
+
+# ---------------------------------------------------------------------------
+# zero extra host syncs
+# ---------------------------------------------------------------------------
+
+
+def test_fused_tick_still_lowers_to_one_scan():
+    """The acceptance pin: the telemetry lane rides the scan carry — the
+    jaxpr of the scanned fused tick contains exactly ONE scan (and no
+    while/cond smuggled in by the lane)."""
+    params = swim_pview.PViewParams(n=64, slots=16, feeds_per_tick=2,
+                                    feed_entries=8)
+    state = swim_pview.init_state(params, jax.random.PRNGKey(0))
+    jaxpr = jax.make_jaxpr(
+        lambda s, r: swim_pview._tick_n_impl(s, r, params, 4)
+    )(state, jax.random.PRNGKey(1))
+    text = str(jaxpr)
+    assert text.count("scan[") == 1, "fused tick no longer one scan"
+    assert "while[" not in text
+
+    # dense kernel: same contract
+    dparams = swim.SwimParams(n=64)
+    dstate = swim.init_state(dparams, jax.random.PRNGKey(0))
+    dtext = str(
+        jax.make_jaxpr(
+            lambda s, r: swim._tick_n_impl(s, r, dparams, 4)
+        )(dstate, jax.random.PRNGKey(1))
+    )
+    assert dtext.count("scan[") == 1
+
+
+def test_stats_and_events_single_readback_and_uint32_wrap():
+    """stats_and_events returns the lane beside the stats; a lane that
+    wrapped mod 2^32 on device still yields correct uint32 deltas."""
+    params = swim.SwimParams(n=32)
+    state = swim.init_state(params, jax.random.PRNGKey(0))
+    state = swim.tick(state, jax.random.PRNGKey(1), params)
+    stats, ev = swim.stats_and_events(state)
+    assert set(stats) == {"coverage", "detected", "false_positive"}
+    assert ev.dtype == np.uint32 and ev.shape == (swim.N_EVENTS,)
+
+    # wrap math: device totals are int32 two's complement; a prev
+    # snapshot near the top of the range subtracts wrap-safe
+    prev = np.array([0xFFFF_FFF0], dtype=np.uint32)
+    cur = np.array([16], dtype=np.uint32)  # wrapped past 2^32
+    assert int((cur - prev).astype(np.uint32)[0]) == 32
+
+
+# ---------------------------------------------------------------------------
+# driver publishing + the CRDT merge kernel's lane
+# ---------------------------------------------------------------------------
+
+
+def test_cluster_sims_publish_registry_deltas():
+    from corrosion_tpu.models.cluster import PViewClusterSim
+
+    reg = Registry()
+    import corrosion_tpu.models.cluster as cluster_mod
+
+    # publish into a scratch registry: the assertion is on deltas, which
+    # the process-global registry (other tests) would pollute
+    orig = cluster_mod.record_kernel_events
+    cluster_mod.record_kernel_events = (
+        lambda kernel, deltas: orig(kernel, deltas, registry=reg)
+    )
+    try:
+        sim = PViewClusterSim(128, slots=32, feeds_per_tick=2,
+                              feed_entries=16)
+        sim.step(5)
+        sim.stats()
+        totals1 = kernel_event_totals(reg)["pview"]
+        device_now = np.asarray(jax.device_get(sim.state.events))
+        for name, i in EV.items():
+            if device_now[i]:
+                assert totals1[name] == float(device_now[i]), name
+        # draining again without stepping must add nothing
+        sim.stats()
+        assert kernel_event_totals(reg)["pview"] == totals1
+        # stepping again adds exactly the new window
+        sim.step(3)
+        sim.stats()
+        totals2 = kernel_event_totals(reg)["pview"]
+        device_after = np.asarray(jax.device_get(sim.state.events))
+        for name, i in EV.items():
+            if device_after[i]:
+                assert totals2[name] == float(device_after[i]), name
+    finally:
+        cluster_mod.record_kernel_events = orig
+
+
+def test_crdt_merge_kernel_publishes_decision_events(monkeypatch):
+    """The array engine's decisions surface as
+    corro.kernel.events.total{kernel="crdt_merge"} increments, counted
+    on-device and drained with the decision readback."""
+    import random
+
+    from tests.test_crdt_batch import mk_store, random_changes
+
+    monkeypatch.setenv("CORRO_CRDT_ENGINE", "array")
+    before = kernel_event_totals(METRICS).get("crdt_merge", {})
+    b_won = before.get("decide_won", 0.0)
+    b_stale = before.get("decide_stale", 0.0)
+    store = mk_store()
+    changes = random_changes(random.Random(99), 60)
+    res = store.apply_changes(changes)
+    store.close()
+    assert res is not None
+    after = kernel_event_totals(METRICS)["crdt_merge"]
+    won = after.get("decide_won", 0.0) - b_won
+    stale = after.get("decide_stale", 0.0) - b_stale
+    # the store may pre-filter already-known changes before the kernel
+    # sees a batch, so <= holds, not ==; both outcome classes must have
+    # been counted for a random workload this size
+    assert won > 0 and stale > 0
+    assert won + stale <= len(changes)
+
+
+def test_event_tables_are_canonical():
+    """The single-source-of-truth tables the kernels, sims, status plane
+    and report all key on."""
+    assert len(KERNEL_EVENTS) == swim.N_EVENTS
+    assert len(set(KERNEL_EVENTS)) == len(KERNEL_EVENTS)
+    assert len(set(CRDT_MERGE_EVENTS)) == len(CRDT_MERGE_EVENTS) == 4
+    with pytest.raises(ValueError):
+        swim._event_vector(nonsense=jnp.int32(1), **{
+            n: jnp.int32(0) for n in KERNEL_EVENTS
+        })

@@ -55,6 +55,55 @@ def test_metrics_registry():
     assert "x_lat_count 1" in text
 
 
+def test_metrics_label_values_escaped():
+    """Prometheus text format 0.0.4: backslash, double quote and line
+    feed in label VALUES must be escaped — a hostile table name or
+    endpoint path must not corrupt the whole exposition (r7 satellite;
+    the old renderer emitted them raw)."""
+    r = Registry()
+    r.counter("x.count", table='we"ird\ntbl\\v').inc()
+    text = r.render_prometheus()
+    assert 'x_count{table="we\\"ird\\ntbl\\\\v"} 1.0' in text
+    # exactly one physical line for the sample (the \n stayed escaped)
+    lines = [ln for ln in text.splitlines() if ln.startswith("x_count")]
+    assert len(lines) == 1
+    # snapshot() returns the raw (unescaped) labels
+    (row,) = r.snapshot()
+    assert row == ("counter", "x.count", {"table": 'we"ird\ntbl\\v'}, 1.0)
+
+
+def test_metrics_instruments_are_thread_safe():
+    """Counter.inc / Gauge.add / Histogram.observe are called from
+    worker threads (agent_metrics.collect_once, simulation drivers)
+    while the event loop mutates the same instruments: the += is a
+    read-modify-write the GIL does NOT make atomic.  Two threads
+    hammering each instrument must lose nothing (r7 satellite: each
+    instrument now carries its own lock)."""
+    import threading
+
+    r = Registry()
+    c = r.counter("t.count")
+    g = r.gauge("t.gauge")
+    h = r.histogram("t.lat")
+    n = 20_000
+
+    def hammer():
+        for i in range(n):
+            c.inc()
+            g.add(1.0)
+            h.observe(0.001 * (i % 7))
+
+    threads = [threading.Thread(target=hammer) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 2 * n
+    assert g.value == 2 * n
+    assert h.count == 2 * n
+    assert sum(h.counts) == 2 * n
+
+
 def test_channel_send_recv_close():
     async def main():
         tx, rx = bounded(4, "test")

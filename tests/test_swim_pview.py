@@ -76,6 +76,10 @@ def test_identity_hash_bit_parity_with_dense():
     assert jnp.array_equal(ps.probe_phase, ds.probe_phase)
     assert jnp.array_equal(ps.probe_subj, ds.probe_subj)
     assert jnp.array_equal(ps.susp_subj, ds.susp_subj)
+    # r7: the device telemetry lane is part of the bit-parity contract —
+    # both kernels must have COUNTED identically, not just merged
+    # identically (test_kernel_telemetry.py pins the per-tick version)
+    assert jnp.array_equal(ps.events, ds.events)
 
 
 def test_bounded_view_converges():
