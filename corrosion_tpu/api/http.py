@@ -12,6 +12,9 @@ Counterpart of the axum router in `klukai-agent/src/agent/util.rs:181-351`:
     snapshot of membership census, kernel event telemetry, loop lag and
     sync backlog, read non-mutatingly from the shared registry; the
     machine-readable sibling of /metrics for dashboards and obs_report)
+  - GET  /v1/flight         (r8: the flight-recorder timeline plane —
+    the last-K per-tick frames stitched from the device rings, the
+    tick-RESOLVED sibling of /v1/status's cumulative totals)
   - bearer-token authz middleware (`util.rs:330-351`), load-shed → 503
 """
 
@@ -81,6 +84,7 @@ class ApiServer:
         app.router.add_get("/v1/subscriptions/{id}", self.h_subscription_by_id)
         app.router.add_post("/v1/updates/{table}", self.h_updates)
         app.router.add_get("/v1/status", self.h_status)
+        app.router.add_get("/v1/flight", self.h_flight)
         return app
 
     async def start(self) -> None:
@@ -433,6 +437,32 @@ class ApiServer:
             },
         }
         return web.json_response(status)
+
+    async def h_flight(self, request: web.Request) -> web.Response:
+        """Flight-recorder timeline plane: the last-K per-tick frames
+        (`?window=K`, default 64, capped at the recorder's capacity;
+        `?kernel=` filters one kernel's timeline).  Each frame is one
+        protocol period: event DELTAS + census levels, wall-clock
+        stamped at drain — where /v1/status answers "how much, total",
+        this answers "when" (the distinction a convergence-stall
+        post-mortem actually needs)."""
+        from corrosion_tpu.runtime.metrics import FLIGHT_CENSUS, KERNEL_EVENTS
+        from corrosion_tpu.runtime.records import FLIGHT
+
+        try:
+            window = int(request.query.get("window", "64"))
+        except ValueError:
+            raise web.HTTPBadRequest(text="window must be an integer")
+        kernel = request.query.get("kernel") or None
+        frames = FLIGHT.window(max(1, min(window, 4096)), kernel=kernel)
+        return web.json_response(
+            {
+                "window": len(frames),
+                "event_lanes": list(KERNEL_EVENTS),
+                "census_lanes": list(FLIGHT_CENSUS),
+                "frames": frames,
+            }
+        )
 
     # -- pubsub routes (wired when managers are attached) ------------------
 

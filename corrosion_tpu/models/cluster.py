@@ -18,10 +18,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from corrosion_tpu.ops import swim, swim_pview
+from corrosion_tpu.runtime import trace
 from corrosion_tpu.runtime.metrics import (
     record_kernel_events,
     record_phase_seconds,
 )
+from corrosion_tpu.runtime.records import FLIGHT
 
 
 @dataclass
@@ -40,10 +42,22 @@ def _publish_event_deltas(
     as `corro.kernel.events.total{kernel=,event=}` counter increments.
     The device totals wrap mod 2^32 (int32 lane); uint32 subtraction
     makes the delta wrap-safe as long as one drain window stays under
-    2^32 events — every driver drains at least once per stats check."""
-    delta = (cur - prev).astype(np.uint32)
-    record_kernel_events(kernel, delta.tolist())
+    2^32 events — every driver drains at least once per stats check.
+    Span-wrapped so an OTLP trace shows WHEN each publish window landed
+    (runtime/trace.py; flight frames carry the same wall clock)."""
+    with trace.span("sim.events.publish", kernel=kernel):
+        delta = (cur - prev).astype(np.uint32)
+        record_kernel_events(kernel, delta.tolist())
     return cur
+
+
+def _drain_flight(kernel: str, drain, since: int) -> int:
+    """Stitch one drained device ring into the process-global flight
+    recorder (span-wrapped for the OTLP ↔ flight wall-clock line-up);
+    returns the new per-sim cursor."""
+    with trace.span("sim.flight.drain", kernel=kernel, tick=drain.t):
+        FLIGHT.record_ring(kernel, drain, since=since)
+    return drain.t
 
 
 class ClusterSim:
@@ -67,6 +81,7 @@ class ClusterSim:
         self.history: List[TickMetrics] = []
         self.ticks = 0  # host-side mirror of state.t (no device readback)
         self._ev_prev = np.zeros(swim.N_EVENTS, dtype=np.uint32)
+        self._flight_next = 0  # flight-recorder cursor (see _drain_flight)
 
     def step(self, ticks: int = 1) -> None:
         """Advance `ticks` protocol periods in ONE device dispatch
@@ -88,11 +103,13 @@ class ClusterSim:
         self.state = swim.set_alive(self.state, member, True)
 
     def stats(self) -> Dict[str, float]:
-        """Convergence stats; the device telemetry lane drains in the
-        SAME readback and its per-window deltas are published to the
-        shared registry (`corro.kernel.events.total{kernel="dense"}`)."""
-        s, ev = swim.stats_and_events(self.state)
+        """Convergence stats; the device telemetry lane AND the flight
+        ring drain in the SAME readback — deltas go to the shared
+        registry (`corro.kernel.events.total{kernel="dense"}`), per-tick
+        frames to the global `FLIGHT` recorder."""
+        s, ev, fl = swim.stats_and_events(self.state)
         self._ev_prev = _publish_event_deltas("dense", self._ev_prev, ev)
+        self._flight_next = _drain_flight("dense", fl, self._flight_next)
         return s
 
     def run_until_stable(
@@ -159,11 +176,18 @@ class ClusterSim:
             float(coverage_target), int(check_every), int(limit),
         )
         self.ticks = int(self.state.t)
-        # one readback: the loop verdict + the telemetry lane the device
-        # loop accumulated while it ran unobserved
-        cov_v, ev = jax.device_get((cov, self.state.events))
+        # one readback: the loop verdict + the telemetry lane + the
+        # flight ring the device loop accumulated while it ran unobserved
+        cov_v, ev, ring = jax.device_get(
+            (cov, self.state.events, self.state.ring)
+        )
         self._ev_prev = _publish_event_deltas(
             "dense", self._ev_prev, np.asarray(ev).astype(np.uint32)
+        )
+        self._flight_next = _drain_flight(
+            "dense",
+            swim.FlightDrain(ring=np.asarray(ring), t=self.ticks),
+            self._flight_next,
         )
         # verdict must use the same precision the on-device predicate
         # compared at (f32), else a loop-satisfied coverage in
@@ -236,6 +260,7 @@ class PViewClusterSim:
         )
         self.ticks = 0  # host-side mirror of state.t (no device readback)
         self._ev_prev = np.zeros(swim.N_EVENTS, dtype=np.uint32)
+        self._flight_next = 0  # flight-recorder cursor (see _drain_flight)
 
     def step(self, ticks: int = 1) -> None:
         """Advance `ticks` protocol periods in ONE donated dispatch."""
@@ -257,10 +282,11 @@ class PViewClusterSim:
         self.state = swim_pview.set_alive_many(self.state, members, True)
 
     def stats(self) -> Dict[str, float]:
-        """Four-term-bar stats; drains + publishes the telemetry lane in
-        the same readback (see class docstring)."""
-        s, ev = swim_pview.stats_and_events(self.state, self.params)
+        """Four-term-bar stats; drains + publishes the telemetry lane
+        and the flight ring in the same readback (see class docstring)."""
+        s, ev, fl = swim_pview.stats_and_events(self.state, self.params)
         self._ev_prev = _publish_event_deltas("pview", self._ev_prev, ev)
+        self._flight_next = _drain_flight("pview", fl, self._flight_next)
         return s
 
     def converged(self, stats: Dict[str, float], cov_target: float = 0.99,
@@ -310,10 +336,18 @@ class PViewClusterSim:
             float(cov_target), int(quorum), int(check_every), int(limit),
         )
         self.ticks = int(self.state.t)
-        # one readback: the four-term verdict + the device loop's lane
-        vals, ev = jax.device_get((vals, self.state.events))
+        # one readback: the four-term verdict + the device loop's lane +
+        # its flight ring
+        vals, ev, ring = jax.device_get(
+            (vals, self.state.events, self.state.ring)
+        )
         self._ev_prev = _publish_event_deltas(
             "pview", self._ev_prev, np.asarray(ev).astype(np.uint32)
+        )
+        self._flight_next = _drain_flight(
+            "pview",
+            swim.FlightDrain(ring=np.asarray(ring), t=self.ticks),
+            self._flight_next,
         )
         vals = np.asarray(vals)
         sat = swim_pview.saturation_floor(self.params.n, self.params.slots)

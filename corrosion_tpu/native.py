@@ -179,7 +179,16 @@ def load_into(conn) -> bool:
     try:
         conn.enable_load_extension(True)
         try:
-            conn.load_extension(path, entrypoint=ENTRYPOINT)
+            try:
+                conn.load_extension(path, entrypoint=ENTRYPOINT)
+            except TypeError:
+                # py3.10/3.11: load_extension() takes no entrypoint
+                # (added in 3.12).  SQLite then derives the entrypoint
+                # from the filename — crdtext.so → sqlite3_crdtext_init,
+                # which IS our ENTRYPOINT, so the bare call loads the
+                # same symbol (same shim spirit as the tomllib→tomli
+                # fallback in runtime/config.py).
+                conn.load_extension(path)
         finally:
             conn.enable_load_extension(False)
         return True

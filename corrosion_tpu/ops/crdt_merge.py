@@ -45,7 +45,11 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from corrosion_tpu.runtime.metrics import record_kernel_events
+from corrosion_tpu.runtime.metrics import (
+    CRDT_MERGE_EVENTS,
+    record_kernel_events,
+)
+from corrosion_tpu.runtime.records import FLIGHT
 
 SENTINEL = "-1"
 
@@ -465,11 +469,19 @@ def merge_table_array(
         # the batch falls back to a host engine: only the ambiguity
         # count is real telemetry (the win/stale decisions are discarded
         # and re-made by the fallback — recording them would double-book)
-        record_kernel_events(
-            "crdt_merge", [0, 0, 0, int(events[3])]
+        ev_list = [0, 0, 0, int(events[3])]
+        record_kernel_events("crdt_merge", ev_list)
+        FLIGHT.record_host_frame(
+            "crdt_merge", dict(zip(CRDT_MERGE_EVENTS, ev_list))
         )
         return None
     record_kernel_events("crdt_merge", events)
+    # the merge kernel has no scan carry, so its flight frames are
+    # host-side: one per decided batch, same lanes as the counter drain
+    FLIGHT.record_host_frame(
+        "crdt_merge",
+        dict(zip(CRDT_MERGE_EVENTS, (int(v) for v in events))),
+    )
 
     # ---- rebuild the engine-contract flush plans -------------------------
     wins = [bool(win[j]) for j in range(n)]

@@ -48,7 +48,7 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from corrosion_tpu.runtime.metrics import KERNEL_EVENTS
+from corrosion_tpu.runtime.metrics import FLIGHT_CENSUS, KERNEL_EVENTS
 
 INT32_MAX = jnp.iinfo(jnp.int32).max
 
@@ -68,6 +68,61 @@ INT32_MAX = jnp.iinfo(jnp.int32).max
 
 N_EVENTS = len(KERNEL_EVENTS)
 _EV_IDX = {name: i for i, name in enumerate(KERNEL_EVENTS)}
+
+# ---------------------------------------------------------------------------
+# flight recorder (r8): besides the cumulative lane, both SWIM kernels
+# carry a [ring_ticks, N_FLIGHT_LANES] int32 ring in the scan state — per
+# tick, row t % ring_ticks records THIS tick's event-delta vector (the
+# diff of the cumulative lane, no new masks) followed by a compact census
+# frame (FLIGHT_CENSUS order).  One dynamic_update_slice per tick, so the
+# fused tick still lowers to one scan and stays donation-aliased; the
+# ring drains in the same `stats_and_events` readback as everything else
+# (zero extra host syncs) and replicates across the mesh like the events
+# lane (parallel/mesh.py).  At the default 128×16 the ring is 8 KiB —
+# invisible next to any view/table.  Conservation invariant (pinned by
+# tests/test_flight_recorder.py): over any window that fits the ring,
+# sum(ring event rows) == cumulative-lane delta, bit-exactly.
+
+N_CENSUS = len(FLIGHT_CENSUS)
+N_FLIGHT_LANES = N_EVENTS + N_CENSUS
+
+
+def _census_frame(n: int, alive, susp_subj, inc, in_subj) -> jax.Array:
+    """[N_CENSUS] int32 point-in-time census in FLIGHT_CENSUS order.
+    Every term is an [N]-shaped integer reduction over state the tick
+    already holds — deliberately NO whole-view/table pass (that would
+    put an O(N^2)/O(N·K) reduction in every tick; the blocked stats
+    pass stays the readback-time answer for view-derived census)."""
+    return jnp.stack(
+        [
+            _bsum(alive),
+            _bsum(susp_subj < n),
+            _bsum(~alive),
+            jnp.max(jnp.sum(in_subj < n, axis=1, dtype=jnp.int32)),
+            jnp.max(inc),
+        ]
+    )
+
+
+def _ring_write(ring, t, ring_ticks: int, frame) -> jax.Array:
+    """Record one tick's [N_FLIGHT_LANES] frame at row t % ring_ticks
+    (one dynamic_update_slice — in-place under donation)."""
+    return jax.lax.dynamic_update_slice(
+        ring,
+        frame[None, :],
+        (jnp.mod(t, jnp.int32(ring_ticks)), jnp.int32(0)),
+    )
+
+
+class FlightDrain(NamedTuple):
+    """Host-side snapshot of the device ring: the raw [R, L] rows plus
+    the absolute tick they were drained at.  Row j holds the frame of
+    tick j + k*R for the largest k keeping it < t — i.e. ticks
+    [max(0, t - R), t) are live; `runtime.records.frames_from_ring`
+    does the stitching arithmetic in ONE place."""
+
+    ring: object  # np.ndarray [ring_ticks, N_FLIGHT_LANES] int32
+    t: int
 
 
 def _bsum(mask) -> jax.Array:
@@ -138,6 +193,9 @@ class SwimParams(NamedTuple):
     # (shift 11.70 s / stable_tick 55 vs pick 14.16 s / 70 at n=10k,
     # PROFILE.md) after the chip window never came; revert criterion
     # recorded in COMPONENTS.md.
+    ring_ticks: int = 128  # flight-recorder depth (per-tick frames kept
+    # on device; see the ring note above). 0 disables the ring (the
+    # state carries a [0, L] array — a perf A/B lever, not a default).
 
 
 VIEW_DTYPE = jnp.int16
@@ -221,6 +279,9 @@ class SwimState(NamedTuple):
     # telemetry in KERNEL_EVENTS order (wraps mod 2^32; see lane note
     # above). NOT a per-member array: sharding replicates it
     # (parallel/mesh.py special-cases the field by name)
+    ring: jax.Array  # [ring_ticks, N_FLIGHT_LANES] int32 — the flight
+    # recorder: per-tick event deltas + census frames (see ring note
+    # above). Replicated under sharding like `events` (by name)
 
 
 def init_state(
@@ -304,6 +365,9 @@ def _init_state_impl(
         susp_deadline=jnp.zeros((n, s), dtype=jnp.int32),
         partition=jnp.zeros(n, dtype=jnp.int32),
         events=jnp.zeros(N_EVENTS, dtype=jnp.int32),
+        ring=jnp.zeros(
+            (params.ring_ticks, N_FLIGHT_LANES), dtype=jnp.int32
+        ),
     )
 
 
@@ -927,7 +991,7 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
 
     # telemetry lane: exact counts of the masks this tick materialized
     # anyway — no extra gathers, no host sync (drained with the stats)
-    events = state.events + _event_vector(
+    ev_delta = _event_vector(
         gossip_emitted=ev_emitted,
         gossip_lost=ev_lost,
         inbox_delivered=ev_delivered,
@@ -940,6 +1004,18 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
         refuted=_bsum(refute),
         self_announced=ev_announce,
     )
+    events = state.events + ev_delta
+
+    # flight ring: this tick's delta vector + census, one
+    # dynamic_update_slice at row t % ring_ticks (see ring note above)
+    ring = state.ring
+    if params.ring_ticks > 0:
+        ring = _ring_write(
+            ring, t, params.ring_ticks,
+            jnp.concatenate(
+                [ev_delta, _census_frame(n, alive, susp_subj, inc, in_subj)]
+            ),
+        )
 
     return SwimState(
         t=t + 1,
@@ -958,6 +1034,7 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
         susp_deadline=susp_deadline,
         partition=part,
         events=events,
+        ring=ring,
     )
 
 
@@ -1129,13 +1206,18 @@ run_to_coverage = functools.partial(
 
 
 def stats_and_events(state: SwimState):
-    """(stats dict, [N_EVENTS] uint32 event totals) in ONE device→host
-    readback — the telemetry lane drains beside the stats it already
-    pays for, never as its own sync."""
+    """(stats dict, [N_EVENTS] uint32 event totals, FlightDrain) in ONE
+    device→host readback — the telemetry lane AND the flight ring drain
+    beside the stats they already pay for, never as their own sync."""
     import numpy as np
 
-    vals, ev = jax.device_get(
-        (_stats_impl(state.view, state.alive), state.events)
+    vals, ev, ring, t = jax.device_get(
+        (
+            _stats_impl(state.view, state.alive),
+            state.events,
+            state.ring,
+            state.t,
+        )
     )
     vals = np.asarray(vals)
     stats = {
@@ -1144,7 +1226,11 @@ def stats_and_events(state: SwimState):
         "false_positive": float(vals[2]),  # live members suspected/downed
     }
     # uint32 view: totals wrap mod 2^32, drains subtract in uint32
-    return stats, np.asarray(ev).astype(np.uint32)
+    return (
+        stats,
+        np.asarray(ev).astype(np.uint32),
+        FlightDrain(ring=np.asarray(ring), t=int(t)),
+    )
 
 
 def membership_stats(state: SwimState) -> dict:

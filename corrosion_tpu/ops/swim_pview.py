@@ -79,6 +79,7 @@ import jax.numpy as jnp
 from corrosion_tpu.ops.swim import (
     INT32_MAX,
     N_EVENTS,
+    N_FLIGHT_LANES,
     PREC_ALIVE,
     PREC_DOWN,
     PREC_SUSPECT,
@@ -87,7 +88,10 @@ from corrosion_tpu.ops.swim import (
     _EV_IDX,
     _bsum,
     _buffer_merge,
+    _census_frame,
     _event_vector,
+    _ring_write,
+    FlightDrain,
     dispatch_inbox,
     finger_offsets,
     key_inc,
@@ -174,6 +178,9 @@ class PViewParams(NamedTuple):
     # build (the r5 path; the identity-hash parity pin uses it because
     # the dense parity contract is pick-shaped).
     gossip_mode: str = "shift"
+    ring_ticks: int = 128  # flight-recorder depth (see ops/swim.py ring
+    # note — per-tick event-delta + census frames in the scan carry;
+    # 0 disables)
 
 
 def _keycap(n: int) -> int:
@@ -293,6 +300,8 @@ class PViewState(NamedTuple):
     events: jax.Array  # [N_EVENTS] int32 — cumulative on-device event
     # telemetry, KERNEL_EVENTS order (see swim.py lane note; replicated
     # under sharding, wrap-mod-2^32 totals drained as uint32 deltas)
+    ring: jax.Array  # [ring_ticks, N_FLIGHT_LANES] int32 — the flight
+    # recorder ring (see swim.py ring note; replicated like `events`)
 
 
 def init_state(
@@ -389,6 +398,9 @@ def _init_impl(
         susp_deadline=jnp.zeros((n, s), dtype=jnp.int32),
         partition=jnp.zeros(n, dtype=jnp.int32),
         events=jnp.zeros(N_EVENTS, dtype=jnp.int32),
+        ring=jnp.zeros(
+            (params.ring_ticks, N_FLIGHT_LANES), dtype=jnp.int32
+        ),
     )
 
 
@@ -800,11 +812,13 @@ def tick_impl(
         )
         ev_announce = _bsum(due)
 
-    # telemetry lane, merge_won still pending: every term below reads
-    # only masks computed against the tick-start table, so the vector is
-    # a legitimate barrier operand in fused mode (it pins the table-
-    # derived reads it consumes ahead of the in-place merge, like the
-    # FSM lanes)
+    # telemetry lane + flight frame, merge_won still pending: every term
+    # below reads only masks computed against the tick-start table, so
+    # the vector is a legitimate barrier operand in fused mode (it pins
+    # the table-derived reads it consumes ahead of the in-place merge,
+    # like the FSM lanes).  The census half is likewise final here —
+    # susp_subj/inc settled in phases 1-5, in_subj in phase 4 — and
+    # deliberately reads no table cell (swim._census_frame).
     ev_vec = _event_vector(
         gossip_emitted=ev_emitted,
         gossip_lost=ev_lost,
@@ -817,6 +831,9 @@ def tick_impl(
         down_declared=_bsum(fire),
         refuted=_bsum(refute),
         self_announced=ev_announce,
+    )
+    frame = jnp.concatenate(
+        [ev_vec, _census_frame(n, alive, susp_subj, inc, in_subj)]
     )
 
     # ---- 6. row-aligned slot update + relay ------------------------------
@@ -850,11 +867,11 @@ def tick_impl(
             feed_cols = jnp.zeros((n, 0), dtype=jnp.int32)
         (packed, feed_vals, feed_cols, new_packed, cols, prev, improved,
          phase, psubj, pdl, pok, susp_subj, susp_inc, susp_deadline, inc,
-         ev_vec,
+         frame,
          ) = jax.lax.optimization_barrier(
             (packed, feed_vals, feed_cols, new_packed, cols, prev, improved,
              phase, psubj, pdl, pok, susp_subj, susp_inc, susp_deadline, inc,
-             ev_vec)
+             frame)
         )
         # two in-place scatters, not one concatenated [N, W_total] plane:
         # the updates are all precomputed above, so ordering stays
@@ -892,9 +909,11 @@ def tick_impl(
 
     # merge_won lands now that `improved` is settled (post-barrier in
     # fused mode); the counter sums a mask, never re-reads the table
-    events = state.events + ev_vec.at[_EV_IDX["merge_won"]].add(
-        _bsum(improved)
-    )
+    frame = frame.at[_EV_IDX["merge_won"]].add(_bsum(improved))
+    events = state.events + frame[:N_EVENTS]
+    ring = state.ring
+    if params.ring_ticks > 0:
+        ring = _ring_write(ring, t, params.ring_ticks, frame)
 
     relay_ok = improved & (all_subj != idx[:, None]) & (all_subj < n)
     bin_subj = jnp.concatenate(
@@ -939,6 +958,7 @@ def tick_impl(
         susp_deadline=susp_deadline,
         partition=part,
         events=events,
+        ring=ring,
     )
 
 
@@ -1163,14 +1183,17 @@ run_to_converged = functools.partial(
 
 
 def stats_and_events(state: PViewState, params: PViewParams):
-    """(stats dict, [N_EVENTS] uint32 event totals) in ONE device→host
-    readback — the telemetry lane piggybacks on the stats transfer."""
+    """(stats dict, [N_EVENTS] uint32 event totals, FlightDrain) in ONE
+    device→host readback — the telemetry lane and the flight ring
+    piggyback on the stats transfer."""
     import numpy as np
 
-    vals, ev = jax.device_get(
+    vals, ev, ring, t = jax.device_get(
         (
             _stats_impl(params, state.slot_packed, state.alive, state.t),
             state.events,
+            state.ring,
+            state.t,
         )
     )
     vals = np.asarray(vals)
@@ -1182,7 +1205,11 @@ def stats_and_events(state: PViewState, params: PViewParams):
         "false_positive": float(vals[4]),
         "detected": float(vals[5]),
     }
-    return stats, np.asarray(ev).astype(np.uint32)
+    return (
+        stats,
+        np.asarray(ev).astype(np.uint32),
+        FlightDrain(ring=np.asarray(ring), t=int(t)),
+    )
 
 
 def membership_stats(state: PViewState, params: PViewParams) -> dict:

@@ -129,11 +129,12 @@ shard_swim_state = shard_member_state
 def _state_shardings(state, mesh: Mesh):
     out = {}
     for name, arr in state._asdict().items():
-        if getattr(arr, "ndim", 0) == 0 or name == "events":
-            # scalars AND the [N_EVENTS] telemetry lane replicate: the
-            # events vector is not a per-member array (its length is the
-            # event-table size, not divisible by the mesh), and its
-            # integer sums all-reduce bit-identically
+        if getattr(arr, "ndim", 0) == 0 or name in ("events", "ring"):
+            # scalars AND the telemetry lanes replicate: the [N_EVENTS]
+            # events vector and the [ring_ticks, N_FLIGHT_LANES] flight
+            # ring are not per-member arrays (their leading axes are
+            # table sizes, not member counts), and their integer
+            # sums/maxes all-reduce bit-identically
             out[name] = NamedSharding(mesh, P())
         else:
             out[name] = _sharding_for(mesh, arr.ndim)

@@ -67,6 +67,16 @@ def assert_always(
     METRICS.counter("corro.invariant.violated", invariant=name).inc()
     logger.error("invariant violated: %s %s", name, details or {})
     if mode == "strict":
+        # chaos trip: before the violation kills the harness, dump the
+        # flight recorder's per-tick history — the black box a post-
+        # mortem replays the churn/suspicion timeline from (best-effort,
+        # a second failure must not mask the invariant itself)
+        try:
+            from corrosion_tpu.runtime.records import FLIGHT
+
+            FLIGHT.snapshot_incident(f"invariant:{name}")
+        except Exception:  # pragma: no cover - diagnostics never mask
+            pass
         raise InvariantViolation(f"{name}: {details or {}}")
     return False
 

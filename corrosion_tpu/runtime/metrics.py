@@ -230,6 +230,29 @@ KERNEL_EVENTS = (
     "self_announced",     # periodic self-announces entering gossip
 )
 
+# Flight-recorder census lanes (r8): the per-tick snapshot half of the
+# device flight ring (`SwimState.ring` / `PViewState.ring` — ops/swim.py
+# `_census_frame`).  Each ring row is [KERNEL_EVENTS deltas ‖ census]:
+# the event lanes hold THIS tick's delta of the cumulative vector above;
+# the census lanes hold point-in-time levels.  All are cheap [N]-shaped
+# integer reductions over arrays the tick already carries — never a
+# whole-view/table pass:
+FLIGHT_CENSUS = (
+    "census_alive",       # ground-truth live processes (sum alive)
+    "census_suspect",     # open suspicion timers cluster-wide — the
+    #                       per-protocol-period "suspicion pressure"
+    #                       SWIM pathologies show up in (Das et al.;
+    #                       Lifeguard)
+    "census_down",        # ground-truth dead processes (detected or
+    #                       not) — churn injections appear as steps
+    "inbox_highwater",    # max per-member valid inbox entries this tick
+    "inc_max",            # max incarnation — refute storms ramp it
+)
+
+# One ring row = event deltas then census, in this order.  Reordering
+# is a wire-format change for every drained ring snapshot.
+FLIGHT_LANES = KERNEL_EVENTS + FLIGHT_CENSUS
+
 # The CRDT merge kernel's lane (ops/crdt_merge.py `_merge_kernel`):
 # per-batch decision outcomes, drained by the host wrapper in the same
 # readback as the decision outputs.

@@ -1,11 +1,23 @@
-"""Measurement-record JSON files with merge-by-key writes.
+"""Measurement records: merge-by-key JSON files + the flight recorder.
 
-The pview scale scripts (`scripts/pview_scale.py`, `scripts/pview_1m.py`)
-record rungs into the shared PVIEW_SCALE.json; each must replace only the
-rungs it re-measured, never clobber another script's records. This is the
-single copy of that merge. (`scripts/scale_ladder.py` keeps its own
-composite-key last-wins merge over BASELINE_MEASURED.json — a different
-contract, deliberately not unified.)
+Two record planes live here:
+
+1. `merge_records` — the pview scale scripts (`scripts/pview_scale.py`,
+   `scripts/pview_1m.py`) record rungs into the shared PVIEW_SCALE.json;
+   each must replace only the rungs it re-measured, never clobber
+   another script's records. This is the single copy of that merge.
+   (`scripts/scale_ladder.py` keeps its own composite-key last-wins
+   merge over BASELINE_MEASURED.json — a different contract,
+   deliberately not unified.)
+
+2. `FlightRecorder` (r8) — the host half of the device flight ring
+   (`ops/swim.py` ring note): drained `[ring_ticks, N_FLIGHT_LANES]`
+   ring snapshots are stitched into a bounded wall-clock-stamped frame
+   history, served by `GET /v1/flight` (api/http.py), rendered by
+   `scripts/obs_report.py`, and dumped to a black-box incident file on
+   tripwire signal-trips / strict invariant violations.  The process
+   global `FLIGHT` is the one every sim, kernel wrapper and endpoint
+   shares — the flight analog of `runtime.metrics.METRICS`.
 """
 
 from __future__ import annotations
@@ -13,7 +25,20 @@ from __future__ import annotations
 import fcntl
 import json
 import os
-from typing import List, Sequence
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+from corrosion_tpu.runtime.metrics import (
+    CRDT_MERGE_EVENTS,
+    FLIGHT_CENSUS,
+    FLIGHT_LANES,
+    KERNEL_EVENTS,
+    METRICS,
+    Registry,
+)
 
 
 def merge_records(
@@ -53,3 +78,189 @@ def merge_records(
             json.dump(merged, f, indent=2)
         os.replace(tmp, path)
     return merged
+
+
+# ---------------------------------------------------------------------------
+# flight recorder (r8): the host timeline plane over the device ring
+
+
+def frames_from_ring(ring, t: int):
+    """Yield (tick, row) for the live rows of a drained device ring.
+
+    Row j of a [R, L] ring holds the frame of the LARGEST tick < t that
+    is ≡ j (mod R) — i.e. ticks [max(0, t - R), t) are live, older ones
+    were overwritten in place.  Single copy of that arithmetic, shared
+    by the recorder, the scripts and the wrap-around tests."""
+    r = ring.shape[0] if hasattr(ring, "shape") else len(ring)
+    for tick in range(max(0, int(t) - r), int(t)):
+        yield tick, ring[tick % r]
+
+
+def _frame_dict(kernel: str, tick: int, wall: float, row) -> dict:
+    """One JSON-ready frame: event-delta lanes + census lanes by name
+    (FLIGHT_LANES order — the ring's wire format)."""
+    vals = [int(v) for v in row]
+    n_ev = len(KERNEL_EVENTS)
+    return {
+        "kernel": kernel,
+        "tick": tick,
+        "wall": wall,
+        "events": dict(zip(KERNEL_EVENTS, vals[:n_ev])),
+        "census": dict(zip(FLIGHT_CENSUS, vals[n_ev:])),
+    }
+
+
+class FlightRecorder:
+    """Bounded wall-clock-stamped history of per-tick flight frames.
+
+    Sims drain the device ring beside their stats readback and hand the
+    raw snapshot here (`record_ring`); host-side kernels without a scan
+    carry (the CRDT merge wrapper) append per-batch frames directly
+    (`record_host_frame`).  Thread model: mutated from whatever thread
+    steps a simulation while the API event loop serves `window()` —
+    every method takes the instance lock (same rule as the metrics
+    instruments, runtime/metrics.py).
+
+    Frames are stamped with the DRAIN wall clock: within one drained
+    window all frames share a stamp, which is exactly the resolution an
+    OTLP span around the drain has (runtime/trace.py) — the two
+    timelines line up by construction.
+    """
+
+    def __init__(self, capacity: int = 4096):
+        self._frames: deque = deque(maxlen=capacity)
+        self._host_tick: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._incident_seq = 0
+
+    def record_ring(
+        self,
+        kernel: str,
+        drain,
+        since: int = 0,
+        registry: Registry = METRICS,
+    ) -> int:
+        """Stitch the NEW frames of a drained device ring (a
+        `swim.FlightDrain`) into the history; returns how many were
+        appended.  `since` is the CALLER's cursor — the tick up to which
+        it already recorded (each sim owns one; the recorder itself
+        keeps none, so independent sims of the same kernel can share the
+        process-global plane without clobbering each other's stitching).
+        Re-draining without stepping appends nothing; ticks overwritten
+        on device before any drain saw them are counted as
+        `corro.flight.frames.dropped` (the bounded-ring contract, not an
+        error)."""
+        ring, t = drain.ring, int(drain.t)
+        wall = time.time()
+        since = max(0, int(since))
+        if t <= since:
+            return 0
+        r = ring.shape[0] if hasattr(ring, "shape") else len(ring)
+        lo = max(since, t - r)
+        dropped = lo - since
+        added = 0
+        with self._lock:
+            for tick, row in frames_from_ring(ring, t):
+                if tick < lo:
+                    continue
+                self._frames.append(_frame_dict(kernel, tick, wall, row))
+                added += 1
+        if added:
+            registry.counter(
+                "corro.flight.frames.total", kernel=kernel
+            ).inc(added)
+        if dropped:
+            registry.counter(
+                "corro.flight.frames.dropped", kernel=kernel
+            ).inc(dropped)
+        return added
+
+    def record_host_frame(
+        self,
+        kernel: str,
+        events: Dict[str, int],
+        registry: Registry = METRICS,
+    ) -> None:
+        """Append one host-side frame (e.g. a CRDT merge batch: `events`
+        keyed by CRDT_MERGE_EVENTS).  `tick` is a per-kernel batch
+        counter — host kernels have no protocol period."""
+        wall = time.time()
+        with self._lock:
+            tick = self._host_tick.get(kernel, 0)
+            self._host_tick[kernel] = tick + 1
+            self._frames.append(
+                {
+                    "kernel": kernel,
+                    "tick": tick,
+                    "wall": wall,
+                    "events": {k: int(v) for k, v in events.items()},
+                    "census": {},
+                }
+            )
+        registry.counter(
+            "corro.flight.frames.total", kernel=kernel
+        ).inc()
+
+    def window(
+        self, k: int, kernel: Optional[str] = None
+    ) -> List[dict]:
+        """The last `k` frames in record order (optionally one kernel's)."""
+        with self._lock:
+            frames = list(self._frames)
+        if kernel is not None:
+            frames = [f for f in frames if f["kernel"] == kernel]
+        return frames[-max(0, int(k)):]
+
+    def snapshot_incident(
+        self,
+        reason: str,
+        path: Optional[str] = None,
+        registry: Registry = METRICS,
+    ) -> Optional[str]:
+        """Black-box dump: write the whole frame history to a JSON file
+        and return its path (None when there is nothing to dump).
+
+        Callers are abnormal-exit paths (tripwire signal-trips, strict
+        invariant violations — NOT graceful shutdown, which also trips
+        the tripwire but is not an incident), so this must never raise.
+        Files go to $CORRO_FLIGHT_DIR (default: a `corrosion_flight/`
+        dir under the system tempdir) and the sequence wraps at 16 per
+        process — a bounded black box, like the real instrument."""
+        with self._lock:
+            frames = list(self._frames)
+            seq = self._incident_seq
+            self._incident_seq += 1
+        if not frames:
+            return None
+        record = {
+            "reason": reason,
+            "wall": time.time(),
+            "pid": os.getpid(),
+            "lanes": list(FLIGHT_LANES),
+            "crdt_lanes": list(CRDT_MERGE_EVENTS),
+            "frames": frames,
+        }
+        try:
+            d = os.environ.get("CORRO_FLIGHT_DIR") or os.path.join(
+                tempfile.gettempdir(), "corrosion_flight"
+            )
+            os.makedirs(d, exist_ok=True)
+            if path is None:
+                safe = "".join(
+                    c if c.isalnum() or c in "-_" else "_" for c in reason
+                )[:48]
+                path = os.path.join(
+                    d,
+                    f"flight_incident_{os.getpid()}_{seq % 16:02d}_{safe}.json",
+                )
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(record, f)
+            os.replace(tmp, path)
+        except OSError:
+            return None
+        registry.counter("corro.flight.incidents.total").inc()
+        return path
+
+
+FLIGHT = FlightRecorder()

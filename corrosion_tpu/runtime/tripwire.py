@@ -32,10 +32,24 @@ class Tripwire:
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGTERM, signal.SIGINT):
             with contextlib.suppress(NotImplementedError):
-                loop.add_signal_handler(sig, tw.trip)
+                loop.add_signal_handler(
+                    sig, tw.trip, f"signal:{signal.Signals(sig).name}"
+                )
         return tw
 
-    def trip(self) -> None:
+    def trip(self, incident: Optional[str] = None) -> None:
+        """Fire the tripwire.  `incident` names an ABNORMAL trip (a
+        SIGTERM/SIGINT, an operator kill): the flight recorder's frame
+        history is then dumped to a black-box file before the loops
+        start draining — exactly the moment an operator later asks
+        "what was the cluster doing when it died".  Graceful shutdown
+        (agent/run.py `shutdown`) trips with no incident and dumps
+        nothing."""
+        if incident and not self._event.is_set():
+            with contextlib.suppress(Exception):  # best-effort black box
+                from corrosion_tpu.runtime.records import FLIGHT
+
+                FLIGHT.snapshot_incident(incident)
         self._event.set()
 
     @property

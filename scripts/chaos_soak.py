@@ -57,6 +57,63 @@ def _soak_fingerprint() -> dict:
     return out
 
 
+def kernel_flight_phase(seed: int = 7) -> dict:
+    """Kernel-level churn episode with a tick-RESOLVED verdict: boot a
+    small pview cluster, kill 3%, run to full detection, and report the
+    suspicion/down/refute timeline from the device flight ring (r8) —
+    the per-protocol-period shape of the detection, where the agent
+    phases above only bank end-state aggregates."""
+    import numpy as np
+
+    from corrosion_tpu.models.cluster import PViewClusterSim
+    from corrosion_tpu.runtime.records import FLIGHT
+
+    n = 256
+    sim = PViewClusterSim(
+        n, slots=64, seed=seed, seed_mode="fingers",
+        feeds_per_tick=2, feed_entries=16, suspicion_ticks=4,
+    )
+    sim.run_until_converged(max_ticks=400, check_every=25)
+    kill = np.random.default_rng(seed).choice(
+        n, size=max(1, n * 3 // 100), replace=False
+    )
+    base = sim.ticks
+    sim.crash_many(kill)
+    det = None
+    while sim.ticks - base < 200:
+        sim.step(10)
+        cs = sim.stats()  # drains the ring into FLIGHT as it goes
+        if cs["detected"] >= 1.0 and cs["false_positive"] == 0.0:
+            det = sim.ticks - base
+            break
+    timeline = [
+        {
+            "tick": f["tick"] - base,
+            "suspect_raised": f["events"]["suspect_raised"],
+            "down_declared": f["events"]["down_declared"],
+            "refuted": f["events"]["refuted"],
+            "open_timers": f["census"]["census_suspect"],
+        }
+        for f in FLIGHT.window(4096, kernel="pview")
+        if f["tick"] >= base
+        and (
+            f["events"]["suspect_raised"]
+            or f["events"]["down_declared"]
+            or f["events"]["refuted"]
+        )
+    ][-128:]
+    assert det is not None, "kernel flight phase: churn never detected"
+    assert any(r["down_declared"] for r in timeline), (
+        "flight ring shows no down_declared tick for a detected churn"
+    )
+    return {
+        "n": n,
+        "killed": int(len(kill)),
+        "detect_ticks": det,
+        "timeline": timeline,
+    }
+
+
 def main() -> None:
     seeds = [int(s) for s in sys.argv[1:]] or [1337, 4242]
     runs = []
@@ -72,9 +129,15 @@ def main() -> None:
         print(f"seed {seed}: {len(summary['phases'])} phases, "
               f"{summary['wall_s']}s, sometimes={summary['sometimes']}",
               flush=True)
+    t0 = time.monotonic()
+    flight = kernel_flight_phase()
+    flight["wall_s"] = round(time.monotonic() - t0, 1)
+    print(f"kernel flight: detect_ticks={flight['detect_ticks']} "
+          f"({len(flight['timeline'])} active ticks)", flush=True)
     record = {
         "mode": "strict",
         "runs": runs,
+        "kernel_flight": flight,
         "code": _soak_fingerprint(),
         "measured_at": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
     }

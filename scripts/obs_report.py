@@ -45,6 +45,7 @@ from corrosion_tpu.runtime.metrics import (  # noqa: E402
     METRICS,
     kernel_event_totals,
 )
+from corrosion_tpu.runtime.records import FLIGHT  # noqa: E402
 
 
 def _code_sha() -> dict:
@@ -91,6 +92,63 @@ def render_registry_tables(emit, ticks_run: int) -> None:
     emit()
 
 
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values) -> str:
+    """Unicode block sparkline of a numeric sequence (flat → all ▁)."""
+    vals = [float(v) for v in values]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK[0] * len(vals)
+    return "".join(
+        _SPARK[int((v - lo) / span * (len(_SPARK) - 1))] for v in vals
+    )
+
+
+# the per-tick shapes an operator reads first: dissemination pressure,
+# loss/overflow, and the suspicion → down → refute lifecycle (the
+# per-protocol-period view SWIM pathologies are diagnosed by)
+_FLIGHT_EVENT_LANES = (
+    "gossip_emitted", "gossip_lost", "inbox_overflowed", "merge_won",
+    "suspect_raised", "down_declared", "refuted",
+)
+_FLIGHT_CENSUS_LANES = (
+    "census_alive", "census_suspect", "census_down",
+    "inbox_highwater", "inc_max",
+)
+
+
+def render_flight_section(emit, kernel: str = "pview", window: int = 64):
+    """Render the flight recorder's tick-resolved timeline: one
+    sparkline + min/max/last per lane over the last `window` frames —
+    the per-tick trend view the cumulative tables above cannot show."""
+    frames = FLIGHT.window(window, kernel=kernel)
+    emit(f"## flight recorder — last {len(frames)} ticks "
+         f"(kernel={kernel}, corro.flight.*)")
+    if not frames:
+        emit("(no frames drained)")
+        emit()
+        return
+    t0, t1 = frames[0]["tick"], frames[-1]["tick"]
+    emit(f"ticks {t0}..{t1}; per-tick event deltas then census levels")
+    emit(f"{'lane':<20} {'min':>8} {'max':>8} {'last':>8}  trend")
+    for group, lanes in (
+        ("events", _FLIGHT_EVENT_LANES),
+        ("census", _FLIGHT_CENSUS_LANES),
+    ):
+        for lane in lanes:
+            series = [f[group].get(lane, 0) for f in frames]
+            emit(
+                f"{lane:<20} {min(series):>8} {max(series):>8} "
+                f"{series[-1]:>8}  {sparkline(series)}"
+            )
+    emit()
+
+
 def main() -> None:
     n = int(os.environ.get("OBS_REPORT_N", "2048"))
     slots = int(os.environ.get("OBS_REPORT_SLOTS", "256"))
@@ -132,6 +190,7 @@ def main() -> None:
     )
     emit()
     render_registry_tables(emit, sim.ticks)
+    render_flight_section(emit, kernel="pview")
 
     path = os.environ.get(
         "OBS_REPORT_OUT", os.path.join(REPO, "OBS_REPORT.md")

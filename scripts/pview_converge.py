@@ -56,9 +56,50 @@ import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
 from corrosion_tpu.ops import swim_pview  # noqa: E402
-from corrosion_tpu.runtime.records import merge_records  # noqa: E402
+from corrosion_tpu.runtime.metrics import KERNEL_EVENTS  # noqa: E402
+from corrosion_tpu.runtime.records import (  # noqa: E402
+    frames_from_ring,
+    merge_records,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# suspicion-lifecycle lanes of the flight ring (r8): the tick-RESOLVED
+# churn story — which tick suspicions spiked, when the down
+# declarations landed, whether refutes trailed them — where the banked
+# end-state stats can only say "detected eventually"
+_TIMELINE_LANES = ("suspect_raised", "down_declared", "refuted")
+_EV_IDX = {name: i for i, name in enumerate(KERNEL_EVENTS)}
+_CEN_SUSPECT = len(KERNEL_EVENTS) + 1  # census_suspect lane offset
+
+
+def flight_timeline(state, max_rows: int = 128):
+    """Drain the device flight ring into [{tick, suspect_raised,
+    down_declared, refuted, census_suspect}] rows (ACTIVE rows only —
+    ticks where any lifecycle lane fired — capped at `max_rows`)."""
+    import numpy as np2
+
+    ring, t = jax.device_get((state.ring, state.t))
+    ring = np2.asarray(ring)
+    rows = []
+    for tick, row in frames_from_ring(ring, int(t)):
+        vals = {lane: int(row[_EV_IDX[lane]]) for lane in _TIMELINE_LANES}
+        if any(vals.values()):
+            vals["tick"] = tick
+            vals["census_suspect"] = int(row[_CEN_SUSPECT])
+            rows.append(vals)
+    return rows[-max_rows:]
+
+
+def print_timeline(label: str, rows) -> None:
+    print(f"{label}: {len(rows)} active ticks", flush=True)
+    for r in rows:
+        print(
+            f"  tick {r['tick']:>6}: suspect+{r['suspect_raised']} "
+            f"down+{r['down_declared']} refute+{r['refuted']} "
+            f"(open timers {r['census_suspect']})",
+            flush=True,
+        )
 
 
 def main() -> None:
@@ -191,6 +232,7 @@ def main() -> None:
     # ---- phase 2: 1% churn → cluster-wide detection ----------------------
     det_ticks = None
     churn_stats = {}
+    churn_timeline = []
     n_kill = max(1, n // 100)
     skip_churn = os.environ.get("PVIEW_SKIP_CHURN") == "1"
     if skip_churn:
@@ -214,6 +256,12 @@ def main() -> None:
                 det_ticks = extra
                 break
         churn_wall = time.monotonic() - t0
+        # tick-resolved suspicion/refute timeline from the flight ring:
+        # the per-protocol-period shape of the detection, not just its
+        # end state (ring depth bounds how far back it reaches — the
+        # tail of a long churn phase, which holds the detection story)
+        churn_timeline = flight_timeline(state)
+        print_timeline("churn timeline (flight ring)", churn_timeline)
     else:
         churn_wall = 0.0
 
@@ -241,6 +289,7 @@ def main() -> None:
             "detect_all_ticks": det_ticks,
             "wall_s": round(churn_wall, 2),
             "stats": {k: round(v, 6) for k, v in churn_stats.items()},
+            "timeline": churn_timeline,
         },
     }
     if skip_churn:

@@ -1,0 +1,231 @@
+"""Flight recorder (r8): the [ring_ticks, N_FLIGHT_LANES] per-tick ring
+in both SWIM scan carries + the host timeline plane over it.
+
+The ring's contract:
+  1. conservation — the event-delta rows are an exact decomposition of
+     the cumulative lane: over any window that fits the ring,
+     sum(ring event rows) == cumulative-lane delta, BIT-exactly, on
+     both kernels;
+  2. wrap-around — past ring_ticks ticks, row j holds the frame of the
+     newest tick ≡ j (mod ring_ticks): exactly the last ring_ticks
+     frames survive, byte-identical to a deeper ring's tail;
+  3. the census half is a point-in-time level (alive/suspect/down,
+     inbox high-water, max incarnation) that tracks injected churn;
+  4. host stitching (`runtime.records`) is cursor-correct: re-drains
+     append nothing, device-overwritten ticks count as dropped, and
+     incident dumps are valid JSON with every frame.
+
+All device cases use the scanned `tick_n` at tiny shapes — unrolled
+per-tick traces are a compile-time trap on the 1-core CI host.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from corrosion_tpu.ops import swim, swim_pview
+from corrosion_tpu.runtime.metrics import (
+    FLIGHT_CENSUS,
+    FLIGHT_LANES,
+    KERNEL_EVENTS,
+    Registry,
+)
+from corrosion_tpu.runtime.records import (
+    FlightRecorder,
+    frames_from_ring,
+)
+
+N_EV = len(KERNEL_EVENTS)
+CEN = {name: N_EV + i for i, name in enumerate(FLIGHT_CENSUS)}
+
+
+def _run(module, params, state, ticks, seed=7):
+    return module.tick_n(state, jax.random.PRNGKey(seed), params, ticks)
+
+
+# ---------------------------------------------------------------------------
+# conservation: sum(ring deltas) == cumulative delta, bit-exactly
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kernel", ["dense", "pview"])
+def test_ring_conserves_cumulative_lane(kernel):
+    if kernel == "dense":
+        module = swim
+        params = swim.SwimParams(n=48, loss=0.1, ring_ticks=16)
+    else:
+        module = swim_pview
+        params = swim_pview.PViewParams(
+            n=96, slots=32, loss=0.1, feeds_per_tick=2, feed_entries=16,
+            ring_ticks=16,
+        )
+    state = module.init_state(params, jax.random.PRNGKey(0))
+    # window 1: from boot (events start at zero) — whole ring vs totals
+    state = _run(module, params, state, 12)
+    ev_mid = np.asarray(state.events).copy()
+    ring = np.asarray(state.ring)
+    assert np.array_equal(ring[:, :N_EV].sum(axis=0), ev_mid)
+    # window 2: exactly ring_ticks further ticks — the ring now holds
+    # precisely that window's deltas, so its sum IS the cumulative delta
+    state = _run(module, params, state, 16, seed=11)
+    ring = np.asarray(state.ring)
+    delta = np.asarray(state.events) - ev_mid
+    assert np.array_equal(ring[:, :N_EV].sum(axis=0), delta)
+    assert (ring[:, :N_EV] >= 0).all()  # deltas, not totals
+
+
+# ---------------------------------------------------------------------------
+# wrap-around: the last ring_ticks frames survive, bit-identical to a
+# deeper ring's tail
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kernel", ["dense", "pview"])
+def test_ring_wraparound_matches_deep_ring_tail(kernel):
+    def mk(ring_ticks):
+        if kernel == "dense":
+            return swim, swim.SwimParams(n=32, loss=0.05,
+                                         ring_ticks=ring_ticks)
+        return swim_pview, swim_pview.PViewParams(
+            n=64, slots=16, loss=0.05, feeds_per_tick=2, feed_entries=8,
+            ring_ticks=ring_ticks,
+        )
+
+    ticks = 21  # > 2×8: the small ring wraps twice
+    module, p_small = mk(8)
+    _, p_deep = mk(32)
+    s_small = _run(module, p_small, module.init_state(
+        p_small, jax.random.PRNGKey(0)), ticks)
+    s_deep = _run(module, p_deep, module.init_state(
+        p_deep, jax.random.PRNGKey(0)), ticks)
+    # ring depth must not perturb the trajectory (same rng stream)
+    assert np.array_equal(s_small.events, s_deep.events)
+    ring_s = np.asarray(s_small.ring)
+    ring_d = np.asarray(s_deep.ring)
+    # deep ring still holds every tick < 32: row j of the small ring
+    # must equal the deep ring's frame for the newest tick ≡ j (mod 8)
+    for tick, row in frames_from_ring(ring_s, ticks):
+        assert tick >= ticks - 8
+        assert np.array_equal(row, ring_d[tick]), f"tick {tick}"
+    # stitching covers exactly the last 8 ticks, in order
+    stitched = list(frames_from_ring(ring_s, ticks))
+    assert [t for t, _ in stitched] == list(range(ticks - 8, ticks))
+
+
+# ---------------------------------------------------------------------------
+# census lanes track injected churn
+# ---------------------------------------------------------------------------
+
+
+def test_census_lanes_track_churn():
+    params = swim.SwimParams(n=32, suspicion_ticks=3, ring_ticks=64)
+    state = swim.init_state(params, jax.random.PRNGKey(0))
+    state = _run(swim, params, state, 8)
+    ring = np.asarray(state.ring)
+    assert ring[7, CEN["census_alive"]] == 32
+    assert ring[7, CEN["census_down"]] == 0
+    state = swim.set_alive(state, 5, False)
+    state = swim.set_alive(state, 9, False)
+    state = _run(swim, params, state, 20, seed=3)
+    ring = np.asarray(state.ring)
+    last = ring[(int(state.t) - 1) % params.ring_ticks]
+    assert last[CEN["census_alive"]] == 30
+    assert last[CEN["census_down"]] == 2
+    # the cascade is visible tick-resolved: some tick carried open
+    # suspicion timers, and inbox high-water stayed within the cap
+    live = [row for _t, row in frames_from_ring(ring, int(state.t))]
+    assert max(r[CEN["census_suspect"]] for r in live) > 0
+    assert max(r[CEN["inbox_highwater"]] for r in live) <= (
+        params.incoming_slots
+    )
+
+
+# ---------------------------------------------------------------------------
+# host stitching: cursors, drops, window, incident dump
+# ---------------------------------------------------------------------------
+
+
+def _fake_drain(t: int, ring_ticks: int = 8):
+    """Synthetic device drain: row j%R of a [R, L] ring holds frame
+    `tick` encoded as tick in lane 0 and tick+100 in the last census
+    lane — the host stitching layer only sees (ring, t), so these tests
+    need no kernel run (the device half is pinned above)."""
+    ring = np.zeros((ring_ticks, len(FLIGHT_LANES)), dtype=np.int32)
+    for tick in range(max(0, t - ring_ticks), t):
+        ring[tick % ring_ticks, 0] = tick
+        ring[tick % ring_ticks, -1] = tick + 100
+    return swim.FlightDrain(ring=ring, t=t)
+
+
+def test_recorder_stitching_cursor_and_drop_accounting():
+    reg = Registry()
+    rec = FlightRecorder(capacity=256)
+    assert rec.record_ring("dense", _fake_drain(5), since=0,
+                           registry=reg) == 5
+    # re-drain without stepping: nothing new
+    assert rec.record_ring("dense", _fake_drain(5), since=5,
+                           registry=reg) == 0
+    # advance to t=17: 12 new ticks > ring 8 — only the last 8 stitch,
+    # 4 were overwritten on device and count as dropped
+    assert rec.record_ring("dense", _fake_drain(17), since=5,
+                           registry=reg) == 8
+    snap = {
+        (name, tuple(sorted(labels.items()))): v
+        for _k, name, labels, v in reg.snapshot()
+    }
+    assert snap[("corro.flight.frames.total",
+                 (("kernel", "dense"),))] == 13
+    assert snap[("corro.flight.frames.dropped",
+                 (("kernel", "dense"),))] == 4
+    frames = rec.window(100, kernel="dense")
+    assert [f["tick"] for f in frames] == list(range(5)) + list(
+        range(9, 17)
+    )
+    assert all(
+        set(f["events"]) == set(KERNEL_EVENTS)
+        and set(f["census"]) == set(FLIGHT_CENSUS)
+        for f in frames
+    )
+    # frames carry the ring's values, keyed by lane name
+    assert frames[-1]["events"]["gossip_emitted"] == 16
+    assert frames[-1]["census"]["inc_max"] == 116
+    # a second sim of the same kernel restarting at tick 0 still records
+    # (the cursor is the CALLER's, not global per kernel)
+    assert rec.record_ring("dense", _fake_drain(3), since=0,
+                           registry=reg) == 3
+
+
+def test_recorder_host_frames_and_window_filter():
+    reg = Registry()
+    rec = FlightRecorder(capacity=16)
+    rec.record_host_frame("crdt_merge", {"decide_won": 3}, registry=reg)
+    rec.record_host_frame("crdt_merge", {"decide_won": 1}, registry=reg)
+    rec.record_ring("dense", _fake_drain(2), registry=reg)
+    assert [f["tick"] for f in rec.window(10, kernel="crdt_merge")] == [0, 1]
+    assert len(rec.window(10)) == 4
+    assert len(rec.window(1)) == 1
+    # bounded history: the deque caps at capacity
+    for _ in range(40):
+        rec.record_host_frame("crdt_merge", {"decide_won": 1},
+                              registry=reg)
+    assert len(rec.window(10_000)) == 16
+
+
+def test_incident_dump_black_box(tmp_path, monkeypatch):
+    monkeypatch.setenv("CORRO_FLIGHT_DIR", str(tmp_path))
+    reg = Registry()
+    rec = FlightRecorder()
+    assert rec.snapshot_incident("empty", registry=reg) is None  # no frames
+    rec.record_ring("dense", _fake_drain(4), registry=reg)
+    path = rec.snapshot_incident("invariant:test/name", registry=reg)
+    assert path is not None and path.startswith(str(tmp_path))
+    with open(path) as f:
+        dump = json.load(f)
+    assert dump["reason"] == "invariant:test/name"
+    assert dump["lanes"] == list(FLIGHT_LANES)
+    assert len(dump["frames"]) == 4
+    assert dump["frames"][-1]["events"]["gossip_emitted"] == 3
+    snap = {name: v for _k, name, _l, v in reg.snapshot()}
+    assert snap["corro.flight.incidents.total"] == 1

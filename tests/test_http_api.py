@@ -174,6 +174,54 @@ def test_status_endpoint_serves_cluster_plane():
     asyncio.run(main())
 
 
+def test_flight_endpoint_serves_tick_resolved_frames():
+    """GET /v1/flight (r8): the last-K per-tick frames stitched from the
+    device ring — event deltas + census by tick, the tick-RESOLVED
+    sibling of /v1/status's cumulative totals."""
+    import aiohttp
+
+    from corrosion_tpu.models.cluster import PViewClusterSim
+
+    sim = PViewClusterSim(128, slots=32, feeds_per_tick=2, feed_entries=16)
+    sim.step(6)
+    sim.stats()  # drains the ring into the process-global recorder
+
+    async def main():
+        net = MemNetwork(seed=43)
+        a, api, client = await boot_with_api(net, "agent-flight")
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await s.get(
+                    f"http://{api.addrs[0]}/v1/flight",
+                    params={"window": 4, "kernel": "pview"},
+                )
+                assert r.status == 200
+                body = await r.json()
+                assert body["window"] == 4
+                assert body["event_lanes"][0] == "gossip_emitted"
+                assert "census_alive" in body["census_lanes"]
+                frames = body["frames"]
+                # the last 4 of the 6 ticks the sim ran, in tick order
+                assert [f["tick"] for f in frames] == [2, 3, 4, 5]
+                assert all(f["kernel"] == "pview" for f in frames)
+                assert frames[-1]["census"]["census_alive"] == 128
+                assert frames[-1]["events"]["gossip_emitted"] > 0
+                assert frames[-1]["wall"] > 0
+                r = await s.get(
+                    f"http://{api.addrs[0]}/v1/flight",
+                    params={"window": "bogus"},
+                )
+                assert r.status == 400
+        finally:
+            await client.close()
+            await api.stop()
+            from corrosion_tpu.agent.run import shutdown
+
+            await shutdown(a)
+
+    asyncio.run(main())
+
+
 def test_http_write_gossips_to_peer():
     async def main():
         net = MemNetwork(seed=37)

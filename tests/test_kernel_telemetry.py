@@ -178,11 +178,13 @@ def test_identity_hash_pview_events_equal_dense():
 
 
 def test_fused_tick_still_lowers_to_one_scan():
-    """The acceptance pin: the telemetry lane rides the scan carry — the
-    jaxpr of the scanned fused tick contains exactly ONE scan (and no
-    while/cond smuggled in by the lane)."""
+    """The acceptance pin: the telemetry lane AND the r8 flight ring
+    (enabled at its default size here) ride the scan carry — the jaxpr
+    of the scanned fused tick contains exactly ONE scan (and no
+    while/cond smuggled in by the lanes)."""
     params = swim_pview.PViewParams(n=64, slots=16, feeds_per_tick=2,
                                     feed_entries=8)
+    assert params.ring_ticks > 0  # the pin must cover the ring write
     state = swim_pview.init_state(params, jax.random.PRNGKey(0))
     jaxpr = jax.make_jaxpr(
         lambda s, r: swim_pview._tick_n_impl(s, r, params, 4)
@@ -190,6 +192,9 @@ def test_fused_tick_still_lowers_to_one_scan():
     text = str(jaxpr)
     assert text.count("scan[") == 1, "fused tick no longer one scan"
     assert "while[" not in text
+    # and the ring is genuinely written INSIDE that one scan
+    out = swim_pview.tick_n(state, jax.random.PRNGKey(1), params, 4)
+    assert int(jnp.sum(jnp.abs(out.ring))) > 0
 
     # dense kernel: same contract
     dparams = swim.SwimParams(n=64)
@@ -203,14 +208,19 @@ def test_fused_tick_still_lowers_to_one_scan():
 
 
 def test_stats_and_events_single_readback_and_uint32_wrap():
-    """stats_and_events returns the lane beside the stats; a lane that
-    wrapped mod 2^32 on device still yields correct uint32 deltas."""
+    """stats_and_events returns the lane AND the flight ring beside the
+    stats; a lane that wrapped mod 2^32 on device still yields correct
+    uint32 deltas."""
     params = swim.SwimParams(n=32)
     state = swim.init_state(params, jax.random.PRNGKey(0))
     state = swim.tick(state, jax.random.PRNGKey(1), params)
-    stats, ev = swim.stats_and_events(state)
+    stats, ev, fl = swim.stats_and_events(state)
     assert set(stats) == {"coverage", "detected", "false_positive"}
     assert ev.dtype == np.uint32 and ev.shape == (swim.N_EVENTS,)
+    # the ring drains in the same readback (r8): raw rows + the tick
+    assert fl.t == 1
+    assert fl.ring.shape == (params.ring_ticks, swim.N_FLIGHT_LANES)
+    assert np.array_equal(fl.ring[0, : swim.N_EVENTS], np.asarray(ev))
 
     # wrap math: device totals are int32 two's complement; a prev
     # snapshot near the top of the range subtracts wrap-safe

@@ -36,3 +36,11 @@ def test_obs_report_renders_event_counters(tmp_path):
     # the phase-gauge family renders in the same artifact
     assert "corro.kernel.phase.seconds" in text
     assert re.search(r"^pview\s+tick\s+", text, re.M)
+    # r8: the flight-recorder section renders tick-resolved sparklines
+    assert "## flight recorder" in text
+    m = re.search(
+        r"^gossip_emitted\s+\d+\s+\d+\s+\d+\s+([▁▂▃▄▅▆▇█]+)$", text, re.M
+    )
+    assert m, "no gossip_emitted sparkline row"
+    assert re.search(r"^census_alive\s+", text, re.M)
+    assert re.search(r"^suspect_raised\s+", text, re.M)
