@@ -70,9 +70,35 @@ class SwimConfig:
     announce_backoff_start: float = 5.0
     announce_backoff_max: float = 120.0
     announce_steady_period: float = 300.0
+    # ---- Lifeguard (r9, arXiv:1707.00788) --------------------------------
+    # Off by default: the vanilla timings above are what every existing
+    # timing-sensitive test and the batched kernels' default mode pin.
+    lifeguard: bool = False
+    lhm_max: int = 8  # Local Health Multiplier score ceiling: probe
+    # period, ack waits and suspicion windows scale by (1 + score)
+    susp_ceiling: float = 3.0  # a suspect's window OPENS at
+    # susp_ceiling * suspect_timeout(n) and shrinks toward the floor as
+    # independent peers confirm the suspicion
+    susp_k: int = 3  # confirmers needed to shrink to the floor
 
     def suspect_timeout(self, n: int) -> float:
         return self.suspicion_mult * math.log2(n + 2) * self.probe_period
+
+    def suspect_timeout_confirmed(self, n: int, confirmers: int) -> float:
+        """Lifeguard LHA-Suspicion window for a suspect with
+        `confirmers` INDEPENDENT suspectors (the suspecting peers we
+        received the assertion from, ourselves included): starts at the
+        ceiling, decays logarithmically to the plain `suspect_timeout`
+        floor at susp_k confirmers — a lone (possibly sick) accuser
+        leaves the target the whole ceiling to refute, a cluster-wide
+        suspicion fires at the floor."""
+        lo = self.suspect_timeout(n)
+        if not self.lifeguard:
+            return lo
+        hi = lo * self.susp_ceiling
+        k = max(1, self.susp_k)
+        c = min(max(confirmers - 1, 0), k)
+        return max(lo, hi - (hi - lo) * math.log2(c + 1) / math.log2(k + 1))
 
     def max_transmissions(self, n: int) -> int:
         # infection-style: O(log n) sends suffice; foca's new_wan keeps ~10
@@ -105,6 +131,12 @@ class _Member:
     incarnation: int = 0
     state: MemberState = MemberState.ALIVE
     state_since: float = field(default_factory=time.monotonic)
+    # Lifeguard LHA-Suspicion: the distinct peers we received the
+    # current suspicion from (ourselves included when we raised it) —
+    # each independent confirmer shrinks the suspect→down window
+    # (`SwimConfig.suspect_timeout_confirmed`). Reset on every state
+    # transition.
+    suspectors: set = field(default_factory=set)
 
 
 @dataclass
@@ -138,6 +170,12 @@ class Membership:
         self.on_notification = on_notification or (lambda n, a: None)
         self.members: Dict[ActorId, _Member] = {}
         self.downed: Dict[ActorId, float] = {}  # id -> when declared down
+        # Lifeguard LHA-Probe: saturating local-health score in
+        # [0, lhm_max]; +1 per missed ack / failed probe / hearing
+        # ourselves suspected, -1 per acked probe. Timer multiplier is
+        # (1 + score) — a node that is itself sick probes slower and
+        # waits longer instead of falsely accusing healthy peers.
+        self._lhm = 0
         # dissemination queue keyed by subject: one live assertion per
         # actor (a newer assertion replaces the queued one in O(1));
         # insertion order doubles as freshness order for _piggyback
@@ -151,6 +189,33 @@ class Membership:
         self._tasks: List[asyncio.Task] = []
 
     # -- public surface ----------------------------------------------------
+
+    @property
+    def lhm(self) -> int:
+        """Current Local Health Multiplier score (0 = healthy)."""
+        return self._lhm
+
+    @property
+    def lhm_multiplier(self) -> float:
+        """Effective timer multiplier: 1 + score (1.0 with lifeguard
+        off — every wait below multiplies by this unconditionally)."""
+        if not self.config.lifeguard:
+            return 1.0
+        return 1.0 + min(self._lhm, self.config.lhm_max)
+
+    def _lhm_bump(self, why: str) -> None:
+        if not self.config.lifeguard:
+            return
+        if self._lhm < self.config.lhm_max:
+            self._lhm += 1
+        METRICS.gauge("corro.gossip.lhm").set(self._lhm)
+        METRICS.counter("corro.gossip.lhm.bumped", why=why).inc()
+
+    def _lhm_relax(self) -> None:
+        if not self.config.lifeguard or self._lhm == 0:
+            return
+        self._lhm -= 1
+        METRICS.gauge("corro.gossip.lhm").set(self._lhm)
 
     @property
     def cluster_size(self) -> int:
@@ -278,11 +343,30 @@ class Membership:
 
     # -- update application -------------------------------------------------
 
-    def _apply_update(self, u: MemberUpdate) -> bool:
-        """Merge one membership assertion; True if it changed our view."""
+    def _apply_update(
+        self, u: MemberUpdate, via: Optional[ActorId] = None
+    ) -> bool:
+        """Merge one membership assertion; True if it changed our view.
+        `via` names the peer the assertion arrived from — Lifeguard's
+        independent-confirmer signal (SWIM updates carry no origin, so
+        the forwarding peer is the independence proxy)."""
         if u.actor.id == self.identity.id:
             return self._apply_self_update(u)
         cur = self.members.get(u.actor.id)
+        # LHA-Suspicion confirmation: a suspect assertion about an
+        # already-suspect member does NOT supersede (equal precedence)
+        # but a new distinct peer asserting it shrinks the window
+        if (
+            self.config.lifeguard
+            and via is not None
+            and u.state == MemberState.SUSPECT
+            and cur is not None
+            and cur.state == MemberState.SUSPECT
+            and u.incarnation >= cur.incarnation
+            and via not in cur.suspectors
+        ):
+            cur.suspectors.add(via)
+            METRICS.counter("corro.gossip.suspicion.confirmed").inc()
         replaced_old: Optional[_Member] = None
         if cur is not None:
             cur_identity = (cur.actor.ts, cur.actor.bump)
@@ -327,6 +411,12 @@ class Membership:
         cur.incarnation = u.incarnation
         cur.state = u.state
         cur.state_since = time.monotonic()
+        # fresh state transition: the confirmer set restarts (a NEW
+        # suspicion epoch begins with just the asserting peer)
+        cur.suspectors = (
+            {via} if (u.state == MemberState.SUSPECT and via is not None)
+            else set()
+        )
         self._disseminate(u)
         if u.state == MemberState.DOWN:
             del self.members[u.actor.id]
@@ -341,6 +431,9 @@ class Membership:
         if (u.actor.ts, u.actor.bump) < (self.identity.ts, self.identity.bump):
             return False  # about an identity we already renewed past
         if u.state == MemberState.SUSPECT and u.incarnation >= self._incarnation:
+            # hearing ourselves suspected is direct evidence our own
+            # timers/replies are running late (Lifeguard LHA-Probe)
+            self._lhm_bump("self_suspected")
             self._incarnation = u.incarnation + 1
             self._disseminate(
                 MemberUpdate(
@@ -376,7 +469,7 @@ class Membership:
                 MemberUpdate(msg.sender, 0, MemberState.ALIVE)
             )
         for u in msg.updates:
-            self._apply_update(u)
+            self._apply_update(u, via=msg.sender.id)
 
         k, me = msg.kind, self.identity
         if k == MsgKind.PING:
@@ -446,6 +539,7 @@ class Membership:
         del self._pending[probe_no]
         rtt = time.monotonic() - probe.started
         self.transport.observe_rtt(probe.target.addr, rtt)
+        self._lhm_relax()  # a completed probe round: health evidence
         m = self.members.get(from_actor.id)
         if m is not None and m.state == MemberState.SUSPECT:
             # direct evidence of life clears our own suspicion
@@ -480,25 +574,43 @@ class Membership:
     async def _probe_loop(self, tripwire: Tripwire) -> None:
         cfg = self.config
         while not tripwire.tripped:
-            await asyncio.sleep(cfg.probe_period)
+            # LHA-Probe: a sick node (high LHM) probes SLOWER — its own
+            # lateness would otherwise read as everyone else's failure
+            # (multiplier is 1.0 with lifeguard off)
+            await asyncio.sleep(cfg.probe_period * self.lhm_multiplier)
             target = self._next_probe_target()
             if target is None:
                 continue
             self._probe_no += 1
             probe_no = self._probe_no
             self._pending[probe_no] = _Probe(target, time.monotonic())
-            await self._send(
-                target.addr, SwimMessage(MsgKind.PING, probe_no, self.identity)
-            )
+            msg = SwimMessage(MsgKind.PING, probe_no, self.identity)
+            if cfg.lifeguard:
+                # LHA-Refute buddy system: if we hold the target as
+                # SUSPECT, tell it IN the ping — it refutes immediately
+                # instead of waiting for the rumor to gossip its way
+                # around (the ping already flows; zero extra packets)
+                m = self.members.get(target.id)
+                if m is not None and m.state == MemberState.SUSPECT:
+                    msg.updates.append(
+                        MemberUpdate(
+                            m.actor, m.incarnation, MemberState.SUSPECT
+                        )
+                    )
+                    METRICS.counter("corro.gossip.buddy.notified").inc()
+            await self._send(target.addr, msg)
             asyncio.ensure_future(self._probe_escalation(probe_no))
 
     async def _probe_escalation(self, probe_no: int) -> None:
         cfg = self.config
-        await asyncio.sleep(cfg.probe_rtt)
+        # ack windows stretch with our OWN health score: if we are the
+        # slow one, the ack is probably sitting in our queue already
+        await asyncio.sleep(cfg.probe_rtt * self.lhm_multiplier)
         probe = self._pending.get(probe_no)
         if probe is None:
             return  # acked
         probe.indirect_sent = True
+        self._lhm_bump("direct_miss")
         target = probe.target
         helpers = [
             m.actor
@@ -516,14 +628,16 @@ class Membership:
                     target=target,
                 ),
             )
-        await asyncio.sleep(2 * cfg.probe_rtt)
+        await asyncio.sleep(2 * cfg.probe_rtt * self.lhm_multiplier)
         probe = self._pending.pop(probe_no, None)
         if probe is None:
             return  # indirectly acked
+        self._lhm_bump("probe_failed")
         m = self.members.get(target.id)
         if m is not None and m.state == MemberState.ALIVE:
             self._apply_update(
-                MemberUpdate(m.actor, m.incarnation, MemberState.SUSPECT)
+                MemberUpdate(m.actor, m.incarnation, MemberState.SUSPECT),
+                via=self.identity.id,
             )
             METRICS.counter("corro.gossip.member.suspected").inc()
 
@@ -533,12 +647,20 @@ class Membership:
         while not tripwire.tripped:
             await asyncio.sleep(cfg.probe_period)
             now = time.monotonic()
-            timeout = cfg.suspect_timeout(self.cluster_size)
+            n = self.cluster_size
+            # per-suspect Lifeguard window: ceiling shrunk by that
+            # suspect's independent confirmer count, stretched by our
+            # OWN health multiplier (with lifeguard off both collapse
+            # to the vanilla fixed suspect_timeout)
+            mult = self.lhm_multiplier
             expired = [
                 m
                 for m in self.members.values()
                 if m.state == MemberState.SUSPECT
-                and now - m.state_since > timeout
+                and now - m.state_since
+                > cfg.suspect_timeout_confirmed(
+                    n, max(1, len(m.suspectors))
+                ) * mult
             ]
             for m in expired:
                 self._apply_update(

@@ -287,16 +287,29 @@ async def _watchdog(agent: Agent) -> None:
 
 
 async def _announcer(agent: Agent) -> None:
-    """Announce to resolved bootstrap addresses with backoff 5 s → 120 s,
-    then a steady 300 s re-announce (handlers.rs:197-248). Bootstrap
-    entries support `host:port[@dns_server]` (bootstrap.rs:60-156); an
-    empty bootstrap list falls back to up to 5 random persisted members
+    """Announce to resolved bootstrap addresses with FULL-JITTER backoff
+    5 s → 120 s, then a steady 300 s re-announce (handlers.rs:197-248).
+    Full jitter (runtime/backoff.py r9) instead of the old deterministic
+    doubling: after a partition heal every isolated node's announce
+    timer used to fire in the same beat — a synchronized rejoin storm at
+    exactly the moment the survivors are busiest.  Bootstrap entries
+    support `host:port[@dns_server]` (bootstrap.rs:60-156); an empty
+    bootstrap list falls back to up to 5 random persisted members
     (bootstrap.rs:29-50)."""
     from corrosion_tpu.agent.member_store import stored_bootstrap_addrs
     from corrosion_tpu.net.dns import resolve_bootstrap
+    from corrosion_tpu.runtime.backoff import Backoff
 
     cfg = agent.membership.config
-    delay = cfg.announce_backoff_start
+
+    def fresh_backoff():
+        return iter(Backoff(
+            min_interval=cfg.announce_backoff_start,
+            max_interval=cfg.announce_backoff_max,
+            factor=2.0, mode="full", retries=None,
+        ))
+
+    boff = fresh_backoff()
     while not agent.tripwire.tripped:
         if agent.config.gossip.bootstrap:
             addrs = await resolve_bootstrap(agent.config.gossip.bootstrap)
@@ -315,8 +328,13 @@ async def _announcer(agent: Agent) -> None:
                 await agent.membership.announce(addr)
         if len(agent.members) > 0:
             delay = cfg.announce_steady_period
+            # membership regained: the NEXT isolation restarts the
+            # jittered ramp from the bottom instead of resuming capped
+            boff = fresh_backoff()
         else:
-            delay = min(delay * 2, cfg.announce_backoff_max)
+            # floor keeps full jitter from hot-looping announces when
+            # the draw lands near zero
+            delay = max(0.05, next(boff))
         with contextlib.suppress(asyncio.TimeoutError):
             await asyncio.wait_for(agent.tripwire.wait(), delay)
 

@@ -95,6 +95,22 @@ class _H2PayloadWriter:
         pass
 
 
+class _TransportStub:
+    """Transport stand-in for `_ProtocolStub.transport`: aiohttp ≥ 3.9
+    web.Request reads `protocol.transport.get_extra_info("sslcontext"/
+    "peername")` AT CONSTRUCTION (older versions read `ssl_context`/
+    `peername` off the protocol itself and tolerated transport=None —
+    with 3.11 installed, transport=None made every native-h2 dispatch
+    die on `assert transport is not None` before the handler ran: the
+    HTTP-500 /v1/* cascade)."""
+
+    def get_extra_info(self, name, default=None):
+        return default
+
+    def is_closing(self) -> bool:
+        return False
+
+
 class _ProtocolStub:
     """Minimal stand-in for aiohttp's RequestHandler protocol: just what
     web.Request and StreamReader touch on the serving path (a shared
@@ -102,9 +118,9 @@ class _ProtocolStub:
     half the request budget at SELECT-1 sizes)."""
 
     _reading_paused = False
-    transport = None
+    transport = _TransportStub()
     writer = None
-    ssl_context = None  # web.Request reads these two at construction
+    ssl_context = None  # pre-3.9 aiohttp read these two at construction
     peername = None
 
     def is_connected(self) -> bool:

@@ -406,6 +406,13 @@ class ApiServer:
                     labels.get("phase", "?")
                 ] = value
 
+        # r9 Lifeguard census: local health + open-suspicion pressure —
+        # the host-side mirror of the kernels' lhm_max /
+        # suspicion_confirmations flight lanes
+        suspects = [
+            m for m in list(agent.membership.members.values())
+            if m.state == MemberState.SUSPECT
+        ]
         status = {
             "actor_id": str(agent.actor_id),
             "cluster": {
@@ -413,6 +420,15 @@ class ApiServer:
                 "member_states": by_state,
                 "members_tracked": len(agent.members.states),
                 "bookie_actors": len(agent.bookie.items()),
+                "lifeguard": {
+                    "enabled": agent.membership.config.lifeguard,
+                    "lhm": agent.membership.lhm,
+                    "multiplier": agent.membership.lhm_multiplier,
+                    "open_suspects": len(suspects),
+                    "suspicion_confirmations": sum(
+                        len(m.suspectors) for m in suspects
+                    ),
+                },
             },
             "kernel_events": kernel_event_totals(METRICS),
             "kernel_phase_seconds": phase_seconds,

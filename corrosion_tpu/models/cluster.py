@@ -102,6 +102,11 @@ class ClusterSim:
     def restart(self, member: int) -> None:
         self.state = swim.set_alive(self.state, member, True)
 
+    def degrade(self, members, loss: float = 0.0, lag: int = 0) -> None:
+        """Degraded-node fault injection (r9): flaky, not dead — see
+        swim.set_degraded. loss=0, lag=0 restores."""
+        self.state = swim.set_degraded(self.state, members, loss, lag)
+
     def stats(self) -> Dict[str, float]:
         """Convergence stats; the device telemetry lane AND the flight
         ring drain in the SAME readback — deltas go to the shared
@@ -281,6 +286,11 @@ class PViewClusterSim:
     def restart_many(self, members) -> None:
         self.state = swim_pview.set_alive_many(self.state, members, True)
 
+    def degrade(self, members, loss: float = 0.0, lag: int = 0) -> None:
+        """Degraded-node fault injection (r9): flaky, not dead — see
+        swim.set_degraded. loss=0, lag=0 restores."""
+        self.state = swim_pview.set_degraded(self.state, members, loss, lag)
+
     def stats(self) -> Dict[str, float]:
         """Four-term-bar stats; drains + publishes the telemetry lane
         and the flight ring in the same readback (see class docstring)."""
@@ -358,3 +368,144 @@ class PViewClusterSim:
             and vals[4] == 0.0
         )
         return self.ticks if ok else None
+
+
+# ---------------------------------------------------------------------------
+# Lifeguard A/B harness (r9): the degraded-node experiment, shared by the
+# tier-1 regression test (tests/test_lifeguard.py, tiny shapes) and the
+# banked chaos phase (scripts/chaos_soak.py --phase flaky-node).
+# ---------------------------------------------------------------------------
+
+from corrosion_tpu.runtime.metrics import KERNEL_EVENTS  # noqa: E402
+
+_EV = {name: i for i, name in enumerate(KERNEL_EVENTS)}
+
+
+def _mk_sim(kernel: str, n: int, slots: int, seed: int, lifeguard: bool,
+            **overrides):
+    lg = dict(lhm_max=8, susp_ceiling=3, susp_k=3) if lifeguard else {}
+    if kernel == "dense":
+        return ClusterSim(n, seed=seed, **lg, **overrides)
+    if kernel == "pview":
+        return PViewClusterSim(
+            n, slots=slots, seed=seed, seed_mode="fingers", **lg, **overrides
+        )
+    raise ValueError(f"unknown kernel {kernel!r}")
+
+
+def flaky_node_ab(
+    kernel: str = "dense",
+    seed: int = 0,
+    n: int = 96,
+    slots: int = 48,
+    boot_ticks: int = 40,
+    window: int = 240,
+    lag: int = 2,
+    loss: float = 0.0,
+    chunk: int = 20,
+    detect_chunk: int = 5,
+    detect_cap: int = 200,
+    suspicion_ticks: int = 4,
+    drain_flight: bool = False,
+    **overrides,
+) -> dict:
+    """One seeded vanilla-vs-Lifeguard A/B on a batched kernel.
+
+    Scenario: boot `n` members, then (phase A) degrade member 1 —
+    processing lag `lag` ticks and/or outbound loss `loss`, the node is
+    ALIVE throughout — and run `window` ticks counting ground-truth
+    false-positive suspicions/downs from the kernel's `suspect_fp`/
+    `down_fp` event lanes; then (phase B) crash member 2 outright and
+    count ticks until every live observer has it detected.  Both modes
+    replay the SAME seed; the vanilla run uses lhm_max=0 (bit-equal to
+    the pre-Lifeguard kernel, the compat pin), the lifeguard run
+    lhm_max=8.  Returns per-mode FP totals, detection ticks, and the
+    flight-recorder suspicion timeline of the lifeguard run.
+    """
+    out: dict = {"kernel": kernel, "seed": seed, "n": n, "lag": lag,
+                 "loss": loss, "window": window}
+    for mode in ("vanilla", "lifeguard"):
+        mode_wall = time.time()
+        sim = _mk_sim(
+            kernel, n, slots, seed, mode == "lifeguard",
+            suspicion_ticks=suspicion_ticks, **overrides,
+        )
+        done = 0
+        while done < boot_ticks:
+            sim.step(min(chunk, boot_ticks - done))
+            done += chunk
+        # ---- phase A: one flaky member, count false accusations ----
+        sim.degrade([1], loss=loss, lag=lag)
+        ev0 = np.asarray(jax.device_get(sim.state.events)).astype(np.int64)
+        done = 0
+        while done < window:
+            sim.step(min(chunk, window - done))
+            done += chunk
+            if drain_flight:
+                sim.stats()  # drain the device ring into FLIGHT per chunk
+        ev1 = np.asarray(jax.device_get(sim.state.events)).astype(np.int64)
+        delta = ev1 - ev0
+        rec = {
+            "suspect_fp": int(delta[_EV["suspect_fp"]]),
+            "down_fp": int(delta[_EV["down_fp"]]),
+            "suspect_raised": int(delta[_EV["suspect_raised"]]),
+            "refuted": int(delta[_EV["refuted"]]),
+            "confirmations": int(delta[_EV["suspicion_confirmations"]]),
+        }
+        rec["lhm_degraded"] = int(
+            np.asarray(jax.device_get(sim.state.lhm))[1]
+        )
+        # ---- phase B: a REAL crash must still be detected fast ----
+        if kernel == "dense":
+            sim.crash(2)
+        else:
+            sim.crash_many([2])
+        base = sim.ticks
+        det = None
+        while sim.ticks - base < detect_cap:
+            sim.step(detect_chunk)
+            s = sim.stats()  # drains events + flight ring as it goes
+            if s["detected"] >= 1.0:
+                det = sim.ticks - base
+                break
+        rec["detect_ticks"] = det
+        rec["detect_base"] = base
+        if drain_flight:
+            # tick-resolved suspicion timeline of THIS mode's run, from
+            # the flight recorder (frames are wall-stamped at drain, so
+            # the mode boundary separates the two runs' frames even
+            # though their tick counters overlap)
+            frames = [
+                f for f in FLIGHT.window(4096, kernel=kernel)
+                if f["wall"] >= mode_wall
+            ]
+            rec["timeline"] = [
+                {
+                    "tick": f["tick"],
+                    "suspect_raised": f["events"]["suspect_raised"],
+                    "suspect_fp": f["events"]["suspect_fp"],
+                    "down_declared": f["events"]["down_declared"],
+                    "down_fp": f["events"]["down_fp"],
+                    "refuted": f["events"]["refuted"],
+                    "confirmations": f["events"][
+                        "suspicion_confirmations"
+                    ],
+                    "lhm_max": f["census"].get("lhm_max", 0),
+                    "open_timers": f["census"].get("census_suspect", 0),
+                }
+                for f in frames
+                if f["events"]["suspect_raised"]
+                or f["events"]["down_declared"]
+                or f["events"]["refuted"]
+            ][-64:]
+        out[mode] = rec
+    v, lf = out["vanilla"], out["lifeguard"]
+    out["fp_ratio"] = (
+        v["suspect_fp"] / max(1, lf["suspect_fp"])
+        if lf["suspect_fp"] or v["suspect_fp"] else None
+    )
+    out["detect_ratio"] = (
+        lf["detect_ticks"] / v["detect_ticks"]
+        if lf["detect_ticks"] and v["detect_ticks"] else None
+    )
+    return out

@@ -51,11 +51,27 @@ def _spawn_logged(coro, what: str, src: str, dst: str) -> None:
 
 @dataclass
 class LinkFaults:
-    """Per-network fault knobs (applied to every link unless partitioned)."""
+    """Per-network fault knobs (applied to every link unless partitioned),
+    plus r9 per-NODE asymmetric knobs keyed by addr — the degraded-node
+    scenario (one slow/lossy peer among healthy ones) that network-global
+    loss/latency cannot express.  Per-node knobs apply to the node's
+    OUTBOUND traffic: a degraded node's sends are slow/lossy/duplicated
+    while traffic TO it flows normally — the asymmetry Lifeguard
+    (arXiv:1707.00788) exploits.  Loss/duplication hit datagrams only
+    (streams stay reliable, like real UDP vs TCP); node_latency also
+    slows the node's uni/bi stream sends."""
 
     latency: float = 0.0  # one-way delay seconds
     jitter: float = 0.0
     datagram_loss: float = 0.0  # [0,1) — datagrams only; streams are reliable
+    node_latency: Dict[str, float] = field(default_factory=dict)
+    # addr -> extra one-way delay (s) on everything the node sends
+    node_datagram_loss: Dict[str, float] = field(default_factory=dict)
+    # addr -> outbound datagram loss [0,1]; combines with the global
+    # loss as max(global, node) — one effective per-datagram probability
+    node_duplicate: Dict[str, float] = field(default_factory=dict)
+    # addr -> probability an outbound datagram is delivered TWICE
+    # (dup-prone NIC/retry pathology; exercises SWIM idempotency)
 
 
 class _MemBiStream(BiStream):
@@ -69,7 +85,8 @@ class _MemBiStream(BiStream):
     async def send(self, payload: bytes) -> None:
         if self._closed or self.other is None:
             raise TransportError("stream closed")
-        await self._net._delay()
+        # the sending side's own addr is the remote end's peer label
+        await self._net._delay(self.other._peer)
         self.other._inbox.put_nowait(payload)
 
     async def recv(self) -> Optional[bytes]:
@@ -131,6 +148,27 @@ class MemNetwork:
     def bring_up(self, addr: str) -> None:
         self._down.discard(addr)
 
+    def degrade(
+        self,
+        addr: str,
+        latency: float = 0.0,
+        datagram_loss: float = 0.0,
+        duplicate: float = 0.0,
+    ) -> None:
+        """Mark one node flaky WITHOUT taking it down: its outbound
+        traffic gets `latency` extra delay, datagrams drop with
+        `datagram_loss` and duplicate with `duplicate` (see LinkFaults
+        per-node knobs)."""
+        self.faults.node_latency[addr] = latency
+        self.faults.node_datagram_loss[addr] = datagram_loss
+        self.faults.node_duplicate[addr] = duplicate
+
+    def restore(self, addr: str) -> None:
+        """Clear a node's degradation."""
+        self.faults.node_latency.pop(addr, None)
+        self.faults.node_datagram_loss.pop(addr, None)
+        self.faults.node_duplicate.pop(addr, None)
+
     def _reachable(self, src: str, dst: str) -> bool:
         if dst in self._down or src in self._down:
             return False
@@ -138,10 +176,13 @@ class MemNetwork:
             return False
         return dst in self._nodes
 
-    async def _delay(self) -> None:
+    async def _delay(self, src: Optional[str] = None) -> None:
         f = self.faults
-        if f.latency or f.jitter:
-            await asyncio.sleep(f.latency + self._rng.random() * f.jitter)
+        extra = f.node_latency.get(src, 0.0) if src else 0.0
+        if f.latency or f.jitter or extra:
+            await asyncio.sleep(
+                f.latency + extra + self._rng.random() * f.jitter
+            )
         else:
             await asyncio.sleep(0)
 
@@ -181,17 +222,32 @@ class MemTransport(Transport):
         net = self._net
         if not net._reachable(self._src, addr):
             return  # datagrams are fire-and-forget: silent loss
-        if net.faults.datagram_loss and net._rng.random() < net.faults.datagram_loss:
+        # one effective loss probability: global iid floor raised by the
+        # sender's per-node outbound loss (degraded-node asymmetry)
+        loss = max(
+            net.faults.datagram_loss,
+            net.faults.node_datagram_loss.get(self._src, 0.0),
+        )
+        if loss and net._rng.random() < loss:
             return
         node = net._nodes[addr]
+        src = self._src
 
         async def deliver():
-            await net._delay()
-            await node.on_datagram(self._src, data)
+            await net._delay(src)
+            await node.on_datagram(src, data)
 
         # detached delivery like real UDP: the sender never blocks on the
         # receiver's handler (RTT is observed by the SWIM ack path instead)
         _spawn_logged(deliver(), "datagram", self._src, addr)
+        dup = net.faults.node_duplicate.get(self._src, 0.0)
+        if dup and net._rng.random() < dup:
+
+            async def deliver_again():
+                await net._delay(src)
+                await node.on_datagram(src, data)
+
+            _spawn_logged(deliver_again(), "datagram-dup", self._src, addr)
 
     async def send_uni(self, addr: str, payload: bytes) -> None:
         net = self._net
@@ -199,7 +255,7 @@ class MemTransport(Transport):
             raise TransportError(f"unreachable: {addr}")
         node = net._nodes[addr]
         start = time.monotonic()
-        await net._delay()
+        await net._delay(self._src)
         # deliver as an independent task, like a uni-stream read loop
         _spawn_logged(node.on_uni(self._src, payload), "uni", self._src, addr)
         self.observe_rtt(addr, 2 * (time.monotonic() - start))
@@ -212,6 +268,6 @@ class MemTransport(Transport):
         local = _MemBiStream(addr, net)
         remote = _MemBiStream(self._src, net)
         local.other, remote.other = remote, local
-        await net._delay()
+        await net._delay(self._src)
         _spawn_logged(node.on_bi(remote), "bi", self._src, addr)
         return local

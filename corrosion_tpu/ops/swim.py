@@ -87,7 +87,7 @@ N_CENSUS = len(FLIGHT_CENSUS)
 N_FLIGHT_LANES = N_EVENTS + N_CENSUS
 
 
-def _census_frame(n: int, alive, susp_subj, inc, in_subj) -> jax.Array:
+def _census_frame(n: int, alive, susp_subj, inc, in_subj, lhm) -> jax.Array:
     """[N_CENSUS] int32 point-in-time census in FLIGHT_CENSUS order.
     Every term is an [N]-shaped integer reduction over state the tick
     already holds — deliberately NO whole-view/table pass (that would
@@ -100,7 +100,36 @@ def _census_frame(n: int, alive, susp_subj, inc, in_subj) -> jax.Array:
             _bsum(~alive),
             jnp.max(jnp.sum(in_subj < n, axis=1, dtype=jnp.int32)),
             jnp.max(inc),
+            jnp.max(lhm),  # r9: worst Local Health Multiplier score
         ]
+    )
+
+
+def _susp_shrink_table(params) -> jax.Array:
+    """[susp_k + 1] int32 Lifeguard LHA-Suspicion deadline table:
+    entry c = the open-timer duration (in ticks) once c confirming
+    suspect messages have been observed — starts at the ceiling
+    `suspicion_ticks * susp_ceiling`, shrinks logarithmically to the
+    `suspicion_ticks` floor at c = susp_k (Lifeguard's
+    max - (max-min) * log(c+1)/log(k+1) curve, arXiv:1707.00788 §4.2).
+    Static python math: the table compiles in as a constant.  Shared by
+    the dense and partial-view kernels."""
+    import math
+
+    lo = params.suspicion_ticks
+    hi = params.suspicion_ticks * params.susp_ceiling
+    k = max(1, params.susp_k)
+    return jnp.asarray(
+        [
+            max(
+                lo,
+                math.ceil(
+                    hi - (hi - lo) * math.log2(c + 1) / math.log2(k + 1)
+                ),
+            )
+            for c in range(k + 1)
+        ],
+        dtype=jnp.int32,
     )
 
 
@@ -196,6 +225,22 @@ class SwimParams(NamedTuple):
     ring_ticks: int = 128  # flight-recorder depth (per-tick frames kept
     # on device; see the ring note above). 0 disables the ring (the
     # state carries a [0, L] array — a perf A/B lever, not a default).
+    # ---- Lifeguard (r9, arXiv:1707.00788) --------------------------------
+    lhm_max: int = 0  # Local Health Multiplier ceiling; 0 DISABLES all
+    # three Lifeguard mechanisms (the compat default: with lhm off the
+    # tick is bit-equal to the pre-r9 kernel — no extra rng draws, no
+    # protocol-lane writes; only the new state lanes exist, zeroed).
+    # >0 enables: each member's probe timeouts and protocol period
+    # scale by (1 + its saturating health score in [0, lhm_max]).
+    lhm_decay_ticks: int = 8  # a successful probe round decrements the
+    # score only once per this many ticks — the paper's asymmetric
+    # ramp-fast/relax-slow shape, which keeps a persistently sick
+    # member's multiplier pinned high instead of oscillating
+    susp_ceiling: int = 3  # LHA-Suspicion: a fresh suspicion timer's
+    # deadline starts at susp_ceiling * suspicion_ticks and shrinks
+    # toward suspicion_ticks as confirmations arrive
+    susp_k: int = 3  # confirming suspect messages needed to shrink the
+    # deadline all the way to the suspicion_ticks floor (log curve)
 
 
 VIEW_DTYPE = jnp.int16
@@ -282,6 +327,26 @@ class SwimState(NamedTuple):
     ring: jax.Array  # [ring_ticks, N_FLIGHT_LANES] int32 — the flight
     # recorder: per-tick event deltas + census frames (see ring note
     # above). Replicated under sharding like `events` (by name)
+    # ---- Lifeguard lanes (r9) — all per-member, member-sharded -----------
+    lhm: jax.Array  # [N] int32 — Local Health Multiplier score in
+    # [0, lhm_max]: +1 per missed direct ack / failed indirect probe /
+    # hearing oneself suspected; -1 per successful probe round (rate-
+    # limited to one decrement per lhm_decay_ticks). Effective timeout/
+    # period multiplier is 1 + score. All-zero when lhm_max == 0.
+    susp_conf: jax.Array  # [N, S] int32 — confirming suspect messages
+    # observed per OPEN suspicion timer (capped at susp_k); shrinks
+    # that timer's deadline along _susp_shrink_table
+    susp_start: jax.Array  # [N, S] int32 — tick the timer opened
+    deg_loss: jax.Array  # [N] float32 — FAULT INJECTION: the member's
+    # outbound datagram loss (gossip sends + every probe leg it
+    # originates). 0 everywhere = today's iid `params.loss` exactly.
+    deg_lag: jax.Array  # [N] int32 — FAULT INJECTION: the member's own
+    # failure-detector processing lag in ticks (CPU starvation / GC
+    # pause: acks land but are observed late). A probe by member i only
+    # succeeds when its window `timeout * (1 + lhm_i)` covers
+    # `timeout + deg_lag[i]` — the Lifeguard flaky-accuser pathology.
+    # Wire-level slowness of a peer is the host net layer's
+    # `node_latency` knob (net/mem.py), not this lane.
 
 
 def init_state(
@@ -368,6 +433,11 @@ def _init_state_impl(
         ring=jnp.zeros(
             (params.ring_ticks, N_FLIGHT_LANES), dtype=jnp.int32
         ),
+        lhm=jnp.zeros(n, dtype=jnp.int32),
+        susp_conf=jnp.zeros((n, s), dtype=jnp.int32),
+        susp_start=jnp.zeros((n, s), dtype=jnp.int32),
+        deg_loss=jnp.zeros(n, dtype=jnp.float32),
+        deg_lag=jnp.zeros(n, dtype=jnp.int32),
     )
 
 
@@ -626,6 +696,27 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
     susp_subj = state.susp_subj
     susp_inc = state.susp_inc
     susp_deadline = state.susp_deadline
+    susp_conf = state.susp_conf
+    susp_start = state.susp_start
+    lhm = state.lhm
+    deg_loss = state.deg_loss
+    deg_lag = state.deg_lag
+
+    # Lifeguard (r9): one STATIC switch for all three mechanisms.  Off
+    # (lhm_max == 0, the default) every branch below compiles to exactly
+    # the pre-r9 tick — same rng draws, same protocol-lane writes; the
+    # fault-injection lanes stay live in both modes (all-zero lanes
+    # reduce to the exact pre-r9 arithmetic, so the vanilla kernel can
+    # host the degraded-node A/B).
+    lifeguard = params.lhm_max > 0
+    # effective per-member timeout/period multiplier (1 = healthy)
+    mult = 1 + jnp.clip(lhm, 0, params.lhm_max) if lifeguard else 1
+    # timer ceiling at registration: LHA-Suspicion opens at the ceiling
+    # and shrinks with confirmations (phase 5c); vanilla opens at the
+    # fixed window
+    open_ticks = params.suspicion_ticks * (
+        params.susp_ceiling if lifeguard else 1
+    )
 
     # announcements generated this tick, merged into own view + buffer
     # later: suspect / down / refute / periodic self-announce
@@ -660,9 +751,26 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
     susp_subj = susp_subj.at[idx, free_slot].set(jnp.where(fail2, psubj, old_subj))
     susp_inc = susp_inc.at[idx, free_slot].set(jnp.where(fail2, binc, old_inc))
     susp_deadline = susp_deadline.at[idx, free_slot].set(
-        jnp.where(fail2, t + params.suspicion_ticks, old_dl)
+        jnp.where(fail2, t + open_ticks, old_dl)
+    )
+    # fresh timers start with zero confirmations at this tick (the
+    # lanes are maintained in both modes; only the deadline shrink is
+    # lifeguard-gated — phase 5c)
+    old_conf = susp_conf[idx, free_slot]
+    old_start = susp_start[idx, free_slot]
+    susp_conf = susp_conf.at[idx, free_slot].set(
+        jnp.where(fail2, 0, old_conf)
+    )
+    susp_start = susp_start.at[idx, free_slot].set(
+        jnp.where(fail2, t, old_start)
     )
     phase = jnp.where(expire2, 0, phase)
+    if lifeguard:
+        # LHA-Probe period stretch: a completed probe cycle (success or
+        # suspicion) cools down for mult-1 extra ticks before the next
+        # probe starts (phase-0 rows repurpose probe_deadline as the
+        # cooldown; mult == 1 reproduces the vanilla same-tick restart)
+        pdl = jnp.where(expire2, t + mult - 1, pdl)
 
     # 1b. escalate expired direct probes to indirect probes
     expire1 = (phase == 1) & (t >= pdl) & alive
@@ -672,31 +780,62 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
     )
     psafe_t = jnp.clip(psubj, 0, n - 1)
     tgt_alive = alive[psafe_t] & (psubj < n)
-    leg = jax.random.uniform(
-        r_ack, (n, params.indirect_probes + 1)
-    ) >= params.loss  # [:, 0] = direct legs, rest = per-helper path
+    # raw leg draws ([:, 0] = direct round-trip, rest = per-helper
+    # path); the loss threshold is per-pair — every participant's
+    # injected outbound loss raises it (max with the iid base; all-zero
+    # deg_loss reduces to `>= params.loss` bit-exactly)
+    leg_u = jax.random.uniform(r_ack, (n, params.indirect_probes + 1))
+    path_loss = jnp.maximum(
+        params.loss,
+        jnp.maximum(
+            jnp.maximum(deg_loss[:, None], deg_loss[helpers]),
+            deg_loss[psafe_t][:, None],
+        ),
+    )
+    # a probe only succeeds when the prober's window covers the base
+    # RTT plus ITS OWN processing lag (deg_lag: the Lifeguard flaky-
+    # accuser injection; lag 0 is vacuously true)
+    ind_win = params.indirect_timeout * mult
+    ind_window_ok = ind_win >= params.indirect_timeout + deg_lag
     # an indirect path works only if prober→helper AND helper→target
     # are both within-partition
     helper_reach = (part[helpers] == part[:, None]) & (
         part[helpers] == part[psafe_t][:, None]
     )
-    helper_ok = alive[helpers] & leg[:, 1:] & tgt_alive[:, None] & helper_reach
-    ind_ok = jnp.any(helper_ok, axis=1)
+    helper_ok = (
+        alive[helpers] & (leg_u[:, 1:] >= path_loss)
+        & tgt_alive[:, None] & helper_reach
+    )
+    ind_ok = jnp.any(helper_ok, axis=1) & ind_window_ok
     phase = jnp.where(fail1, 2, jnp.where(expire1, 0, phase))
     pok = jnp.where(fail1, ind_ok, pok)
-    pdl = jnp.where(fail1, t + params.indirect_timeout, pdl)
+    pdl = jnp.where(fail1, t + ind_win, pdl)
+    if lifeguard:
+        # completed-successfully rows cool down (see 1a); ~fail1, not
+        # pok — pok was just reassigned to the escalated rows' outcome
+        pdl = jnp.where(expire1 & ~fail1, t + mult - 1, pdl)
 
     # 1c. idle members start a new probe
     start = (phase == 0) & alive
+    if lifeguard:
+        # LHA-Probe: the protocol period stretches with the member's own
+        # health score — phase-0 rows wait out their cooldown deadline
+        start = start & (t >= pdl)
     target = _pick_known_alive(view, idx, r_probe, params, params.probe_candidates)
     will = start & (target < n)
     tsafe = jnp.clip(target, 0, n - 1)
+    d_loss = jnp.maximum(
+        params.loss, jnp.maximum(deg_loss, deg_loss[tsafe])
+    )
+    d_win = params.direct_timeout * mult
     direct_ok = (
-        alive[tsafe] & (target < n) & leg[:, 0] & (part[tsafe] == part)
+        alive[tsafe] & (target < n) & (leg_u[:, 0] >= d_loss)
+        & (part[tsafe] == part)
+        & (d_win >= params.direct_timeout + deg_lag)
     )
     phase = jnp.where(will, 1, phase)
     psubj = jnp.where(will, target, psubj)
-    pdl = jnp.where(will, t + params.direct_timeout, pdl)
+    pdl = jnp.where(will, t + d_win, pdl)
     pok = jnp.where(will, direct_ok, pok)
 
     # ---- 2. suspicion timers ---------------------------------------------
@@ -715,6 +854,7 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
     clear = (jnp.arange(params.susp_slots)[None, :] == fire_col[:, None]) & fire[:, None]
     clear = clear | (sdl_hit & ~still)  # refuted timers just clear
     susp_subj = jnp.where(clear, n, susp_subj)
+    susp_conf = jnp.where(clear, 0, susp_conf)
 
     # ---- 3. gossip send --------------------------------------------------
     m, f = params.piggyback, params.fanout
@@ -787,8 +927,12 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
         & alive[tg_safe][:, :, None]  # receiver must be up
         & (part[tg_safe] == part[:, None])[:, :, None]  # same network
     )
+    # the sender's injected outbound loss stacks on the iid base (max,
+    # not product: one effective per-datagram loss probability); zero
+    # deg_loss lanes reduce to `< params.loss` bit-exactly
     drop = (
-        jax.random.uniform(r_loss, msg_ok.shape) < params.loss
+        jax.random.uniform(r_loss, msg_ok.shape)
+        < jnp.maximum(params.loss, deg_loss)[:, None, None]
     )
     # telemetry: emitted counts messages that would reach an up, same-
     # partition receiver; lost is the loss-injection slice of those
@@ -941,6 +1085,28 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
         key_prec(selfk) >= PREC_SUSPECT, key_inc(selfk), -1
     )
     worst = jnp.maximum(worst_msg, worst_diag)
+    if lifeguard:
+        # LHA-Refute buddy system: a prober that STARTED a probe this
+        # tick while holding a suspect entry about its target tells the
+        # target in the ping payload — the target refutes immediately
+        # instead of waiting for the suspicion to reach it by gossip.
+        # Delivery rides the direct-probe leg draw (the ping must reach
+        # an up, same-partition target); no extra rng is consumed.
+        tkey = view[idx, tsafe]
+        tell = (
+            will & alive & alive[tsafe] & (part[tsafe] == part)
+            & (leg_u[:, 0] >= d_loss)
+            & (key_prec(tkey) == PREC_SUSPECT)
+        )
+        buddy = (
+            jnp.full((n,), -1, dtype=jnp.int32)
+            .at[jnp.where(tell, tsafe, n)]
+            .max(
+                jnp.where(tell, jnp.maximum(key_inc(tkey), 0), -1),
+                mode="drop",
+            )
+        )
+        worst = jnp.maximum(worst, buddy)
     refute = alive & (worst >= 0) & (worst >= inc)
     inc = jnp.where(refute, jnp.minimum(worst + 1, INC_CAP), inc)
     own_upd_subj = own_upd_subj.at[:, 2].set(jnp.where(refute, idx, n))
@@ -957,6 +1123,52 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
             jnp.where(due, make_key(inc, PREC_ALIVE), 0)
         )
         ev_announce = _bsum(due)
+
+    # ---- 5c. Lifeguard bookkeeping (LHA-Suspicion + LHM update) ----------
+    ev_conf = jnp.int32(0)
+    if lifeguard:
+        # confirmations: suspect messages in THIS tick's gossip inbox
+        # about a subject with an open timer, at the timer's believed
+        # incarnation or newer (gossip the tick already delivers — no
+        # extra traffic; message count approximates independent
+        # suspectors, since SWIM suspect updates carry no origin)
+        open_t = susp_subj < n  # [N, S] post-registration, post-clear
+        msg_inc = key_inc(in_key)
+        conf_msg = (
+            (in_subj[:, None, :] == susp_subj[:, :, None])
+            & (key_prec(in_key) == PREC_SUSPECT)[:, None, :]
+            & (msg_inc[:, None, :] >= susp_inc[:, :, None])
+        )  # [N, S, R] — S and R are small (4, ~16)
+        conf_add = jnp.sum(conf_msg, axis=2, dtype=jnp.int32) * open_t
+        ev_conf = jnp.sum(conf_add, dtype=jnp.int32)
+        susp_conf = jnp.minimum(susp_conf + conf_add, params.susp_k)
+        # deadline = start + shrink(confirmations): opens at the
+        # ceiling, collapses toward the suspicion_ticks floor as
+        # independent confirmations accumulate — a lone (possibly
+        # wrong) suspector leaves the target the whole ceiling to
+        # refute, while a cluster-wide true suspicion fires fast
+        shrink = _susp_shrink_table(params)
+        susp_deadline = jnp.where(
+            open_t,
+            susp_start + shrink[jnp.clip(susp_conf, 0, params.susp_k)],
+            susp_deadline,
+        )
+        # LHM saturating counter: ramp on every local-health miss
+        # (missed direct ack, failed indirect probe, hearing oneself
+        # suspected), relax one step per successful probe round at most
+        # once per lhm_decay_ticks (success = expired un-failed, judged
+        # on the masks captured BEFORE pok was reassigned)
+        succ = (expire1 & ~fail1) | (expire2 & ~fail2)
+        dec = succ & (jnp.mod(t, jnp.int32(params.lhm_decay_ticks)) == 0)
+        lhm = jnp.clip(
+            lhm
+            + fail1.astype(jnp.int32)
+            + fail2.astype(jnp.int32)
+            + refute.astype(jnp.int32)
+            - dec.astype(jnp.int32),
+            0,
+            params.lhm_max,
+        )
 
     # ---- 6. row-aligned view update + relay ------------------------------
     all_subj = jnp.concatenate([in_subj, own_upd_subj], axis=1)  # [N, R+3]
@@ -991,6 +1203,12 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
 
     # telemetry lane: exact counts of the masks this tick materialized
     # anyway — no extra gathers, no host sync (drained with the stats)
+    # ground-truth false-positive splits of the suspicion lanes: the
+    # kernel owns `alive`, so "suspected/downed a subject that is in
+    # fact up" is exact — the lane the Lifeguard A/B is judged on
+    ev_suspect_fp = _bsum(fail2 & (psubj < n) & alive[psafe_t])
+    fired_safe = jnp.clip(fired_subj, 0, n - 1)
+    ev_down_fp = _bsum(fire & (fired_subj < n) & alive[fired_safe])
     ev_delta = _event_vector(
         gossip_emitted=ev_emitted,
         gossip_lost=ev_lost,
@@ -1003,6 +1221,9 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
         down_declared=_bsum(fire),
         refuted=_bsum(refute),
         self_announced=ev_announce,
+        suspicion_confirmations=ev_conf,
+        suspect_fp=ev_suspect_fp,
+        down_fp=ev_down_fp,
     )
     events = state.events + ev_delta
 
@@ -1013,7 +1234,10 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
         ring = _ring_write(
             ring, t, params.ring_ticks,
             jnp.concatenate(
-                [ev_delta, _census_frame(n, alive, susp_subj, inc, in_subj)]
+                [
+                    ev_delta,
+                    _census_frame(n, alive, susp_subj, inc, in_subj, lhm),
+                ]
             ),
         )
 
@@ -1035,6 +1259,11 @@ def tick_impl(state: SwimState, rng: jax.Array, params: SwimParams) -> SwimState
         partition=part,
         events=events,
         ring=ring,
+        lhm=lhm,
+        susp_conf=susp_conf,
+        susp_start=susp_start,
+        deg_loss=deg_loss,
+        deg_lag=deg_lag,
     )
 
 
@@ -1087,6 +1316,22 @@ def set_partition(state: SwimState, groups) -> SwimState:
     feeds). Pass zeros to heal."""
     return state._replace(
         partition=jnp.asarray(groups, dtype=jnp.int32)
+    )
+
+
+def set_degraded(state, members, loss: float = 0.0, lag: int = 0):
+    """Degraded-node fault injection (r9): mark `members` as flaky
+    WITHOUT killing them — `loss` is their outbound datagram loss
+    (gossip sends + every probe leg they originate), `lag` their local
+    failure-detector processing lag in ticks (the Lifeguard CPU-
+    starvation pathology: a lagged member's probes miss their window
+    and it falsely accuses healthy peers — unless LHA-Probe stretches
+    its window).  Pass loss=0, lag=0 to restore.  Works on both
+    SwimState and PViewState (same lane names)."""
+    idx = jnp.asarray(members, dtype=jnp.int32)
+    return state._replace(
+        deg_loss=state.deg_loss.at[idx].set(jnp.float32(loss)),
+        deg_lag=state.deg_lag.at[idx].set(jnp.int32(lag)),
     )
 
 

@@ -91,8 +91,11 @@ from corrosion_tpu.ops.swim import (
     _census_frame,
     _event_vector,
     _ring_write,
+    _susp_shrink_table,
     FlightDrain,
     dispatch_inbox,
+    set_degraded,  # noqa: F401 — duck-typed over both state types;
+    # re-exported so drivers can call swim_pview.set_degraded
     finger_offsets,
     key_inc,
     key_known,
@@ -181,6 +184,12 @@ class PViewParams(NamedTuple):
     ring_ticks: int = 128  # flight-recorder depth (see ops/swim.py ring
     # note — per-tick event-delta + census frames in the scan carry;
     # 0 disables)
+    # ---- Lifeguard (r9) — same contract as swim.SwimParams ---------------
+    lhm_max: int = 0  # 0 disables all three mechanisms (compat default:
+    # bit-equal to the pre-r9 tick); >0 = LHM score ceiling
+    lhm_decay_ticks: int = 8
+    susp_ceiling: int = 3
+    susp_k: int = 3
 
 
 def _keycap(n: int) -> int:
@@ -302,6 +311,12 @@ class PViewState(NamedTuple):
     # under sharding, wrap-mod-2^32 totals drained as uint32 deltas)
     ring: jax.Array  # [ring_ticks, N_FLIGHT_LANES] int32 — the flight
     # recorder ring (see swim.py ring note; replicated like `events`)
+    # ---- Lifeguard lanes (r9) — see swim.SwimState for semantics ---------
+    lhm: jax.Array  # [N] int32 — Local Health Multiplier score
+    susp_conf: jax.Array  # [N, S] int32 — per-open-timer confirmations
+    susp_start: jax.Array  # [N, S] int32 — timer registration tick
+    deg_loss: jax.Array  # [N] float32 — injected outbound datagram loss
+    deg_lag: jax.Array  # [N] int32 — injected local processing lag
 
 
 def init_state(
@@ -401,6 +416,11 @@ def _init_impl(
         ring=jnp.zeros(
             (params.ring_ticks, N_FLIGHT_LANES), dtype=jnp.int32
         ),
+        lhm=jnp.zeros(n, dtype=jnp.int32),
+        susp_conf=jnp.zeros((n, s), dtype=jnp.int32),
+        susp_start=jnp.zeros((n, s), dtype=jnp.int32),
+        deg_loss=jnp.zeros(n, dtype=jnp.float32),
+        deg_lag=jnp.zeros(n, dtype=jnp.int32),
     )
 
 
@@ -488,6 +508,20 @@ def tick_impl(
     susp_subj = state.susp_subj
     susp_inc = state.susp_inc.astype(jnp.int32)
     susp_deadline = state.susp_deadline
+    susp_conf = state.susp_conf
+    susp_start = state.susp_start
+    lhm = state.lhm
+    deg_loss = state.deg_loss
+    deg_lag = state.deg_lag
+
+    # Lifeguard (r9): same static switch + semantics as swim.tick_impl
+    # (see the dense kernel's comments; this kernel mirrors it phase for
+    # phase so the identity-hash parity holds with lifeguard on too)
+    lifeguard = params.lhm_max > 0
+    mult = 1 + jnp.clip(lhm, 0, params.lhm_max) if lifeguard else 1
+    open_ticks = params.suspicion_ticks * (
+        params.susp_ceiling if lifeguard else 1
+    )
 
     # suspect / down / refute / periodic self-announce
     own_upd_subj = jnp.full((n, 4), n, dtype=jnp.int32)
@@ -516,39 +550,71 @@ def tick_impl(
     susp_subj = susp_subj.at[idx, free_slot].set(jnp.where(fail2, psubj, old_subj))
     susp_inc = susp_inc.at[idx, free_slot].set(jnp.where(fail2, binc, old_inc))
     susp_deadline = susp_deadline.at[idx, free_slot].set(
-        jnp.where(fail2, t + params.suspicion_ticks, old_dl)
+        jnp.where(fail2, t + open_ticks, old_dl)
+    )
+    old_conf = susp_conf[idx, free_slot]
+    old_start = susp_start[idx, free_slot]
+    susp_conf = susp_conf.at[idx, free_slot].set(
+        jnp.where(fail2, 0, old_conf)
+    )
+    susp_start = susp_start.at[idx, free_slot].set(
+        jnp.where(fail2, t, old_start)
     )
     phase = jnp.where(expire2, 0, phase)
+    if lifeguard:
+        # LHA-Probe period stretch (see swim.tick_impl 1a)
+        pdl = jnp.where(expire2, t + mult - 1, pdl)
 
     expire1 = (phase == 1) & (t >= pdl) & alive
     fail1 = expire1 & ~pok
     helpers = jax.random.randint(r_helpers, (n, params.indirect_probes), 0, n)
     psafe_t = jnp.clip(psubj, 0, n - 1)
     tgt_alive = alive[psafe_t] & (psubj < n)
-    leg = jax.random.uniform(
-        r_ack, (n, params.indirect_probes + 1)
-    ) >= params.loss
+    # raw leg draws + per-pair loss/lag model — see swim.tick_impl 1b
+    leg_u = jax.random.uniform(r_ack, (n, params.indirect_probes + 1))
+    path_loss = jnp.maximum(
+        params.loss,
+        jnp.maximum(
+            jnp.maximum(deg_loss[:, None], deg_loss[helpers]),
+            deg_loss[psafe_t][:, None],
+        ),
+    )
+    ind_win = params.indirect_timeout * mult
+    ind_window_ok = ind_win >= params.indirect_timeout + deg_lag
     helper_reach = (part[helpers] == part[:, None]) & (
         part[helpers] == part[psafe_t][:, None]
     )
-    helper_ok = alive[helpers] & leg[:, 1:] & tgt_alive[:, None] & helper_reach
-    ind_ok = jnp.any(helper_ok, axis=1)
+    helper_ok = (
+        alive[helpers] & (leg_u[:, 1:] >= path_loss)
+        & tgt_alive[:, None] & helper_reach
+    )
+    ind_ok = jnp.any(helper_ok, axis=1) & ind_window_ok
     phase = jnp.where(fail1, 2, jnp.where(expire1, 0, phase))
     pok = jnp.where(fail1, ind_ok, pok)
-    pdl = jnp.where(fail1, t + params.indirect_timeout, pdl)
+    pdl = jnp.where(fail1, t + ind_win, pdl)
+    if lifeguard:
+        pdl = jnp.where(expire1 & ~fail1, t + mult - 1, pdl)
 
     start = (phase == 0) & alive
+    if lifeguard:
+        start = start & (t >= pdl)
     target = _pick_known_alive(
         params, packed, idx, r_probe, params.probe_candidates, t
     )
     will = start & (target < n)
     tsafe = jnp.clip(target, 0, n - 1)
+    d_loss = jnp.maximum(
+        params.loss, jnp.maximum(deg_loss, deg_loss[tsafe])
+    )
+    d_win = params.direct_timeout * mult
     direct_ok = (
-        alive[tsafe] & (target < n) & leg[:, 0] & (part[tsafe] == part)
+        alive[tsafe] & (target < n) & (leg_u[:, 0] >= d_loss)
+        & (part[tsafe] == part)
+        & (d_win >= params.direct_timeout + deg_lag)
     )
     phase = jnp.where(will, 1, phase)
     psubj = jnp.where(will, target, psubj)
-    pdl = jnp.where(will, t + params.direct_timeout, pdl)
+    pdl = jnp.where(will, t + d_win, pdl)
     pok = jnp.where(will, direct_ok, pok)
 
     # ---- 2. suspicion timers ---------------------------------------------
@@ -565,6 +631,7 @@ def tick_impl(
     clear = (jnp.arange(params.susp_slots)[None, :] == fire_col[:, None]) & fire[:, None]
     clear = clear | (sdl_hit & ~still)
     susp_subj = jnp.where(clear, n, susp_subj)
+    susp_conf = jnp.where(clear, 0, susp_conf)
 
     # ---- 3. gossip send --------------------------------------------------
     m, f = params.piggyback, params.fanout
@@ -621,7 +688,10 @@ def tick_impl(
         & alive[tg_safe][:, :, None]
         & (part[tg_safe] == part[:, None])[:, :, None]
     )
-    drop = jax.random.uniform(r_loss, msg_ok.shape) < params.loss
+    drop = (
+        jax.random.uniform(r_loss, msg_ok.shape)
+        < jnp.maximum(params.loss, deg_loss)[:, None, None]
+    )
     # telemetry (see swim.py): emitted = deliverable sends, lost = the
     # loss-injection slice; both from masks already materialized
     ev_emitted = _bsum(msg_ok)
@@ -790,6 +860,26 @@ def tick_impl(
     selfk = _lookup(params, packed, idx, t)
     worst_diag = jnp.where(key_prec(selfk) >= PREC_SUSPECT, key_inc(selfk), -1)
     worst = jnp.maximum(worst_msg, worst_diag)
+    if lifeguard:
+        # LHA-Refute buddy system (see swim.tick_impl phase 5); in
+        # fused mode the suspect-entry lookup reads the tick-start
+        # table like every other reader — one merge staler than r5,
+        # the same staleness class as the refutation diag above
+        tkey = _lookup(params, packed, target, t)
+        tell = (
+            will & alive & alive[tsafe] & (part[tsafe] == part)
+            & (leg_u[:, 0] >= d_loss)
+            & (key_prec(tkey) == PREC_SUSPECT)
+        )
+        buddy = (
+            jnp.full((n,), -1, dtype=jnp.int32)
+            .at[jnp.where(tell, tsafe, n)]
+            .max(
+                jnp.where(tell, jnp.maximum(key_inc(tkey), 0), -1),
+                mode="drop",
+            )
+        )
+        worst = jnp.maximum(worst, buddy)
     refute = alive & (worst >= 0) & (worst >= inc)
     # both bounds bind: the packed-slot word needs key*P < 2^31
     # (inc_cap(n)), and the shared packed buffer merge needs keys < 2^15
@@ -812,6 +902,39 @@ def tick_impl(
         )
         ev_announce = _bsum(due)
 
+    # ---- 5c. Lifeguard bookkeeping (see swim.tick_impl 5c) ---------------
+    # reads only inbox planes + suspicion/FSM lanes — no table cell —
+    # so everything here is barrier-safe in fused mode
+    ev_conf = jnp.int32(0)
+    if lifeguard:
+        open_t = susp_subj < n
+        msg_inc = key_inc(in_key)
+        conf_msg = (
+            (in_subj[:, None, :] == susp_subj[:, :, None])
+            & (key_prec(in_key) == PREC_SUSPECT)[:, None, :]
+            & (msg_inc[:, None, :] >= susp_inc[:, :, None])
+        )
+        conf_add = jnp.sum(conf_msg, axis=2, dtype=jnp.int32) * open_t
+        ev_conf = jnp.sum(conf_add, dtype=jnp.int32)
+        susp_conf = jnp.minimum(susp_conf + conf_add, params.susp_k)
+        shrink = _susp_shrink_table(params)
+        susp_deadline = jnp.where(
+            open_t,
+            susp_start + shrink[jnp.clip(susp_conf, 0, params.susp_k)],
+            susp_deadline,
+        )
+        succ = (expire1 & ~fail1) | (expire2 & ~fail2)
+        dec = succ & (jnp.mod(t, jnp.int32(params.lhm_decay_ticks)) == 0)
+        lhm = jnp.clip(
+            lhm
+            + fail1.astype(jnp.int32)
+            + fail2.astype(jnp.int32)
+            + refute.astype(jnp.int32)
+            - dec.astype(jnp.int32),
+            0,
+            params.lhm_max,
+        )
+
     # telemetry lane + flight frame, merge_won still pending: every term
     # below reads only masks computed against the tick-start table, so
     # the vector is a legitimate barrier operand in fused mode (it pins
@@ -819,6 +942,9 @@ def tick_impl(
     # like the FSM lanes).  The census half is likewise final here —
     # susp_subj/inc settled in phases 1-5, in_subj in phase 4 — and
     # deliberately reads no table cell (swim._census_frame).
+    ev_suspect_fp = _bsum(fail2 & (psubj < n) & alive[psafe_t])
+    fired_safe = jnp.clip(fired_subj, 0, n - 1)
+    ev_down_fp = _bsum(fire & (fired_subj < n) & alive[fired_safe])
     ev_vec = _event_vector(
         gossip_emitted=ev_emitted,
         gossip_lost=ev_lost,
@@ -831,9 +957,12 @@ def tick_impl(
         down_declared=_bsum(fire),
         refuted=_bsum(refute),
         self_announced=ev_announce,
+        suspicion_confirmations=ev_conf,
+        suspect_fp=ev_suspect_fp,
+        down_fp=ev_down_fp,
     )
     frame = jnp.concatenate(
-        [ev_vec, _census_frame(n, alive, susp_subj, inc, in_subj)]
+        [ev_vec, _census_frame(n, alive, susp_subj, inc, in_subj, lhm)]
     )
 
     # ---- 6. row-aligned slot update + relay ------------------------------
@@ -867,11 +996,11 @@ def tick_impl(
             feed_cols = jnp.zeros((n, 0), dtype=jnp.int32)
         (packed, feed_vals, feed_cols, new_packed, cols, prev, improved,
          phase, psubj, pdl, pok, susp_subj, susp_inc, susp_deadline, inc,
-         frame,
+         frame, lhm, susp_conf, susp_start,
          ) = jax.lax.optimization_barrier(
             (packed, feed_vals, feed_cols, new_packed, cols, prev, improved,
              phase, psubj, pdl, pok, susp_subj, susp_inc, susp_deadline, inc,
-             frame)
+             frame, lhm, susp_conf, susp_start)
         )
         # two in-place scatters, not one concatenated [N, W_total] plane:
         # the updates are all precomputed above, so ordering stays
@@ -959,6 +1088,11 @@ def tick_impl(
         partition=part,
         events=events,
         ring=ring,
+        lhm=lhm,
+        susp_conf=susp_conf,
+        susp_start=susp_start,
+        deg_loss=deg_loss,
+        deg_lag=deg_lag,
     )
 
 

@@ -134,7 +134,10 @@ def _state_shardings(state, mesh: Mesh):
             # events vector and the [ring_ticks, N_FLIGHT_LANES] flight
             # ring are not per-member arrays (their leading axes are
             # table sizes, not member counts), and their integer
-            # sums/maxes all-reduce bit-identically
+            # sums/maxes all-reduce bit-identically.  The r9 Lifeguard
+            # lanes (lhm, susp_conf/susp_start, deg_loss/deg_lag) are
+            # ordinary per-member arrays and take the member sharding
+            # below — only these two stay replicated by name.
             out[name] = NamedSharding(mesh, P())
         else:
             out[name] = _sharding_for(mesh, arr.ndim)

@@ -228,6 +228,18 @@ KERNEL_EVENTS = (
     "down_declared",      # suspicion timers fired un-refuted
     "refuted",            # members that refuted by bumping incarnation
     "self_announced",     # periodic self-announces entering gossip
+    # r9 Lifeguard lanes (appended — lane order is a wire format):
+    "suspicion_confirmations",  # independent confirming suspect messages
+    #                       applied to OPEN suspicion timers (LHA-S:
+    #                       each confirmation shrinks that timer's
+    #                       deadline toward the floor; 0 with lhm off)
+    "suspect_fp",         # of suspect_raised, subjects that are ground-
+    #                       truth ALIVE — the false-accusation rate the
+    #                       Lifeguard A/B is judged on (the kernel owns
+    #                       ground truth, so the lane is exact, not an
+    #                       estimate)
+    "down_fp",            # of down_declared, subjects ground-truth
+    #                       ALIVE — wrongful evictions
 )
 
 # Flight-recorder census lanes (r8): the per-tick snapshot half of the
@@ -247,6 +259,10 @@ FLIGHT_CENSUS = (
     #                       not) — churn injections appear as steps
     "inbox_highwater",    # max per-member valid inbox entries this tick
     "inc_max",            # max incarnation — refute storms ramp it
+    "lhm_max",            # r9: max Local Health Multiplier score across
+    #                       members (Lifeguard LHA-Probe; 0 = every
+    #                       member healthy or lifeguard disabled) — a
+    #                       degraded node shows up as a sustained step
 )
 
 # One ring row = event deltas then census, in this order.  Reordering

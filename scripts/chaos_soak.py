@@ -114,8 +114,82 @@ def kernel_flight_phase(seed: int = 7) -> dict:
     }
 
 
+def flaky_node_phase(seeds=(3, 11)) -> dict:
+    """r9 Lifeguard A/B: one degraded member (processing lag — the
+    flaky-accuser pathology), vanilla vs lifeguard at the SAME seeds,
+    asserting the acceptance inequalities before banking:
+    >= 5x fewer ground-truth false-positive suspicions of healthy
+    members, wrongful downs likewise, and a truly-crashed member still
+    detected within 2x the vanilla tick count.  Tick-resolved suspicion
+    timelines ride along from the flight recorder (r8)."""
+    from corrosion_tpu.models.cluster import flaky_node_ab
+
+    runs = []
+    for seed in seeds:
+        r = flaky_node_ab(
+            kernel="dense", seed=seed, n=96, boot_ticks=40, window=240,
+            lag=2, chunk=20, detect_chunk=5, drain_flight=True,
+        )
+        v, lf = r["vanilla"], r["lifeguard"]
+        assert v["suspect_fp"] >= 5 * max(1, lf["suspect_fp"]), (
+            f"seed {seed}: FP suspicions did not collapse 5x: {r}"
+        )
+        assert v["down_fp"] >= 5 * max(1, lf["down_fp"]), (
+            f"seed {seed}: wrongful downs did not collapse 5x: {r}"
+        )
+        assert v["detect_ticks"] is not None and lf["detect_ticks"], (
+            f"seed {seed}: crash never detected: {r}"
+        )
+        assert lf["detect_ticks"] <= 2 * v["detect_ticks"], (
+            f"seed {seed}: lifeguard detection too slow: {r}"
+        )
+        assert lf["timeline"], f"seed {seed}: no flight timeline: {r}"
+        runs.append(r)
+        print(
+            f"flaky-node seed {seed}: suspect_fp {v['suspect_fp']} -> "
+            f"{lf['suspect_fp']}, down_fp {v['down_fp']} -> "
+            f"{lf['down_fp']}, detect {v['detect_ticks']} -> "
+            f"{lf['detect_ticks']}", flush=True,
+        )
+    return {"scenario": "one member lag=2 ticks, alive throughout",
+            "runs": runs}
+
+
+def _bank(update: dict) -> None:
+    """Merge keys into CHAOS_SOAK.json, preserving phases not re-run."""
+    path = os.path.join(REPO, "CHAOS_SOAK.json")
+    try:
+        with open(path) as f:
+            record = json.load(f)
+    except (OSError, ValueError):
+        record = {}
+    record.update(update)
+    record["code"] = _soak_fingerprint()
+    record["measured_at"] = time.strftime(
+        "%Y-%m-%d %H:%M:%S", time.gmtime()
+    )
+    with open(path, "w") as f:
+        json.dump(record, f, indent=1)
+
+
 def main() -> None:
-    seeds = [int(s) for s in sys.argv[1:]] or [1337, 4242]
+    args = sys.argv[1:]
+    phase_only = None
+    if "--phase" in args:
+        i = args.index("--phase")
+        phase_only = args[i + 1]
+        args = args[:i] + args[i + 2:]
+    if phase_only == "flaky-node":
+        t0 = time.monotonic()
+        fl = flaky_node_phase()
+        fl["wall_s"] = round(time.monotonic() - t0, 1)
+        _bank({"flaky_node": fl})
+        print(json.dumps({"metric": "chaos_soak", "phase": "flaky-node",
+                          "runs": len(fl["runs"])}))
+        return
+    if phase_only is not None:
+        raise SystemExit(f"unknown --phase {phase_only!r}")
+    seeds = [int(s) for s in args] or [1337, 4242]
     runs = []
     for seed in seeds:
         t0 = time.monotonic()
@@ -134,15 +208,15 @@ def main() -> None:
     flight["wall_s"] = round(time.monotonic() - t0, 1)
     print(f"kernel flight: detect_ticks={flight['detect_ticks']} "
           f"({len(flight['timeline'])} active ticks)", flush=True)
-    record = {
+    t0 = time.monotonic()
+    flaky = flaky_node_phase()
+    flaky["wall_s"] = round(time.monotonic() - t0, 1)
+    _bank({
         "mode": "strict",
         "runs": runs,
         "kernel_flight": flight,
-        "code": _soak_fingerprint(),
-        "measured_at": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
-    }
-    with open(os.path.join(REPO, "CHAOS_SOAK.json"), "w") as f:
-        json.dump(record, f, indent=1)
+        "flaky_node": flaky,
+    })
     print(json.dumps({"metric": "chaos_soak", "runs": len(runs),
                       "all_phases": all(len(r["phases"]) == 5 for r in runs)}))
 

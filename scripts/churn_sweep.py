@@ -113,6 +113,12 @@ def main() -> None:
         ("pb16-mt20", {"piggyback": 16, "max_transmissions": 20}),
         ("ae8-pb16-mt20", {"antientropy": 8, "piggyback": 16,
                            "max_transmissions": 20}),
+        # r9: Lifeguard on — measures what the LHA-Suspicion ceiling
+        # costs in detect-all ticks when the churned members are REALLY
+        # dead (confirmations should shrink the window back toward the
+        # floor; a large gap vs baseline means susp_k/susp_ceiling need
+        # retuning at this scale)
+        ("lifeguard", {"lhm_max": 8}),
     ]
     out = []
     for label, ov in configs:

@@ -172,6 +172,35 @@ async def run_soak(seed: int) -> dict:
     return summary
 
 
+def test_flaky_node_ab_banked_record_holds_acceptance():
+    """Tier-1 replay guard on the banked flaky-node A/B (r9): the
+    record in CHAOS_SOAK.json must keep satisfying the acceptance
+    inequalities — >= 2 seeds, >= 5x collapse of ground-truth
+    false-positive suspicions AND wrongful downs, real-crash detection
+    within 2x vanilla, with a non-empty flight-recorder timeline.  The
+    live directional replay runs in tests/test_lifeguard.py (tiny
+    shapes, both kernels); this pins the banked artifact against drift
+    (`scripts/chaos_soak.py --phase flaky-node` re-banks it)."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", "CHAOS_SOAK.json")
+    with open(path) as f:
+        record = json.load(f)
+    fl = record["flaky_node"]
+    runs = fl["runs"]
+    assert len(runs) >= 2, "flaky-node A/B needs >= 2 seeds"
+    assert len({r["seed"] for r in runs}) == len(runs)
+    for r in runs:
+        v, lf = r["vanilla"], r["lifeguard"]
+        assert v["suspect_fp"] >= 5 * max(1, lf["suspect_fp"]), r
+        assert v["down_fp"] >= 5 * max(1, lf["down_fp"]), r
+        assert v["detect_ticks"] and lf["detect_ticks"], r
+        assert lf["detect_ticks"] <= 2 * v["detect_ticks"], r
+        assert lf["timeline"], "missing flight timeline"
+        assert lf["lhm_degraded"] >= 1, "LHA-Probe never engaged"
+
+
 def test_chaos_soak_strict_invariants(monkeypatch):
     monkeypatch.setenv("CORRO_INVARIANTS", "strict")
     # outer bound must exceed the inner wait_progress livelock cap

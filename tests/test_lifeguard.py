@@ -1,0 +1,383 @@
+"""Lifeguard (r9, arXiv:1707.00788): local-health-aware failure detection
+in both SWIM kernels and the host Membership, plus the degraded-node
+fault surface that proves it.
+
+Pins, in order:
+  1. COMPAT — with lhm_max=0 (the default) the Lifeguard knobs are
+     INERT: tuning them changes nothing, bit for bit, in either kernel
+     (the off mode is the pre-r9 kernel; the PR's golden check also
+     diffed it against actual pre-r9 main).
+  2. FREE WHEN HEALTHY — lifeguard ON under zero faults produces the
+     same trajectory as OFF in every lane except the repurposed
+     probe-cooldown deadline.
+  3. PARITY — the identity-hash pview tick equals the dense tick with
+     lifeguard ON and a degraded member injected (the strongest
+     cross-kernel pin now covers the new paths).
+  4. A/B — the headline: one flaky member (processing lag) poisons the
+     vanilla cluster with false-positive suspicions; lifeguard-on
+     collapses them >= 5x while a real crash is still detected within
+     2x the vanilla tick count.  Both kernels, seeded.
+  5. HOST — Membership LHM ramp/relax, confirmer-set suspicion windows,
+     and the buddy refutation path over MemNetwork; per-node fault
+     knobs in net/mem.py.
+"""
+
+import asyncio
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corrosion_tpu.agent.membership import (
+    MemberState,
+    MemberUpdate,
+    Membership,
+    SwimConfig,
+)
+from corrosion_tpu.net.mem import LinkFaults, MemNetwork
+from corrosion_tpu.ops import swim, swim_pview
+from corrosion_tpu.runtime.metrics import KERNEL_EVENTS
+
+from tests.test_membership import FAST, mk_node, wait_until
+
+EV = {name: i for i, name in enumerate(KERNEL_EVENTS)}
+
+LG_FAST = SwimConfig(
+    probe_period=0.05, probe_rtt=0.02, suspicion_mult=1.0,
+    lifeguard=True, lhm_max=8, susp_ceiling=3.0, susp_k=3,
+)
+
+
+# ---------------------------------------------------------------------------
+# 1. compat: lhm off => lifeguard knobs are inert (both kernels)
+# ---------------------------------------------------------------------------
+
+
+def test_lifeguard_knobs_inert_when_disabled_dense():
+    base = swim.SwimParams(n=48, loss=0.1)
+    tuned = swim.SwimParams(
+        n=48, loss=0.1, lhm_decay_ticks=3, susp_ceiling=7, susp_k=9
+    )
+    assert base.lhm_max == 0  # the compat default
+    s0 = swim.init_state(base, jax.random.PRNGKey(0))
+    s1 = swim.init_state(tuned, jax.random.PRNGKey(0))
+    s0 = swim.tick_n(s0, jax.random.PRNGKey(1), base, 8)
+    s1 = swim.tick_n(s1, jax.random.PRNGKey(1), tuned, 8)
+    for name, a in s0._asdict().items():
+        assert jnp.array_equal(a, getattr(s1, name)), f"field {name}"
+
+
+def test_lifeguard_knobs_inert_when_disabled_pview():
+    mk = lambda **kw: swim_pview.PViewParams(  # noqa: E731
+        n=64, slots=32, loss=0.1, feeds_per_tick=2, feed_entries=16, **kw
+    )
+    base, tuned = mk(), mk(lhm_decay_ticks=3, susp_ceiling=7, susp_k=9)
+    s0 = swim_pview.init_state(base, jax.random.PRNGKey(0))
+    s1 = swim_pview.init_state(tuned, jax.random.PRNGKey(0))
+    s0 = swim_pview.tick_n(s0, jax.random.PRNGKey(1), base, 8)
+    s1 = swim_pview.tick_n(s1, jax.random.PRNGKey(1), tuned, 8)
+    for name, a in s0._asdict().items():
+        assert jnp.array_equal(a, getattr(s1, name)), f"field {name}"
+
+
+def test_lifeguard_free_when_healthy_dense():
+    """Lifeguard ON with zero faults: every lane bit-equal to OFF
+    except probe_deadline (repurposed as the always-zero cooldown)."""
+    off = swim.SwimParams(n=48)
+    on = swim.SwimParams(n=48, lhm_max=8)
+    s_off = swim.init_state(off, jax.random.PRNGKey(0))
+    s_on = swim.init_state(on, jax.random.PRNGKey(0))
+    s_off = swim.tick_n(s_off, jax.random.PRNGKey(1), off, 10)
+    s_on = swim.tick_n(s_on, jax.random.PRNGKey(1), on, 10)
+    differing = {
+        name
+        for name, a in s_off._asdict().items()
+        if not jnp.array_equal(a, getattr(s_on, name))
+    }
+    assert differing <= {"probe_deadline"}, differing
+    assert int(jnp.max(s_on.lhm)) == 0  # nobody got sick
+
+
+# ---------------------------------------------------------------------------
+# 3. identity-hash parity with lifeguard ON + degradation
+# ---------------------------------------------------------------------------
+
+
+def test_identity_hash_parity_with_lifeguard_and_degradation():
+    """The dense-equivalence configuration stays BIT-equal with every
+    Lifeguard mechanism active and a degraded member injected — the
+    r5 parity contract extended over the new paths (events included)."""
+    n = 48
+    dp = swim.SwimParams(
+        n=n, feeds_per_tick=2, feed_entries=16, announce_period=8,
+        antientropy=2, gossip_mode="pick", loss=0.1, lhm_max=8,
+        suspicion_ticks=4,
+    )
+    pp = swim_pview.PViewParams(
+        n=n, slots=n, identity_hash=True, feeds_per_tick=2,
+        feed_entries=16, announce_period=8, antientropy=2,
+        tick_mode="r5", gossip_mode="pick", loss=0.1, lhm_max=8,
+        suspicion_ticks=4,
+    )
+    ds = swim.init_state(dp, jax.random.PRNGKey(0))
+    ps = swim_pview.init_state(pp, jax.random.PRNGKey(0))
+    ds = swim.set_degraded(ds, [5], loss=0.4, lag=1)
+    ps = swim_pview.set_degraded(ps, [5], loss=0.4, lag=1)
+    for i in range(12):
+        key = jax.random.fold_in(jax.random.PRNGKey(7), i)
+        if i == 5:
+            ds = swim.set_alive(ds, 9, False)
+            ps = swim_pview.set_alive(ps, 9, False)
+        ds = swim.tick(ds, key, dp)
+        ps = swim_pview.tick(ps, key, pp)
+        assert jnp.array_equal(ds.events, ps.events), (
+            i,
+            dict(zip(KERNEL_EVENTS, np.asarray(ds.events))),
+            dict(zip(KERNEL_EVENTS, np.asarray(ps.events))),
+        )
+        for f in ("lhm", "susp_conf", "susp_start", "probe_deadline",
+                  "inc", "susp_subj", "susp_deadline"):
+            assert jnp.array_equal(getattr(ds, f), getattr(ps, f)), (i, f)
+
+
+# ---------------------------------------------------------------------------
+# 4. the A/B: flaky member poisons vanilla, lifeguard collapses it
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kernel,n,kw", [
+    ("dense", 64, {}),
+    ("pview", 64, {"slots": 32, "feeds_per_tick": 2, "feed_entries": 16}),
+])
+def test_flaky_node_ab_false_positives_collapse(kernel, n, kw):
+    """Seeded vanilla-vs-lifeguard regression on the scanned tick_n:
+    >= 5x fewer ground-truth false-positive suspicions under one
+    degraded (lagged) member, real-crash detection within 2x."""
+    from corrosion_tpu.models.cluster import flaky_node_ab
+
+    r = flaky_node_ab(
+        kernel=kernel, seed=3, n=n, boot_ticks=20, window=120, lag=2,
+        chunk=20, detect_chunk=5, **kw,
+    )
+    v, lf = r["vanilla"], r["lifeguard"]
+    # the pathology must actually manifest in vanilla mode...
+    assert v["suspect_fp"] >= 15, r
+    # ...and collapse >= 5x under lifeguard
+    assert v["suspect_fp"] >= 5 * max(1, lf["suspect_fp"]), r
+    # wrongful downs collapse too
+    assert v["down_fp"] >= 5 * max(1, lf["down_fp"]), r
+    # the degraded member's own health score rose (LHA-Probe engaged)
+    assert lf["lhm_degraded"] >= 1, r
+    # a truly-crashed member is still detected, within 2x vanilla
+    assert v["detect_ticks"] is not None and lf["detect_ticks"] is not None, r
+    assert lf["detect_ticks"] <= 2 * v["detect_ticks"], r
+
+
+# ---------------------------------------------------------------------------
+# 5a. host Membership: LHM ramp/relax + suspicion windows
+# ---------------------------------------------------------------------------
+
+
+def _actor(i):
+    from corrosion_tpu.types.actor import Actor, ActorId
+    from corrosion_tpu.types.base import Timestamp
+
+    return Actor(
+        id=ActorId(bytes([i]) * 16), addr=f"node{i}",
+        ts=Timestamp.from_unix(i),
+    )
+
+
+def test_host_lhm_ramps_on_self_suspicion_and_relaxes_on_ack():
+    net = MemNetwork()
+    ms = Membership(_actor(1), net.transport("node1"), LG_FAST,
+                    rng=random.Random(1))
+    assert ms.lhm_multiplier == 1.0
+    # hearing ourselves suspected bumps LHM and refutes
+    ms._apply_self_update(
+        MemberUpdate(ms.identity, 0, MemberState.SUSPECT)
+    )
+    assert ms.lhm == 1 and ms.lhm_multiplier == 2.0
+    assert ms._incarnation == 1  # refutation incarnation bump
+    # a successful probe round relaxes it
+    ms._lhm_relax()
+    assert ms.lhm == 0 and ms.lhm_multiplier == 1.0
+    # saturates at lhm_max
+    for _ in range(LG_FAST.lhm_max + 5):
+        ms._lhm_bump("test")
+    assert ms.lhm == LG_FAST.lhm_max
+
+
+def test_host_lhm_inert_with_lifeguard_off():
+    net = MemNetwork()
+    ms = Membership(_actor(1), net.transport("node1"), FAST,
+                    rng=random.Random(1))
+    ms._lhm_bump("test")
+    assert ms.lhm == 0 and ms.lhm_multiplier == 1.0
+
+
+def test_suspect_timeout_confirmed_curve():
+    cfg = LG_FAST
+    n = 16
+    lo = cfg.suspect_timeout(n)
+    hi = lo * cfg.susp_ceiling
+    # one lone suspector: the full ceiling to refute
+    assert cfg.suspect_timeout_confirmed(n, 1) == pytest.approx(hi)
+    # monotone non-increasing in confirmers, floor at susp_k+1 total
+    vals = [cfg.suspect_timeout_confirmed(n, c) for c in range(1, 7)]
+    assert all(a >= b - 1e-9 for a, b in zip(vals, vals[1:]))
+    assert vals[cfg.susp_k] == pytest.approx(lo)
+    # lifeguard off: flat at the vanilla window
+    off = SwimConfig(probe_period=cfg.probe_period,
+                     suspicion_mult=cfg.suspicion_mult)
+    assert off.suspect_timeout_confirmed(n, 1) == pytest.approx(
+        off.suspect_timeout(n)
+    )
+
+
+def test_confirmer_set_grows_per_distinct_peer_only():
+    net = MemNetwork()
+    ms = Membership(_actor(1), net.transport("node1"), LG_FAST,
+                    rng=random.Random(1))
+    b, p1, p2 = _actor(2), _actor(3), _actor(4)
+    ms._apply_update(MemberUpdate(b, 0, MemberState.ALIVE))
+    ms._apply_update(MemberUpdate(b, 0, MemberState.SUSPECT), via=p1.id)
+    m = ms.members[b.id]
+    assert m.suspectors == {p1.id}
+    # same peer re-asserting: no new independence
+    ms._apply_update(MemberUpdate(b, 0, MemberState.SUSPECT), via=p1.id)
+    assert m.suspectors == {p1.id}
+    # a second peer confirms (equal precedence would NOT supersede —
+    # the confirmer path must fire anyway)
+    ms._apply_update(MemberUpdate(b, 0, MemberState.SUSPECT), via=p2.id)
+    assert m.suspectors == {p1.id, p2.id}
+    # refutation resets the epoch
+    ms._apply_update(MemberUpdate(b, 1, MemberState.ALIVE), via=p1.id)
+    assert ms.members[b.id].suspectors == set()
+
+
+# ---------------------------------------------------------------------------
+# 5b. buddy refutation end-to-end over MemNetwork
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_buddy_ping_prompts_immediate_refutation():
+    """A (holding B as SUSPECT) pings B: the suspect update rides the
+    ping itself, B refutes by incarnation bump without ever receiving
+    the rumor from gossip."""
+    from corrosion_tpu.runtime.tripwire import Tripwire
+
+    net = MemNetwork(seed=3)
+    a = mk_node(net, 1, cfg=LG_FAST)
+    b = mk_node(net, 2, cfg=LG_FAST)
+    trip = Tripwire()
+    a.start(trip)
+    b.start(trip)
+    try:
+        # A knows B and holds it SUSPECT at inc 0; B has no idea
+        a._apply_update(MemberUpdate(b.identity, 0, MemberState.ALIVE))
+        a._apply_update(
+            MemberUpdate(b.identity, 0, MemberState.SUSPECT),
+            via=a.identity.id,
+        )
+        assert a.members[b.identity.id].state == MemberState.SUSPECT
+        # A's own probe loop delivers the buddy notification in-ping
+        assert await wait_until(lambda: b._incarnation >= 1, timeout=5.0)
+        # and the refutation clears A's suspicion (ack direct-evidence
+        # path or the gossiped alive@1)
+        assert await wait_until(
+            lambda: (
+                b.identity.id in a.members
+                and a.members[b.identity.id].state == MemberState.ALIVE
+            ),
+            timeout=5.0,
+        )
+    finally:
+        trip.trip()
+        await a.stop()
+        await b.stop()
+
+
+# ---------------------------------------------------------------------------
+# 5c. per-node fault knobs in net/mem.py
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_node_outbound_loss_is_asymmetric():
+    net = MemNetwork(seed=1)
+    got = {"a": 0, "b": 0}
+
+    async def on_dg_a(src, data):
+        got["a"] += 1
+
+    async def on_dg_b(src, data):
+        got["b"] += 1
+
+    async def nop_uni(src, data):
+        pass
+
+    async def nop_bi(stream):
+        stream.close()
+
+    net.listener("a").serve(on_dg_a, nop_uni, nop_bi)
+    net.listener("b").serve(on_dg_b, nop_uni, nop_bi)
+    net.degrade("b", datagram_loss=1.0)
+    ta, tb = net.transport("a"), net.transport("b")
+    for _ in range(10):
+        await ta.send_datagram("b", b"x")  # INBOUND to b: unaffected
+        await tb.send_datagram("a", b"y")  # OUTBOUND from b: all lost
+    await asyncio.sleep(0.05)
+    assert got["b"] == 10 and got["a"] == 0
+    net.restore("b")
+    await tb.send_datagram("a", b"z")
+    await asyncio.sleep(0.05)
+    assert got["a"] == 1
+
+
+@pytest.mark.asyncio
+async def test_node_duplicate_delivers_twice():
+    net = MemNetwork(seed=1, faults=LinkFaults(node_duplicate={"a": 1.0}))
+    seen = []
+
+    async def on_dg(src, data):
+        seen.append(data)
+
+    async def nop_uni(src, data):
+        pass
+
+    async def nop_bi(stream):
+        stream.close()
+
+    net.listener("b").serve(on_dg, nop_uni, nop_bi)
+    await net.transport("a").send_datagram("b", b"dup")
+    await asyncio.sleep(0.05)
+    assert seen == [b"dup", b"dup"]
+
+
+@pytest.mark.asyncio
+async def test_node_latency_slows_only_that_sender():
+    import time as _time
+
+    net = MemNetwork(seed=1, faults=LinkFaults(node_latency={"a": 0.15}))
+    stamps = {}
+
+    async def on_dg(src, data):
+        stamps[src] = _time.monotonic()
+
+    async def nop_uni(src, data):
+        pass
+
+    async def nop_bi(stream):
+        stream.close()
+
+    net.listener("c").serve(on_dg, nop_uni, nop_bi)
+    t0 = _time.monotonic()
+    await net.transport("a").send_datagram("c", b"slow")
+    await net.transport("b").send_datagram("c", b"fast")
+    assert await wait_until(lambda: len(stamps) == 2, timeout=2.0)
+    assert stamps["a"] - t0 >= 0.14
+    assert stamps["b"] - t0 < 0.1

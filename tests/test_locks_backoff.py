@@ -39,6 +39,41 @@ def test_backoff_infinite_when_retries_none():
         next(it)  # never raises StopIteration
 
 
+def test_backoff_full_jitter_bounds_and_spread():
+    """r9 full-jitter mode (the announce/rejoin storm-breaker): each
+    yield is uniform in [0, min(base, max)], base still ramps
+    exponentially — so retriers spread over the whole window instead of
+    firing in the same beat."""
+    b = Backoff(min_interval=4.0, max_interval=64.0, factor=2.0,
+                retries=8, mode="full").with_seed(7)
+    vals = list(b)
+    base = 4.0
+    for v in vals:
+        assert 0.0 <= v <= base + 1e-9
+        base = min(base * 2.0, 64.0)
+    # genuinely spread: not all draws collapse near the cap or floor
+    assert len({round(v, 3) for v in vals}) > 4
+    # deterministic under the same seed
+    assert vals == list(
+        Backoff(min_interval=4.0, max_interval=64.0, factor=2.0,
+                retries=8, mode="full").with_seed(7)
+    )
+    # two DIFFERENT seeds (two healed nodes) desynchronize — the storm
+    # property the deterministic doubling had
+    other = list(
+        Backoff(min_interval=4.0, max_interval=64.0, factor=2.0,
+                retries=8, mode="full").with_seed(8)
+    )
+    assert vals != other
+
+
+def test_backoff_unknown_mode_raises():
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        next(iter(Backoff(mode="nonsense")))
+
+
 @pytest.mark.asyncio
 async def test_rwlock_readers_shared_writer_exclusive():
     reg = LockRegistry()
