@@ -87,5 +87,18 @@ class Agent:
         return self.actor.cluster_id
 
     def notify_change_hooks(self, changes: List[Change]) -> None:
+        """Feed one committed batch to the subs/updates hooks.  Runs on
+        whatever thread committed (write path / ingest worker): the
+        histogram makes the per-batch hook cost visible so a routing
+        regression back to O(subs × changes) shows up as a rising
+        write-path tax, not a mystery throughput loss."""
+        import time as _time
+
+        from corrosion_tpu.runtime.metrics import METRICS
+
+        start = _time.monotonic()
         for hook in list(self.change_hooks):
             hook(changes)
+        METRICS.histogram("corro.agent.changes.hooks.seconds").observe(
+            _time.monotonic() - start
+        )

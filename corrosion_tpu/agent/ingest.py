@@ -12,7 +12,12 @@ Counterparts:
     incomplete ones buffer with seq-range bookkeeping, empties only move
     the gap set; closing a version's last seq gap schedules a
     fully-buffered apply (`util.rs:1000-1023`); committed impactful rows
-    feed the subs/updates hooks (`util.rs:1042-1047`).
+    feed the subs/updates hooks (`util.rs:1042-1047`).  The hooks run
+    HERE on the apply worker thread: since r10 the subs hook is the
+    manager's inverted routing index (O(changes + hits), sub count out
+    of the loop) and the per-batch hook cost is recorded as
+    `corro.agent.changes.hooks.seconds` — a regression back to
+    O(subs × changes) shows up as a rising ingest tax.
   - `process_fully_buffered_changes` (`util.rs:552-700`).
 """
 

@@ -432,6 +432,26 @@ class ApiServer:
             },
             "kernel_events": kernel_event_totals(METRICS),
             "kernel_phase_seconds": phase_seconds,
+            # r10 subscription serving plane: how many live queries, how
+            # the change router is spending the write path, and whether
+            # the shared diff executor is backing up (depth > workers =
+            # matchers queueing for a diff slot)
+            "subscriptions": {
+                "count": len(self.subs.handles()) if self.subs else 0,
+                "streams": sum(
+                    h.subscriber_count for h in self.subs.handles()
+                )
+                if self.subs
+                else 0,
+                "router_tables": peek("corro.subs.router.tables"),
+                "router_changes": peek("corro.subs.router.changes.total"),
+                "router_matched": peek("corro.subs.router.matched.total"),
+                "router_fanout": peek("corro.subs.router.fanout.total"),
+                "executor_depth": peek("corro.subs.executor.depth"),
+                "executor_submitted": peek(
+                    "corro.subs.executor.submitted.total"
+                ),
+            },
             "loop": {
                 "lag_max_seconds": peek(
                     "corro.runtime.loop.lag.max.seconds"

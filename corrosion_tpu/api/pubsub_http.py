@@ -27,7 +27,6 @@ from typing import Any, List, Optional
 from aiohttp import web
 
 from corrosion_tpu.api.types import (
-    ev_change,
     ev_columns,
     ev_eoq,
     ev_error,
@@ -35,6 +34,7 @@ from corrosion_tpu.api.types import (
     ev_row,
     parse_statement,
 )
+from corrosion_tpu.pubsub.matcher import MatcherError, SubDead
 from corrosion_tpu.pubsub.parse import ParseError
 
 
@@ -166,7 +166,13 @@ async def _stream_sub(
     try:
         replayed_max = 0
         if from_id is not None:
-            evs = await asyncio.to_thread(handle.matcher.changes_since, from_id)
+            try:
+                evs = await asyncio.to_thread(handle.changes_since, from_id)
+            except MatcherError as e:
+                # dead matcher: typed terminal error, not a replay hang
+                await line(ev_error(str(e)))
+                await resp.write_eof()
+                return resp
             if evs is None:
                 await line(
                     ev_error(
@@ -177,7 +183,7 @@ async def _stream_sub(
                 await resp.write_eof()
                 return resp
             for ev in evs:
-                await line(ev_change(ev.kind, ev.rowid, ev.values, ev.change_id))
+                await line(ev.line())
                 replayed_max = ev.change_id
         else:
             await line(ev_columns(handle.columns))
@@ -190,13 +196,45 @@ async def _stream_sub(
             replayed_max = snap_id
 
         while True:
-            ev = await q.get()
-            if ev is None:  # matcher died
-                await line(ev_error(handle.error or "subscription closed"))
-                break
-            if ev.change_id <= replayed_max:
+            item = await q.get()
+            # greedy drain: queue items are whole diff batches (lists);
+            # under fan-out pressure several batches coalesce into one
+            # socket write, so per-event cost on this loop is a cached
+            # string append + join (the reference buffers the same way,
+            # pubsub.rs:818-980)
+            pending = [item]
+            while True:
+                try:
+                    pending.append(q.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
+            chunks: List[bytes] = []
+            terminal = None
+            for item in pending:
+                if item is None or isinstance(item, SubDead):
+                    terminal = item
+                    break
+                if item and item[0].change_id > replayed_max:
+                    # whole batch is post-replay (events are id-ordered):
+                    # ship the ONE payload every subscriber shares
+                    chunks.append(item.payload())
+                else:
+                    lines = [
+                        ev.line()
+                        for ev in item
+                        if ev.change_id > replayed_max
+                    ]
+                    if lines:
+                        chunks.append(("\n".join(lines) + "\n").encode())
+            if chunks:
+                await resp.write(b"".join(chunks))
+            if terminal is None:
                 continue
-            await line(ev_change(ev.kind, ev.rowid, ev.values, ev.change_id))
+            if isinstance(terminal, SubDead):  # matcher died
+                await line(ev_error(f"subscription failed: {terminal.error}"))
+            else:  # clean manager stop
+                await line(ev_error("subscription closed"))
+            break
     except (ConnectionResetError, asyncio.CancelledError):
         pass
     finally:

@@ -91,15 +91,6 @@ def ev_eoq(time_s: float, change_id: Optional[int] = None) -> str:
     return json.dumps({"eoq": body}, separators=(",", ":"))
 
 
-def ev_change(
-    kind: str, rowid: int, values: List[SqliteValue], change_id: int
-) -> str:
-    return json.dumps(
-        {"change": [kind, rowid, [dump_value(v) for v in values], change_id]},
-        separators=(",", ":"),
-    )
-
-
 def ev_error(err: str) -> str:
     return json.dumps({"error": err}, separators=(",", ":"))
 

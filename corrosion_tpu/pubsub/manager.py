@@ -1,4 +1,5 @@
-"""SubsManager: dedupe, lifecycle and restore of live-query matchers.
+"""SubsManager: dedupe, lifecycle, restore and change ROUTING of
+live-query matchers.
 
 Counterpart of `SubsManager` in `klukai-types/src/pubsub.rs:54-256`:
 subscriptions are deduped by SQL text hash (`:565`), `get_or_insert`
@@ -6,6 +7,18 @@ subscriptions are deduped by SQL text hash (`:565`), `get_or_insert`
 query, and `restore` (`:164`) re-attaches matchers persisted under
 `<subs_path>/<uuid>/sub.sqlite` on agent start
 (`klukai-agent/src/agent/setup.rs:296-349`).
+
+Routing (r10): the change hook used to call every matcher's
+`filter_candidates` for every committed batch — O(subs × changes)
+Python work under the GIL, on the WRITE path.  The manager now keeps an
+inverted index `table → {cid | sentinel} → (handles…)` rebuilt on
+(un)subscribe, so `match_changes` does one dict hop per change and
+feeds each hit matcher a pre-filtered candidate pk set directly:
+O(changes + hits), subscription count out of the write path.  A change
+routes to a matcher iff the matcher's parsed column deps contain its
+(table, cid) — or it is a sentinel (row create/delete), which reaches
+every matcher on the table — exactly `Matcher.filter_candidates`'s
+predicate, amortized across matchers.
 """
 
 from __future__ import annotations
@@ -16,8 +29,9 @@ import shutil
 import sqlite3
 import uuid
 from pathlib import Path
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from corrosion_tpu.pubsub.executor import DiffExecutor
 from corrosion_tpu.pubsub.matcher import (
     Matcher,
     MatcherError,
@@ -26,7 +40,10 @@ from corrosion_tpu.pubsub.matcher import (
 )
 from corrosion_tpu.pubsub.parse import ParseError, parse_select
 from corrosion_tpu.runtime.metrics import METRICS
-from corrosion_tpu.types.change import Change
+from corrosion_tpu.types.change import SENTINEL, Change
+
+# table -> cid (or SENTINEL) -> handles whose queries the change affects
+Router = Dict[str, Dict[str, Tuple[MatcherHandle, ...]]]
 
 
 class SubsManager:
@@ -38,6 +55,26 @@ class SubsManager:
         self._by_id: Dict[str, MatcherHandle] = {}
         self._by_hash: Dict[str, str] = {}  # sql hash -> id
         self._lock = asyncio.Lock()
+        # immutable snapshot, swapped whole on (un)subscribe: worker
+        # threads read it lock-free mid-rebuild and see old or new,
+        # never a half-built index
+        self._router: Router = {}
+        self.executor = DiffExecutor()
+
+    def _rebuild_router(self) -> None:
+        idx: Dict[str, Dict[str, Set[MatcherHandle]]] = {}
+        for handle in self._by_id.values():
+            # routing_keys = filter_candidates's predicate, factored to
+            # the parse layer (sentinel per table + every dep column)
+            for table, cid in handle.matcher.parsed.routing_keys():
+                idx.setdefault(table, {}).setdefault(cid, set()).add(
+                    handle
+                )
+        self._router = {
+            table: {cid: tuple(hs) for cid, hs in by_cid.items()}
+            for table, by_cid in idx.items()
+        }
+        METRICS.gauge("corro.subs.router.tables").set(len(self._router))
 
     def get(self, sub_id: str) -> Optional[MatcherHandle]:
         return self._by_id.get(sub_id)
@@ -75,10 +112,11 @@ class SubsManager:
                 matcher.close()
                 self._purge_dir(sub_id)
                 raise ParseError(str(e)) from e
-            handle = MatcherHandle(matcher, loop)
+            handle = MatcherHandle(matcher, loop, executor=self.executor)
             handle.start()
             self._by_id[sub_id] = handle
             self._by_hash[sql_hash(sql)] = sub_id
+            self._rebuild_router()
             METRICS.gauge("corro.subs.count").set(len(self._by_id))
             return handle, True
 
@@ -105,12 +143,15 @@ class SubsManager:
             except (sqlite3.Error, MatcherError, ParseError, KeyError):
                 shutil.rmtree(d, ignore_errors=True)
                 continue
-            handle = MatcherHandle(matcher, asyncio.get_running_loop())
+            handle = MatcherHandle(
+                matcher, asyncio.get_running_loop(), executor=self.executor
+            )
             handle.start()
             self._by_id[d.name] = handle
             self._by_hash[sql_hash(sql)] = d.name
             await asyncio.to_thread(self._resync, handle)
             n += 1
+        self._rebuild_router()
         METRICS.gauge("corro.subs.count").set(len(self._by_id))
         return n
 
@@ -148,14 +189,45 @@ class SubsManager:
     # -- feeding -----------------------------------------------------------
 
     def match_changes(self, changes: Sequence[Change]) -> None:
-        """Change hook: route committed changes to every matcher
-        (updates.rs:424-488). Thread-safe. Dead matchers are skipped
-        (their queue has no consumer) and torn down from the loop."""
-        for handle in list(self._by_id.values()):
-            if handle.error is not None:
-                handle.loop.call_soon_threadsafe(self._schedule_removal, handle.id)
+        """Change hook: route committed changes through the inverted
+        index (updates.rs:424-488). Thread-safe. One dict hop per
+        change, candidate pk sets accumulated per hit matcher —
+        `filter_candidates` never runs here, and matchers whose
+        (table, cid) index misses do no work at all. Dead matchers are
+        skipped (their queue has no consumer) and torn down from the
+        loop."""
+        router = self._router
+        if not router:
+            return
+        per: Dict[MatcherHandle, Dict[str, Set[bytes]]] = {}
+        matched = 0
+        fanout = 0
+        for ch in changes:
+            by_cid = router.get(ch.table)
+            if by_cid is None:
                 continue
-            handle.match_changes(changes)
+            handles = by_cid.get(
+                SENTINEL if ch.is_sentinel() else ch.cid
+            )
+            if not handles:
+                continue
+            matched += 1
+            fanout += len(handles)
+            for h in handles:
+                per.setdefault(h, {}).setdefault(
+                    ch.table, set()
+                ).add(ch.pk)
+        METRICS.counter("corro.subs.router.changes.total").inc(len(changes))
+        if matched:
+            METRICS.counter("corro.subs.router.matched.total").inc(matched)
+            METRICS.counter("corro.subs.router.fanout.total").inc(fanout)
+        for handle, cands in per.items():
+            if handle.error is not None:
+                handle.loop.call_soon_threadsafe(
+                    self._schedule_removal, handle.id
+                )
+                continue
+            handle.enqueue_candidates(cands)
 
     def _schedule_removal(self, sub_id: str) -> None:
         asyncio.ensure_future(self.remove(sub_id, purge=True))
@@ -171,6 +243,7 @@ class SubsManager:
         if handle is None:
             return
         self._by_hash.pop(sql_hash(handle.sql), None)
+        self._rebuild_router()
         await handle.stop()
         if purge:
             self._purge_dir(sub_id)
@@ -183,3 +256,4 @@ class SubsManager:
     async def stop_all(self) -> None:
         for sid in list(self._by_id):
             await self.remove(sid)
+        self.executor.shutdown()

@@ -50,6 +50,12 @@ CANDIDATE_BATCH_WAIT = 0.6  # 600 ms (pubsub.rs:1069)
 CHANGES_LOG_KEEP = 500  # prune to last 500 (pubsub.rs:1171-1192)
 PRUNE_INTERVAL = 300.0  # every 5 min
 
+# UPDATE/INSERT/DELETE ... RETURNING landed in SQLite 3.35.0; older
+# libraries (this image ships 3.34.1) take a SELECT-then-mutate
+# fallback in the _diff_* family — same events, one extra read per diff
+# statement.  Gated once at import, not per batch.
+_HAS_RETURNING = sqlite3.sqlite_version_info >= (3, 35, 0)
+
 
 class MatcherError(Exception):
     pass
@@ -57,12 +63,30 @@ class MatcherError(Exception):
 
 @dataclass(frozen=True)
 class SubEvent:
-    """One row-change event: mirrors QueryEvent::Change."""
+    """One row-change event: mirrors QueryEvent::Change.
+
+    `values_json` is the cells encoded ONCE at diff time (it is also
+    exactly what the `sub.changes` log stores) — the NDJSON line every
+    subscriber receives is assembled from it by `line()` without
+    re-serializing per subscriber, so a 128-subscriber fan-out pays one
+    json.dumps, not 128."""
 
     change_id: int
     kind: str  # insert | update | delete
     rowid: int
     values: List[Any]  # JSON-ready cell values
+    values_json: str = ""  # json.dumps(values), computed once
+
+    def line(self) -> str:
+        """The full `{"change":[kind,rowid,values,change_id]}` NDJSON
+        line, shared across subscribers (kind is a fixed token and
+        rowid/change_id are ints, so assembly is plain concatenation)."""
+        vj = self.values_json or json.dumps(
+            self.values, separators=(",", ":")
+        )
+        return (
+            f'{{"change":["{self.kind}",{self.rowid},{vj},{self.change_id}]}}'
+        )
 
 
 def sql_hash(sql: str) -> str:
@@ -89,6 +113,39 @@ def _pk_alias(table: str, col: str) -> str:
     return f"__corro_pk_{table}_{col}"
 
 
+@dataclass(frozen=True)
+class SubDead:
+    """Terminal frame a dying matcher fans out to attached subscribers:
+    carries the error so downstream code surfaces a typed error frame
+    instead of dereferencing a bare None (`ev.kind` AttributeError).
+    A clean stop still fans out None."""
+
+    error: str
+
+
+class EventBatch(list):
+    """One diff's events plus their encoded wire payload, built ONCE
+    and shared by every attached subscriber: in the common case (no
+    replay filtering) a stream ships `payload()` — the same bytes
+    object — so a 128-stream fan-out costs 128 socket writes, not
+    128 × len(batch) string joins.  Subclasses list so event-level
+    consumers iterate it unchanged."""
+
+    __slots__ = ("_payload",)
+
+    def payload(self) -> bytes:
+        """All events as NDJSON lines (newline-terminated), lazily
+        encoded once.  Only called from the event loop thread, so the
+        build is race-free."""
+        try:
+            return self._payload
+        except AttributeError:
+            self._payload = (
+                "\n".join(ev.line() for ev in self) + "\n"
+            ).encode()
+            return self._payload
+
+
 class Matcher:
     """Owns the sub db + the rewrite; drives initial fill and diffs.
 
@@ -113,6 +170,13 @@ class Matcher:
         self._conn: Optional[sqlite3.Connection] = None
         self._conn_lock = threading.Lock()
         self.last_change_id = 0
+        # precomputed per-batch SQL (built once by _prepare_plans after
+        # the column set is known): stable statement text is what lets
+        # sqlite3's per-connection statement cache reuse prepared plans
+        # across batches — the old per-batch DROP/CREATE bumped the
+        # schema cookie and recompiled everything every 600 ms tick
+        self._plans: Dict[str, Any] = {}
+        self._state_fill_cache: Dict[frozenset, str] = {}
 
     # -- setup -------------------------------------------------------------
 
@@ -177,6 +241,7 @@ class Matcher:
                 conn.execute(
                     f'CREATE TABLE IF NOT EXISTS sub."temp_{t.name}" ({cols})'
                 )
+            self._create_state_results(conn)
             conn.executemany(
                 "INSERT OR REPLACE INTO sub.columns (idx, name) VALUES (?, ?)",
                 list(enumerate(self.columns)),
@@ -189,6 +254,7 @@ class Matcher:
                 "INSERT OR REPLACE INTO sub.meta (k, v) VALUES"
                 " ('state', 'created')"
             )
+        self._prepare_plans()
 
     def reattach(self) -> None:
         """Reopen an existing sub db (restore path, pubsub.rs:826-861)."""
@@ -205,8 +271,175 @@ class Matcher:
                 "SELECT name FROM sub.columns ORDER BY idx"
             )
         ]
+        with self._conn_lock:
+            # legacy sub dbs carry a CREATE-TABLE-AS state_results whose
+            # column names came from the select list; rebuild canonical
+            conn.execute("DROP TABLE IF EXISTS sub.state_results")
+            for t in self.parsed.tables:
+                cols = ", ".join(
+                    f'"{c}"' for c in self.store.schema.table(t.name).pk_cols
+                )
+                conn.execute(
+                    f'CREATE TABLE IF NOT EXISTS sub."temp_{t.name}" ({cols})'
+                )
+            self._create_state_results(conn)
         row = conn.execute("SELECT MAX(id) AS m FROM sub.changes").fetchone()
         self.last_change_id = int(row["m"] or 0)
+        self._prepare_plans()
+
+    def _create_state_results(self, conn) -> None:
+        """Persistent diff scratch table (canonical column names: pk
+        aliases then col_0..col_n) + a pk index.  Created ONCE — batches
+        reuse it via DELETE + INSERT...SELECT, never DDL: the old
+        per-batch DROP/CREATE both recompiled every cached statement
+        (schema cookie bump) and left the diff lookups unindexed, which
+        is where the banked bench's O(table) per-batch cost lived."""
+        pk_cols = self._pk_alias_cols()
+        col_defs = ", ".join(
+            [f'"{c}"' for c in pk_cols]
+            + [f'"col_{i}"' for i in range(len(self.columns))]
+        )
+        idx_cols = ", ".join(f'"{c}"' for c in pk_cols)
+        conn.execute(
+            f"CREATE TABLE IF NOT EXISTS sub.state_results ({col_defs})"
+        )
+        conn.execute(
+            "CREATE INDEX IF NOT EXISTS sub.state_results_pks"
+            f" ON state_results ({idx_cols})"
+        )
+
+    def _prepare_plans(self) -> None:
+        """Build every per-batch SQL string once.
+
+        The diff statements are shaped so the measured 3.34 planner
+        keeps them O(batch): each is DRIVEN from `state_results` or the
+        temp pk tables (batch-sized) with indexed lookups into
+        `sub.query` — CROSS JOIN pins the join order for the update
+        scan, LEFT JOIN ... IS NULL pins it for the insert-miss scan
+        (the previous UPDATE...FROM / INSERT..SELECT..NOT EXISTS shapes
+        let the planner flip to a full scan of the materialized table
+        per batch).  Mutations are applied by __corro_rowid executemany
+        — plan-proof, and independent of RETURNING support."""
+        pk_cols = self._pk_alias_cols()
+        ncols = len(self.columns)
+        p: Dict[str, Any] = {}
+        p["temp_clear"] = {}
+        p["temp_insert"] = {}
+        for t in self.parsed.tables:
+            if t.name in p["temp_clear"]:
+                continue
+            tbl_pks = self.store.schema.table(t.name).pk_cols
+            p["temp_clear"][t.name] = f'DELETE FROM sub."temp_{t.name}"'
+            p["temp_insert"][t.name] = (
+                f'INSERT INTO sub."temp_{t.name}" VALUES'
+                f" ({', '.join('?' * len(tbl_pks))})"
+            )
+        p["state_clear"] = "DELETE FROM sub.state_results"
+        state_cols = [f'"{c}"' for c in pk_cols] + [
+            f'"col_{i}"' for i in range(ncols)
+        ]
+        p["state_cols"] = ", ".join(state_cols)
+
+        on = " AND ".join(
+            f'q."{c}" IS s."{c}"' for c in pk_cols
+        )
+        s_user = [f's."col_{i}"' for i in range(ncols)]
+        q_user = [f'q."col_{i}"' for i in range(ncols)]
+        differs = " OR ".join(
+            f"{qc} IS NOT {sc}" for qc, sc in zip(q_user, s_user)
+        )
+        # updates: read rowid + new values driven from s (CROSS JOIN =
+        # no reorder), then apply by rowid
+        if ncols:
+            p["updates_select"] = (
+                f"SELECT q.__corro_rowid, {', '.join(s_user)}"
+                f" FROM sub.state_results s CROSS JOIN sub.query q"
+                f" ON {on} WHERE {differs}"
+            )
+            p["updates_apply"] = (
+                "UPDATE sub.query SET "
+                + ", ".join(f'"col_{i}" = ?' for i in range(ncols))
+                + " WHERE __corro_rowid = ?"
+            )
+        # inserts: rows in s with no pk partner in q (LEFT JOIN pins s
+        # as the driving table; the q probe rides the unique pk index)
+        p["inserts_select"] = (
+            f"SELECT {', '.join(['s.' + c for c in state_cols])}"
+            f" FROM sub.state_results s LEFT JOIN sub.query q ON {on}"
+            " WHERE q.__corro_rowid IS NULL"
+        )
+        p["inserts_apply"] = (
+            f"INSERT INTO sub.query ({p['state_cols']}) VALUES"
+            f" ({', '.join('?' * len(state_cols))})"
+        )
+        p["max_rowid"] = (
+            "SELECT COALESCE(MAX(__corro_rowid), 0) FROM sub.query"
+        )
+        user_sel = ", ".join(f'"col_{i}"' for i in range(ncols))
+        p["inserted_rows"] = (
+            f"SELECT __corro_rowid{', ' + user_sel if ncols else ''}"
+            " FROM sub.query WHERE __corro_rowid > ?"
+            " ORDER BY __corro_rowid"
+        )
+        # deletes: per changed table — candidates driven by the temp pk
+        # list (IN → indexed q lookups), absence checked against the
+        # indexed state_results
+        p["deletes_select"] = {}
+        for table in {t.name for t in self.parsed.tables}:
+            tbl_pks = self.store.schema.table(table).pk_cols
+            ref_preds = []
+            for ref in self.parsed.tables:
+                if ref.name != table:
+                    continue
+                aliases = [f'"{_pk_alias(ref.alias, c)}"' for c in tbl_pks]
+                quoted_pks = ", ".join(f'"{c}"' for c in tbl_pks)
+                ref_preds.append(
+                    f"({', '.join('q.' + a for a in aliases)}) IN"
+                    f" (SELECT {quoted_pks}"
+                    f' FROM sub."temp_{table}")'
+                )
+            in_temp = "(" + " OR ".join(ref_preds) + ")"
+            not_in_results = (
+                "NOT EXISTS (SELECT 1 FROM sub.state_results s WHERE "
+                + " AND ".join(f'q."{c}" IS s."{c}"' for c in pk_cols)
+                + ")"
+            )
+            p["deletes_select"][table] = (
+                f"SELECT __corro_rowid{', ' + user_sel if ncols else ''}"
+                f" FROM sub.query AS q WHERE {in_temp} AND {not_in_results}"
+            )
+            p.setdefault("deletes_returning", {})[table] = (
+                f"DELETE FROM sub.query AS q WHERE {in_temp} AND"
+                f" {not_in_results} RETURNING"
+                f" __corro_rowid{', ' + user_sel if ncols else ''}"
+            )
+        p["deletes_apply"] = (
+            "DELETE FROM sub.query WHERE __corro_rowid = ?"
+        )
+        p["log_append"] = (
+            "INSERT INTO sub.changes (id, type, __corro_rowid, data)"
+            " VALUES (?, ?, ?, ?)"
+        )
+        self._plans = p
+        self._state_fill_cache = {}
+
+    def _state_fill_sql(self, tables: frozenset) -> str:
+        """INSERT...SELECT (UNION of per-ref rewritten queries) for one
+        candidate-table set, memoized so the statement text — and the
+        prepared plan behind it — is stable across batches."""
+        sql = self._state_fill_cache.get(tables)
+        if sql is None:
+            selects = [
+                self._table_query(ref)
+                for ref in self.parsed.tables
+                if ref.name in tables
+            ]
+            sql = (
+                f"INSERT INTO sub.state_results ({self._plans['state_cols']}) "
+                + " UNION ".join(selects)
+            )
+            self._state_fill_cache[tables] = sql
+        return sql
 
     # -- rewrites ----------------------------------------------------------
 
@@ -360,67 +593,44 @@ class Matcher:
         self, candidates: Dict[str, Set[bytes]]
     ) -> List[SubEvent]:
         """Diff changed pks against the materialized result
-        (pubsub.rs:1401-1673). Runs on an executor thread."""
+        (pubsub.rs:1401-1673). Runs on an executor thread.
+
+        Steady-state cost is O(changed pks), independent of the table
+        size: every statement here is precomputed text (prepared-plan
+        reuse), driven from the batch-sized temp/state tables, and the
+        only DML against `sub.query` is rowid-keyed.  A tier-1 trace
+        pin (tests/test_pubsub_perf.py) holds the per-batch statement
+        count equal across table sizes."""
         conn = self._conn
         assert conn is not None
-        pk_cols = self._pk_alias_cols()
-        ncols = len(self.columns)
-        ins_cols = [f'"{c}"' for c in pk_cols] + [
-            f'"col_{i}"' for i in range(ncols)
-        ]
+        plans = self._plans
         events: List[SubEvent] = []
         start = time.monotonic()
         with self._conn_lock:
             conn.execute("BEGIN")
             try:
                 for table, pks in candidates.items():
-                    tbl_pks = self.store.schema.table(table).pk_cols
-                    conn.execute(f'DELETE FROM sub."temp_{table}"')
+                    conn.execute(plans["temp_clear"][table])
                     conn.executemany(
-                        f'INSERT INTO sub."temp_{table}" VALUES'
-                        f" ({', '.join('?' * len(tbl_pks))})",
+                        plans["temp_insert"][table],
                         [tuple(unpack_columns(pk)) for pk in pks],
                     )
                 self._expand_left_join_candidates(conn, candidates)
-                conn.execute("DROP TABLE IF EXISTS sub.state_results")
+                conn.execute(plans["state_clear"])
                 # one select per driving *ref* of a changed table, so a
                 # self-joined table re-evaluates through both of its refs
-                selects = [
-                    self._table_query(ref)
-                    for ref in self.parsed.tables
-                    if ref.name in candidates
-                ]
-                conn.execute(
-                    "CREATE TABLE sub.state_results AS "
-                    + " UNION ".join(selects)
-                )
-                res_cols = [
-                    d[1]
-                    for d in conn.execute(
-                        "PRAGMA sub.table_info(state_results)"
-                    )
-                ]
-                # state_results columns = pk aliases then user cols in order
-                sr_pk = [f'"{c}"' for c in res_cols[: len(pk_cols)]]
-                sr_user = [f'"{c}"' for c in res_cols[len(pk_cols):]]
+                conn.execute(self._state_fill_sql(frozenset(candidates)))
 
-                events.extend(self._diff_updates(conn, pk_cols, sr_pk, sr_user))
-                events.extend(
-                    self._diff_inserts(conn, pk_cols, ins_cols, sr_pk, sr_user)
-                )
-                events.extend(
-                    self._diff_deletes(conn, candidates, pk_cols)
-                )
-                for ev in events:
-                    conn.execute(
-                        "INSERT INTO sub.changes (id, type, __corro_rowid,"
-                        " data) VALUES (?, ?, ?, ?)",
-                        (
-                            ev.change_id,
-                            ev.kind,
-                            ev.rowid,
-                            json.dumps(ev.values, separators=(",", ":")),
-                        ),
+                events.extend(self._diff_updates(conn))
+                events.extend(self._diff_inserts(conn))
+                events.extend(self._diff_deletes(conn, candidates))
+                if events:
+                    conn.executemany(
+                        plans["log_append"],
+                        [
+                            (ev.change_id, ev.kind, ev.rowid, ev.values_json)
+                            for ev in events
+                        ],
                     )
                 conn.execute("COMMIT")
             except BaseException:
@@ -428,6 +638,16 @@ class Matcher:
                 raise
         METRICS.histogram("corro.subs.process.time.seconds", id=self.id).observe(time.monotonic() - start)
         return events
+
+    def _mk_event(self, kind: str, rowid: int, raw_values) -> SubEvent:
+        values = [dump_value(v) for v in raw_values]
+        return SubEvent(
+            self._next_id(),
+            kind,
+            rowid,
+            values,
+            json.dumps(values, separators=(",", ":")),
+        )
 
     def _next_id(self) -> int:
         self.last_change_id += 1
@@ -475,106 +695,71 @@ class Matcher:
                     [tuple(r) for r in rows],
                 )
 
-    def _diff_updates(self, conn, pk_cols, sr_pk, sr_user) -> List[SubEvent]:
-        """Rows whose pk exists but whose values changed → update."""
-        ncols = len(self.columns)
-        if ncols == 0:
+    def _diff_updates(self, conn) -> List[SubEvent]:
+        """Rows whose pk exists but whose values changed → update.
+
+        SELECT-then-mutate-by-rowid on every SQLite version: the
+        single-statement `UPDATE ... FROM ... RETURNING` alternative
+        measured O(table) under the 3.34 planner (it flips to a full
+        scan of sub.query with an automatic index over state_results),
+        and the rows have to be fetched for the events anyway — so the
+        plan-pinned CROSS JOIN read + rowid-keyed writes are both the
+        portable path and the fast one."""
+        if len(self.columns) == 0:
             return []
-        on = " AND ".join(
-            f'q."{c}" IS s.{sc}' for c, sc in zip(pk_cols, sr_pk)
+        rows = conn.execute(self._plans["updates_select"]).fetchall()
+        if not rows:
+            return []
+        conn.executemany(
+            self._plans["updates_apply"],
+            [tuple(r)[1:] + (r[0],) for r in rows],
         )
-        differs = " OR ".join(
-            f'q."col_{i}" IS NOT s.{sc}' for i, sc in enumerate(sr_user)
-        )
-        sets = ", ".join(
-            f'"col_{i}" = s.{sc}' for i, sc in enumerate(sr_user)
-        )
-        # RETURNING may not use the update alias in sqlite: unqualified
-        # names resolve against the modified table only
-        ret = ", ".join(f'"col_{i}"' for i in range(ncols))
-        rows = conn.execute(
-            f"UPDATE sub.query AS q SET {sets} FROM sub.state_results s"
-            f" WHERE {on} AND ({differs})"
-            f" RETURNING __corro_rowid, {ret}"
+        return [self._mk_event("update", r[0], list(r)[1:]) for r in rows]
+
+    def _diff_inserts(self, conn) -> List[SubEvent]:
+        """state_results rows with no pk partner in the materialized
+        table → insert.  The LEFT JOIN pins state_results as the outer
+        loop (O(batch)); inserted rowids are read back as the
+        AUTOINCREMENT-contiguous range past the pre-insert MAX (an O(1)
+        index peek)."""
+        plans = self._plans
+        rows = conn.execute(plans["inserts_select"]).fetchall()
+        if not rows:
+            return []
+        max_rowid = conn.execute(plans["max_rowid"]).fetchone()[0]
+        conn.executemany(plans["inserts_apply"], [tuple(r) for r in rows])
+        inserted = conn.execute(
+            plans["inserted_rows"], (max_rowid,)
         ).fetchall()
         return [
-            SubEvent(
-                self._next_id(),
-                "update",
-                r[0],
-                [dump_value(v) for v in list(r)[1:]],
-            )
-            for r in rows
+            self._mk_event("insert", r[0], list(r)[1:]) for r in inserted
         ]
 
-    def _diff_inserts(
-        self, conn, pk_cols, ins_cols, sr_pk, sr_user
-    ) -> List[SubEvent]:
-        missing = " AND ".join(
-            f'q."{c}" IS s.{sc}' for c, sc in zip(pk_cols, sr_pk)
-        )
-        sel = ", ".join(sr_pk + sr_user)
-        rows = conn.execute(
-            f"INSERT INTO sub.query ({', '.join(ins_cols)})"
-            f" SELECT {sel} FROM sub.state_results s"
-            f" WHERE NOT EXISTS (SELECT 1 FROM sub.query q WHERE {missing})"
-            f" RETURNING __corro_rowid,"
-            f" {', '.join(f'col_{i}' for i in range(len(self.columns)))}"
-        ).fetchall()
-        return [
-            SubEvent(
-                self._next_id(),
-                "insert",
-                r[0],
-                [dump_value(v) for v in list(r)[1:]],
-            )
-            for r in rows
-        ]
-
-    def _diff_deletes(self, conn, candidates, pk_cols) -> List[SubEvent]:
+    def _diff_deletes(self, conn, candidates) -> List[SubEvent]:
         """Materialized rows whose driving pks were candidates but which
-        no longer appear in state_results → delete."""
+        no longer appear in state_results → delete.  Candidate rows are
+        reached through the temp pk list (indexed q lookups), the
+        absence probe rides the state_results pk index, and the DELETE
+        itself is rowid-keyed."""
         events: List[SubEvent] = []
-        ncols = len(self.columns)
-        ret = ", ".join(f'"col_{i}"' for i in range(ncols))
         for table in candidates:
-            tbl_pks = self.store.schema.table(table).pk_cols
-            # a materialized row is affected if ANY ref of the changed
-            # table binds a changed pk (self-joins have several refs)
-            ref_preds = []
-            for ref in self.parsed.tables:
-                if ref.name != table:
-                    continue
-                aliases = [f'"{_pk_alias(ref.alias, c)}"' for c in tbl_pks]
-                quoted_pks = ", ".join(f'"{c}"' for c in tbl_pks)
-                ref_preds.append(
-                    f"({', '.join('q.' + a for a in aliases)}) IN"
-                    f" (SELECT {quoted_pks}"
-                    f' FROM sub."temp_{table}")'
-                )
-            in_temp = "(" + " OR ".join(ref_preds) + ")"
-            all_aliases = [f'"{c}"' for c in pk_cols]
-            not_in_results = (
-                f"NOT EXISTS (SELECT 1 FROM sub.state_results s WHERE "
-                + " AND ".join(
-                    f"q.{a} IS s.{a}" for a in all_aliases
-                )
-                + ")"
-            )
-            sel = f", {ret}" if ncols else ""
-            rows = conn.execute(
-                f"DELETE FROM sub.query AS q WHERE {in_temp} AND"
-                f" {not_in_results} RETURNING __corro_rowid{sel}"
-            ).fetchall()
-            for r in rows:
-                events.append(
-                    SubEvent(
-                        self._next_id(),
-                        "delete",
-                        r[0],
-                        [dump_value(v) for v in list(r)[1:]],
+            if _HAS_RETURNING:
+                # fast path (>= 3.35): one statement — the candidate
+                # predicate keeps the same indexed plan as the SELECT
+                rows = conn.execute(
+                    self._plans["deletes_returning"][table]
+                ).fetchall()
+            else:
+                rows = conn.execute(
+                    self._plans["deletes_select"][table]
+                ).fetchall()
+                if rows:
+                    conn.executemany(
+                        self._plans["deletes_apply"],
+                        [(r[0],) for r in rows],
                     )
-                )
+            for r in rows:
+                events.append(self._mk_event("delete", r[0], list(r)[1:]))
         return events
 
     # -- log / catch-up ----------------------------------------------------
@@ -594,7 +779,13 @@ class Matcher:
                 (from_id,),
             ).fetchall()
         return [
-            SubEvent(r["id"], r["type"], r["__corro_rowid"], json.loads(r["data"]))
+            SubEvent(
+                r["id"],
+                r["type"],
+                r["__corro_rowid"],
+                json.loads(r["data"]),
+                r["data"],
+            )
             for r in rows
         ]
 
@@ -645,11 +836,19 @@ class MatcherHandle:
     """Async face of a Matcher: candidate queue, subscriber fan-out,
     lifecycle task. Mirrors `MatcherHandle` (pubsub.rs:518)."""
 
-    def __init__(self, matcher: Matcher, loop: asyncio.AbstractEventLoop):
+    def __init__(
+        self,
+        matcher: Matcher,
+        loop: asyncio.AbstractEventLoop,
+        executor=None,
+    ):
         self.matcher = matcher
         self.loop = loop
         self.id = matcher.id
         self.sql = matcher.sql
+        # shared bounded DiffExecutor (pubsub/executor.py) when owned by
+        # a SubsManager; None falls back to asyncio.to_thread
+        self._executor = executor
         self._queue: asyncio.Queue = asyncio.Queue()
         self._subscribers: List[asyncio.Queue] = []
         self._sub_lock = threading.Lock()
@@ -671,10 +870,26 @@ class MatcherHandle:
     def last_change_id(self) -> int:
         return self.matcher.last_change_id
 
+    def changes_since(self, from_id: int) -> Optional[List[SubEvent]]:
+        """Catch-up through the handle: a dead matcher raises a typed
+        MatcherError (callers turn it into an error frame) instead of
+        replaying from a connection whose diff loop has stopped."""
+        if self.error is not None:
+            raise MatcherError(f"subscription failed: {self.error}")
+        return self.matcher.changes_since(from_id)
+
     # -- feeding (thread-safe; called from change hooks on any thread) -----
 
     def match_changes(self, changes: Sequence[Change]) -> None:
-        cands = self.matcher.filter_candidates(changes)
+        """Filter + enqueue. Standalone-handle path: a manager-owned
+        handle receives pre-filtered candidates via
+        `enqueue_candidates` from the routing index instead."""
+        self.enqueue_candidates(self.matcher.filter_candidates(changes))
+
+    def enqueue_candidates(
+        self, cands: Dict[str, Set[bytes]]
+    ) -> None:
+        """Feed pre-filtered candidate pks (thread-safe)."""
         if not cands:
             return
         METRICS.counter("corro.subs.matched.count", id=self.id).inc(sum(len(v) for v in cands.values()))
@@ -716,31 +931,57 @@ class MatcherHandle:
                     for t, pks in more.items():
                         batch.setdefault(t, set()).update(pks)
                         n += len(pks)
-                events = await asyncio.to_thread(
+                events = await self._run_blocking(
                     self.matcher.handle_candidates, batch
                 )
                 self.processed += n
                 if events:
                     self._fan_out(events)
                 if time.monotonic() - last_prune > PRUNE_INTERVAL:
-                    await asyncio.to_thread(self.matcher.prune_log)
+                    await self._run_blocking(self.matcher.prune_log)
                     last_prune = time.monotonic()
         except Exception as e:  # matcher died: notify subscribers
             self.error = str(e)
             METRICS.counter("corro.subs.errors.count", id=self.id).inc()
         finally:
-            # clean stop AND error both release attached streams
-            self._fan_out([None])
+            # clean stop AND death both release attached streams — death
+            # with a TYPED terminal frame (the error travels with the
+            # sentinel so streams surface it instead of dereferencing
+            # a bare None)
+            self._fan_out_terminal(
+                SubDead(self.error) if self.error is not None else None
+            )
             self._done.set()
 
-    def _fan_out(self, events: List[Optional[SubEvent]]) -> None:
+    async def _run_blocking(self, fn, *args):
+        if self._executor is not None:
+            return await self._executor.run(fn, *args)
+        return await asyncio.to_thread(fn, *args)
+
+    def _fan_out(self, events: List[SubEvent]) -> None:
+        """ONE queue put per subscriber per diff batch: each attached
+        stream receives the same EventBatch (shared object — per-event
+        encoding happened once in the diff, the wire payload encodes
+        once on first ship), wakes once, and ships it in one socket
+        write.  Per-event-per-subscriber puts were the 128-stream
+        fan-out's dominant loop cost."""
+        batch = EventBatch(events)
         with self._sub_lock:
             subs = list(self._subscribers)
         for q in subs:
-            for ev in events:
-                q.put_nowait(ev)
+            q.put_nowait(batch)
+
+    def _fan_out_terminal(self, sentinel) -> None:
+        """End-of-stream: a bare None (clean stop) or SubDead frame."""
+        with self._sub_lock:
+            subs = list(self._subscribers)
+        for q in subs:
+            q.put_nowait(sentinel)
 
     def attach(self) -> asyncio.Queue:
+        """Subscribe to live events.  Queue items are LISTS of SubEvent
+        (one per diff batch), a bare None (clean stop) or a SubDead
+        terminal frame (matcher death, carries the error)."""
         q: asyncio.Queue = asyncio.Queue()
         with self._sub_lock:
             self._subscribers.append(q)

@@ -113,6 +113,20 @@ class ParsedSelect:
     def table_names(self) -> List[str]:
         return [t.name for t in self.tables]
 
+    def routing_keys(self):
+        """(table, cid) pairs the SubsManager's inverted change-routing
+        index files this query under — one per referenced column, plus
+        the sentinel per table (row create/delete reaches every query on
+        the table regardless of projected columns).  This is
+        `Matcher.filter_candidates`'s match predicate, factored to the
+        parse layer so the router and the filter cannot drift."""
+        from corrosion_tpu.types.change import SENTINEL
+
+        for table, deps in self.col_deps.items():
+            yield table, SENTINEL
+            for cid in deps:
+                yield table, cid
+
 
 def _split_clauses(tokens: List[Token], sql: str) -> Tuple[str, str, Optional[str], str]:
     """Split a SELECT into (select_list, from, where, tail) at paren depth 0."""

@@ -269,6 +269,30 @@ FLIGHT_CENSUS = (
 # is a wire-format change for every drained ring snapshot.
 FLIGHT_LANES = KERNEL_EVENTS + FLIGHT_CENSUS
 
+# Subscription serving-plane series (r10): the live-query perf round's
+# observable contract, emitted from pubsub/{manager,executor,matcher}.py
+# and agent/handle.py —
+#   corro.subs.router.tables          gauge      indexed source tables
+#   corro.subs.router.changes.total   counter    changes seen by the
+#                                                inverted routing index
+#   corro.subs.router.matched.total   counter    changes that hit >= 1
+#                                                matcher's (table,cid)
+#   corro.subs.router.fanout.total    counter    change x matcher pairs
+#                                                routed (the old hook
+#                                                cost was subs x changes
+#                                                REGARDLESS of matches)
+#   corro.subs.executor.depth         gauge      diff jobs submitted but
+#                                                unfinished; > workers
+#                                                means matchers queue
+#   corro.subs.executor.submitted.total counter
+#   corro.subs.executor.wait.seconds  histogram  queue wait before a
+#                                                diff starts
+#   corro.agent.changes.hooks.seconds histogram  per committed batch:
+#                                                total change-hook time
+#                                                on the write path
+# Canonical rows live in the COMPONENTS.md observability table
+# (lint_metrics.py enforces both directions).
+
 # The CRDT merge kernel's lane (ops/crdt_merge.py `_merge_kernel`):
 # per-batch decision outcomes, drained by the host wrapper in the same
 # readback as the decision outputs.

@@ -1,22 +1,36 @@
-"""Pubsub engine throughput: sustained write → subscription-event rate.
+"""Pubsub engine throughput: sustained write → subscription-event rate,
+scaled along BOTH serving-plane axes (table rows × live subscriptions).
 
 Reference analog: the matcher's cmd_loop batches candidates for 600 ms /
 1000 entries and diffs per-table rewritten queries
 (`klukai-types/src/pubsub.rs:1062-1226`). This measures the end-to-end
-event rate a live NDJSON subscription sustains while a writer hammers
-/v1/transactions on the same agent — matcher, per-sub sqlite db, HTTP
-streaming and the h2 front-end all in the path.
+event rate live NDJSON subscriptions sustain while a writer hammers
+/v1/transactions on the same agent — change router, matcher diffs,
+shared diff executor, per-sub sqlite dbs, HTTP streaming and the h2
+front-end all in the path.
 
-Writes INSERT ... ON CONFLICT upserts in batches; the subscriber counts
-row-change events until the writer stops and the stream drains. Records
-into PUBSUB_BENCH.json.
+Writes INSERT ... ON CONFLICT upserts in batches; each subscriber is a
+DISTINCT subscription (distinct SQL → its own matcher + sub db, the
+expensive axis) that counts row-change events until every stream has
+drained `n_rows` events. Records merge into PUBSUB_BENCH.json keyed by
+rung; records are `code_sha`-stamped over the measured pubsub files
+(bench.py replay-gate discipline) so before/after points in the shared
+artifact stay auditable.
 
-Usage: python scripts/bench_pubsub.py [n_rows] [batch]   (default 20000 50)
+Usage:
+  python scripts/bench_pubsub.py [n_rows] [batch]          one rung, 1 sub
+  python scripts/bench_pubsub.py --subs N [n_rows] [batch] one rung, N subs
+  python scripts/bench_pubsub.py --all [--tag T]           the full grid:
+      rows axis  {5k, 20k, 80k} × 1 sub   (table-size scaling)
+      subs axis  5k × {1, 16, 128} subs   (sub-count scaling)
+  --tag suffixes every rung name (e.g. `-pre`/`-post` for an A/B banked
+  into the same file).
 """
 
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import json
 import os
 import sys
@@ -35,28 +49,65 @@ from corrosion_tpu.runtime.records import merge_records  # noqa: E402
 
 from tests.test_http_api import boot_with_api  # noqa: E402
 
+_MEASURED_FILES = (
+    "corrosion_tpu/pubsub/matcher.py",
+    "corrosion_tpu/pubsub/manager.py",
+    "corrosion_tpu/pubsub/executor.py",
+    "corrosion_tpu/api/pubsub_http.py",
+    "scripts/bench_pubsub.py",
+)
 
-async def main(n_rows: int, batch: int) -> dict:
+
+def _code_fingerprint() -> dict:
+    out = {}
+    for rel in _MEASURED_FILES:
+        try:
+            with open(os.path.join(REPO, rel), "rb") as f:
+                out[rel] = hashlib.sha256(f.read()).hexdigest()[:12]
+        except OSError:
+            out[rel] = "missing"
+    return out
+
+
+async def main(
+    n_rows: int,
+    batch: int,
+    n_subs: int = 1,
+    tag: str = "",
+    distinct: bool = False,
+) -> dict:
     net = MemNetwork(seed=9)
     agent, api, client = await boot_with_api(net, "agent-pubsub")
-    sub_client = CorrosionApiClient(api.addrs[0])
-    got = 0
-    done = asyncio.Event()
+    sub_clients = [CorrosionApiClient(api.addrs[0]) for _ in range(n_subs)]
+    done_counts = [0] * n_subs
 
-    async def subscriber() -> None:
-        nonlocal got
-        async for ev in sub_client.subscribe(
-            "SELECT id, text FROM tests", skip_rows=True
+    async def subscriber(k: int) -> None:
+        # default: IDENTICAL SQL — the manager dedupes by hash so all
+        # streams share ONE matcher's diff + once-encoded event bytes
+        # (the reference serving architecture; the per-sub-rate bar is
+        # judged here).  --distinct gives each stream its own predicate
+        # → its own matcher + sub db: the matcher-count scaling axis.
+        sql = (
+            f"SELECT id, text FROM tests WHERE id >= -{k + 1}"
+            if distinct
+            else "SELECT id, text FROM tests"
+        )
+        # raw observer mode: count delivered change lines without a
+        # json.loads per event — the bench measures the serving plane,
+        # not the harness's decoder (uniform across every rung)
+        async for line in sub_clients[k].subscribe(
+            sql, skip_rows=True, raw=True
         ):
-            if "change" in ev:
-                got += 1
-                if got >= n_rows:
-                    done.set()
+            if line.startswith('{"change":'):
+                done_counts[k] += 1
+                if done_counts[k] >= n_rows:
                     return
+            elif line.startswith('{"error":'):
+                raise RuntimeError(f"subscriber {k} got error frame: {line}")
 
-    sub_task = asyncio.ensure_future(subscriber())
+    sub_tasks = [asyncio.ensure_future(subscriber(k)) for k in range(n_subs)]
     try:
-        await asyncio.sleep(0.5)  # subscription established
+        await asyncio.sleep(0.5 + 0.01 * n_subs)  # subscriptions established
 
         t0 = time.monotonic()
         for start in range(0, n_rows, batch):
@@ -70,34 +121,84 @@ async def main(n_rows: int, batch: int) -> dict:
             ]
             await client.execute(stmts)
         write_wall = time.monotonic() - t0
-        # wait on the subscriber TASK, not just the event: a subscriber
+        # wait on the subscriber TASKS, not just an event: a subscriber
         # crash must surface its real exception, not a bare TimeoutError
-        await asyncio.wait_for(sub_task, 300)
+        await asyncio.wait_for(asyncio.gather(*sub_tasks), 600)
         total_wall = time.monotonic() - t0
 
+        got = sum(done_counts)
+        rung = f"pubsub-{n_rows}" + (
+            f"x{n_subs}{'d' if distinct else ''}" if n_subs != 1 else ""
+        )
         return {
-            "rung": f"pubsub-{n_rows}",
+            "rung": rung + (f"-{tag}" if tag else ""),
             "n_rows": n_rows,
+            "n_subs": n_subs,
+            "distinct_matchers": bool(distinct and n_subs != 1),
             "batch": batch,
             "write_wall_s": round(write_wall, 2),
             "events_delivered": got,
             "event_rate_per_s": round(got / total_wall, 1),
+            "event_rate_per_sub_per_s": round(got / n_subs / total_wall, 1),
             "write_rate_per_s": round(n_rows / write_wall, 1),
             "total_wall_s": round(total_wall, 2),
+            "code_sha": _code_fingerprint(),
+            "measured_at": time.strftime(
+                "%Y-%m-%d %H:%M:%S", time.gmtime()
+            ),
         }
     finally:
-        sub_task.cancel()
+        for t in sub_tasks:
+            t.cancel()
         await client.close()
-        await sub_client.close()
+        for sc in sub_clients:
+            await sc.close()
         await api.stop()
         from corrosion_tpu.agent.run import shutdown
 
         await shutdown(agent)
 
 
+# the banked grid: rows axis at 1 sub, subs axis at 5k rows (shared
+# matcher via dedupe), plus one distinct-matcher rung for the
+# matcher-count scaling trajectory
+ALL_RUNGS = (
+    (5_000, 50, 1, False),
+    (20_000, 50, 1, False),
+    (80_000, 50, 1, False),
+    (5_000, 50, 16, False),
+    (5_000, 50, 128, False),
+    (5_000, 50, 16, True),
+)
+
+
+def _run_and_merge(rungs, tag: str) -> None:
+    recs = []
+    for n_rows, batch, n_subs, distinct in rungs:
+        rec = asyncio.run(main(n_rows, batch, n_subs, tag, distinct))
+        print(json.dumps(rec), flush=True)
+        recs.append(rec)
+    merge_records(os.path.join(REPO, "PUBSUB_BENCH.json"), recs)
+
+
 if __name__ == "__main__":
-    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 20_000
-    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 50
-    rec = asyncio.run(main(n_rows, batch))
-    merge_records(os.path.join(REPO, "PUBSUB_BENCH.json"), [rec])
-    print(json.dumps(rec))
+    args = sys.argv[1:]
+    tag = ""
+    if "--tag" in args:
+        i = args.index("--tag")
+        tag = args[i + 1]
+        del args[i : i + 2]
+    distinct = "--distinct" in args
+    if distinct:
+        args.remove("--distinct")
+    if "--all" in args:
+        _run_and_merge(ALL_RUNGS, tag)
+        sys.exit(0)
+    n_subs = 1
+    if "--subs" in args:
+        i = args.index("--subs")
+        n_subs = int(args[i + 1])
+        del args[i : i + 2]
+    n_rows = int(args[0]) if args else 20_000
+    batch = int(args[1]) if len(args) > 1 else 50
+    _run_and_merge([(n_rows, batch, n_subs, distinct)], tag)

@@ -121,6 +121,21 @@ def run_async(coro):
     return asyncio.run(coro)
 
 
+async def get_ev(q, timeout=5.0):
+    """Pop ONE event from a matcher attach() queue.  Since r10 queue
+    items are whole diff batches (lists of SubEvent) — one put per
+    subscriber per diff — so single-event consumers buffer the rest on
+    the queue object.  None / SubDead sentinels pass through bare."""
+    buf = getattr(q, "_evbuf", [])
+    while not buf:
+        item = await asyncio.wait_for(q.get(), timeout)
+        if not isinstance(item, list):
+            return item
+        buf = list(item)
+    q._evbuf = buf[1:]
+    return buf[0]
+
+
 def test_initial_materialization_and_incremental():
     async def main():
         store = make_store()
@@ -145,33 +160,33 @@ def test_initial_materialization_and_incremental():
                 "INSERT INTO users (id, name, age) VALUES (3, 'cyn', 44)",
             )
         )
-        ev = await asyncio.wait_for(q.get(), 5)
+        ev = await get_ev(q)
         assert (ev.kind, ev.values) == ("insert", ["cyn"])
 
         # update matching row's projected col → update event
         subs.match_changes(
             write(store, "UPDATE users SET name = 'ann2' WHERE id = 1")
         )
-        ev = await asyncio.wait_for(q.get(), 5)
+        ev = await get_ev(q)
         assert (ev.kind, ev.values) == ("update", ["ann2"])
 
         # row falls out of the predicate → delete event
         subs.match_changes(
             write(store, "UPDATE users SET age = 10 WHERE id = 3")
         )
-        ev = await asyncio.wait_for(q.get(), 5)
+        ev = await get_ev(q)
         assert (ev.kind, ev.values) == ("delete", ["cyn"])
 
         # row enters the predicate → insert event
         subs.match_changes(
             write(store, "UPDATE users SET age = 18 WHERE id = 2")
         )
-        ev = await asyncio.wait_for(q.get(), 5)
+        ev = await get_ev(q)
         assert (ev.kind, ev.values) == ("insert", ["bob"])
 
         # real DELETE → delete event
         subs.match_changes(write(store, "DELETE FROM users WHERE id = 1"))
-        ev = await asyncio.wait_for(q.get(), 5)
+        ev = await get_ev(q)
         assert (ev.kind, ev.values) == ("delete", ["ann2"])
 
         # change ids are monotonically increasing from 1
@@ -207,7 +222,7 @@ def test_join_subscription():
                 " VALUES (1, 2, 'world')",
             )
         )
-        ev = await asyncio.wait_for(q.get(), 5)
+        ev = await get_ev(q)
         assert (ev.kind, ev.values) == ("insert", ["ann", "world"])
 
         # renaming the user updates every joined row
@@ -216,7 +231,7 @@ def test_join_subscription():
         )
         got = {}
         for _ in range(2):
-            ev = await asyncio.wait_for(q.get(), 5)
+            ev = await get_ev(q)
             got[tuple(ev.values)] = ev.kind
         assert got == {("ANN", "hello"): "update", ("ANN", "world"): "update"}
         await subs.stop_all()
@@ -239,8 +254,8 @@ def test_dedupe_and_catch_up():
             write(store, "INSERT INTO users (id, name) VALUES (2, 'b')")
         )
         q = h1.attach()
-        ev1 = await asyncio.wait_for(q.get(), 5)
-        ev2 = await asyncio.wait_for(q.get(), 5)
+        ev1 = await get_ev(q)
+        ev2 = await get_ev(q)
         h1.detach(q)
 
         # catch-up replays the log after a given change id
@@ -281,7 +296,7 @@ def test_restore_from_disk(tmp_path):
         # AND the missed delete (reference: match_changes_from_db_version)
         got = {}
         for _ in range(2):
-            ev = await asyncio.wait_for(q.get(), 5)
+            ev = await get_ev(q)
             got[ev.values[0]] = ev.kind
         assert got == {"late": "insert", "a": "delete"}
         rows = h.matcher.all_rows()
@@ -433,7 +448,7 @@ def test_self_join_subscription():
             write(store, "UPDATE users SET name = 'bobby' WHERE id = 2")
         )
         evs = []
-        ev = await asyncio.wait_for(q.get(), 5)
+        ev = await get_ev(q)
         evs.append(ev)
         # 'bobby' row update seen via ref b; ref a row (bob, age 0) has no
         # partner so stays out
@@ -444,7 +459,7 @@ def test_self_join_subscription():
         subs.match_changes(
             write(store, "UPDATE users SET age = 99 WHERE id = 1")
         )
-        ev = await asyncio.wait_for(q.get(), 5)
+        ev = await get_ev(q)
         assert (ev.kind, ev.values) == ("delete", ["ann", "bobby"])
         await subs.stop_all()
 
@@ -478,7 +493,7 @@ def test_left_join_null_extension_diffs():
         )
         got = {}
         for _ in range(2):
-            ev = await asyncio.wait_for(q.get(), 5)
+            ev = await get_ev(q)
             got[(ev.kind, tuple(ev.values))] = True
         assert ("insert", ("ann", "T")) in got
         assert ("delete", ("ann", None)) in got
@@ -491,7 +506,7 @@ def test_left_join_null_extension_diffs():
         )
         got = {}
         for _ in range(2):
-            ev = await asyncio.wait_for(q.get(), 5)
+            ev = await get_ev(q)
             got[(ev.kind, tuple(ev.values))] = True
         assert ("delete", ("ann", "T")) in got
         assert ("insert", ("ann", None)) in got

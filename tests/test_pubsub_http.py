@@ -225,6 +225,70 @@ def test_subscription_exactly_once_under_concurrent_writers():
     asyncio.run(main())
 
 
+def test_matcher_death_surfaces_typed_error_frame():
+    """r10 regression: a matcher whose diff loop dies mid-stream must
+    end every attached subscription with an {"error": ...} frame that
+    carries the failure — not a hang, and not an AttributeError from a
+    bare None sentinel.  Catch-up by id from the dead sub must 404."""
+
+    async def main():
+        net = MemNetwork(seed=42)
+        a, api, client = await boot_with_api(net, "agent-dead")
+        try:
+            stream = client.subscribe(
+                "SELECT id, text FROM tests", skip_rows=True
+            )
+            it = stream.__aiter__()
+            await next_of(it, "eoq")
+            qid = stream.query_id
+
+            # live event proves the stream works, then kill the matcher
+            await insert(a, 1, "alive")
+            await next_of(it, "change")
+
+            handle = api.subs.get(qid)
+
+            def boom(_cands):
+                raise RuntimeError("diff exploded (injected)")
+
+            handle.matcher.handle_candidates = boom
+            await insert(a, 2, "doomed")
+
+            ev = await asyncio.wait_for(it.__anext__(), 15)
+            while "error" not in ev:
+                ev = await asyncio.wait_for(it.__anext__(), 15)
+            assert "diff exploded" in ev["error"], ev
+            assert handle.error is not None
+
+            # stream ended cleanly after the error frame
+            with __import__("pytest").raises(StopAsyncIteration):
+                await asyncio.wait_for(it.__anext__(), 15)
+
+            # catch-up on the dead sub is refused, not hung
+            s2 = client.subscribe(
+                "SELECT id, text FROM tests", from_change=0
+            )
+            s2.query_id = qid
+            it2 = s2.__aiter__()
+            got_err = None
+            try:
+                async for ev in it2:
+                    if "error" in ev:
+                        got_err = ev
+                        break
+            except Exception as e:  # 404 surfaces as ClientError
+                got_err = {"error": str(e)}
+            assert got_err is not None
+        finally:
+            await client.close()
+            await api.stop()
+            from corrosion_tpu.agent.run import shutdown
+
+            await shutdown(a)
+
+    asyncio.run(main())
+
+
 def test_subscription_rows_across_sign_boundary():
     """Regression: integer pks 128..255 pack into a sign-ambiguous byte
     upstream (encoder/decoder asymmetry, pubsub.rs:2315-2340 vs get_int)
