@@ -53,6 +53,9 @@ class _Pending:
     payload: bytes = field(compare=False)
     origin: bytes = field(compare=False)  # actor id bytes to exclude
     send_count: int = field(compare=False, default=0)
+    # origin commit wall clock (r11 latency plane): stamps the
+    # commit→wire hop when the FIRST transmission happens
+    origin_wall: Optional[float] = field(compare=False, default=None)
 
 
 async def broadcast_loop(agent: Agent) -> None:
@@ -95,6 +98,12 @@ async def broadcast_loop(agent: Agent) -> None:
                     payload=payload,
                     origin=item.change.actor_id.bytes16,
                     send_count=0,
+                    # only the ORIGIN node's own fresh changes stamp the
+                    # commit→wire hop; relayed changes already counted
+                    # theirs at their origin
+                    origin_wall=(
+                        item.change.origin_ts if item.is_local else None
+                    ),
                 ),
             )
 
@@ -142,6 +151,11 @@ async def _transmit(agent: Agent, bucket: TokenBucket, p: _Pending) -> bool:
         return False
 
     targets: List[Actor] = []
+    if p.send_count == 0 and p.origin_wall is not None:
+        # commit→wire: broadcast batching + queue delay at the origin
+        from corrosion_tpu.runtime.latency import e2e_observe
+
+        e2e_observe("broadcast", time.time() - p.origin_wall)
     if p.send_count == 0:
         # ring0 gets first-transmission priority (mod.rs:591-651)
         targets.extend(

@@ -40,8 +40,10 @@ class BroadcastInput:
     is_local: bool  # True = AddBroadcast, False = Rebroadcast
 
 
-# subs/updates hook: called with every batch of impactful committed changes
-ChangeHook = Callable[[List[Change]], None]
+# subs/updates hook: called with every batch of impactful committed
+# changes plus the batch's latency stamp (runtime/latency.py BatchStamp;
+# origin may be None when no stamp traveled with the changes)
+ChangeHook = Callable[..., None]
 
 
 @dataclass
@@ -75,6 +77,9 @@ class Agent:
     # live-query + raw-update managers (agent.rs:64-273 subs/updates)
     subs: Optional[object] = None  # SubsManager
     updates: Optional[object] = None  # UpdatesManager
+    # r11 SLO plane: per-agent latency-objective monitor
+    # (runtime/latency.py SloMonitor), checked by /v1/slo + the canary
+    slo: Optional[object] = None
     # instrumented-lock registry (agent.rs:707-1066), admin `locks` command
     lock_registry: LockRegistry = field(default_factory=LockRegistry)
 
@@ -86,19 +91,30 @@ class Agent:
     def cluster_id(self) -> ClusterId:
         return self.actor.cluster_id
 
-    def notify_change_hooks(self, changes: List[Change]) -> None:
+    def notify_change_hooks(
+        self, changes: List[Change], origin_wall: Optional[float] = None
+    ) -> None:
         """Feed one committed batch to the subs/updates hooks.  Runs on
         whatever thread committed (write path / ingest worker): the
         histogram makes the per-batch hook cost visible so a routing
         regression back to O(subs × changes) shows up as a rising
-        write-path tax, not a mystery throughput loss."""
+        write-path tax, not a mystery throughput loss.
+
+        r11: the batch's latency stamp travels with it — `applied` is
+        NOW (the commit that produced these changes just happened on
+        this thread), `origin` is the origin node's commit wall clock
+        when it rode the envelope here (None otherwise).  The matcher
+        measures apply→event against it and the stream write measures
+        the end-to-end total."""
         import time as _time
 
+        from corrosion_tpu.runtime.latency import BatchStamp
         from corrosion_tpu.runtime.metrics import METRICS
 
+        stamp = BatchStamp(origin=origin_wall, applied=_time.time())
         start = _time.monotonic()
         for hook in list(self.change_hooks):
-            hook(changes)
+            hook(changes, stamp)
         METRICS.histogram("corro.agent.changes.hooks.seconds").observe(
             _time.monotonic() - start
         )

@@ -243,8 +243,24 @@ def process_multiple_changes(
             changes = process_fully_buffered(agent, actor_id, version)
             all_impactful.extend(changes)
 
+    # r11 latency plane: commit→apply per stamped change (the origin
+    # wall stamp rode the broadcast/sync envelope here).  Cross-node
+    # wall-clock delta: e2e_observe clamps skew-negative values.  The
+    # OLDEST origin travels on to the hooks so apply→event and the
+    # end-to-end total attribute against the batch's worst element.
+    from corrosion_tpu.runtime.latency import e2e_observe
+
+    origin_min: Optional[float] = None
+    now_wall = time.time()
+    for cv, source in batch:
+        if cv.origin_ts is None:
+            continue
+        e2e_observe("apply", now_wall - cv.origin_ts, source=source.value)
+        if origin_min is None or cv.origin_ts < origin_min:
+            origin_min = cv.origin_ts
+
     if all_impactful:
-        agent.notify_change_hooks(all_impactful)
+        agent.notify_change_hooks(all_impactful, origin_min)
     METRICS.histogram("corro.agent.changes.processing.time.seconds").observe(
         time.monotonic() - start
     )

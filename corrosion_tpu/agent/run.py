@@ -61,8 +61,16 @@ async def setup(
 ) -> Agent:
     tripwire = tripwire or Tripwire()
     store = CrdtStore(config.db.path)
+    # the canary table is system-owned (created at runtime by the SLO
+    # canary probe, r11) and never appears in the user's schema files:
+    # carry a persisted one through the declarative re-apply, or a
+    # restart would be refused as a destructive table drop
+    canary_t = store.schema.tables.get(config.slo.canary_table)
+    canary_ddl = canary_t.raw_sql.rstrip(";") + ";" if canary_t else None
     for schema_path in config.db.schema_paths:
         sql = Path(schema_path).read_text()
+        if canary_ddl:
+            sql = sql + "\n" + canary_ddl
         store.apply_schema_sql(sql)
     clock = HLClock()
 
@@ -198,6 +206,16 @@ async def setup(
 
     agent.subs = SubsManager(store, config.db.subscriptions_path)
     agent.updates = UpdatesManager(store)
+
+    # r11 SLO plane: per-stage latency objectives + error-budget burn
+    from corrosion_tpu.runtime.latency import SloMonitor
+
+    agent.slo = SloMonitor(
+        targets=config.slo.targets,
+        objective=config.slo.objective,
+        window_secs=config.slo.window_secs,
+        breach_checks=config.slo.breach_checks,
+    )
     agent.change_hooks.append(agent.subs.match_changes)
     agent.change_hooks.append(agent.updates.match_changes)
 
@@ -230,7 +248,16 @@ async def run(agent: Agent) -> None:
             return
         if cv.actor_id == agent.actor_id:
             return  # our own broadcast reflected back
-        agent.tx_changes.try_send((cv, ChangeSource.BROADCAST))
+        if cv.traceparent:
+            # stitch the origin's span on the EAGER dissemination path
+            # too (sync already adopts the SyncStart traceparent); the
+            # traceparent stays ON the cv so a re-broadcast relays it
+            from corrosion_tpu.runtime.trace import continue_from
+
+            with continue_from(cv.traceparent, "broadcast.recv", peer=src):
+                agent.tx_changes.try_send((cv, ChangeSource.BROADCAST))
+        else:
+            agent.tx_changes.try_send((cv, ChangeSource.BROADCAST))
 
     async def on_bi(stream: BiStream) -> None:
         await serve_sync(agent, stream)
@@ -255,6 +282,11 @@ async def run(agent: Agent) -> None:
     t.spawn(member_states_loop(agent))
     t.spawn(resurrect_and_schedule_rejoin(agent))
     t.spawn(_announcer(agent))
+    if agent.config.slo.canary:
+        # opt-in end-to-end canary probe (r11): synthetic writes under a
+        # self-subscription, continuously measuring true write→event
+        # latency on the live cluster
+        t.spawn(canary_loop(agent))
     # db maintenance: WAL truncate ladder + incremental vacuum
     # (handlers.rs:379-547) — this is what makes perf.wal_threshold_gb live
     from corrosion_tpu.store.maintenance import vacuum_loop, wal_maintenance_loop
@@ -339,6 +371,130 @@ async def _announcer(agent: Agent) -> None:
             await asyncio.wait_for(agent.tripwire.wait(), delay)
 
 
+async def canary_loop(agent: Agent) -> None:
+    """The SLO canary (r11): write one tiny synthetic row per interval
+    to the canary table through the REAL public write path, watch it
+    come back through a REAL self-subscription, and record the observed
+    write→event latency — the ground-truth end-to-end measurement the
+    per-stage `corro.e2e.*` histograms decompose.
+
+    Every node keys its own row by actor id, so on a cluster each
+    node's subscription also receives the OTHER nodes' canary updates:
+    those measure true cross-node write→event latency from the origin
+    wall stamp embedded in the row (scope="remote", skew-clamped).
+    Each cycle also runs the agent's SloMonitor check, which is what
+    arms the sustained-breach incident dump on a live cluster."""
+    import time as _time
+
+    from corrosion_tpu.pubsub.matcher import SubDead
+    from corrosion_tpu.runtime.latency import e2e_observe
+    from corrosion_tpu.runtime.records import FLIGHT
+
+    cfg = agent.config.slo
+    table = cfg.canary_table
+
+    def ensure_table() -> None:
+        # additive re-apply: the schema engine diffs declaratively, so
+        # the canary table must be appended to the FULL current schema
+        # (not applied alone — that would unregister the user's tables)
+        if table in agent.store.schema.tables:
+            return
+        parts = []
+        for t in agent.store.schema.tables.values():
+            parts.append(t.raw_sql.rstrip(";") + ";")
+            for idx in t.indexes.values():
+                parts.append(idx.raw_sql.rstrip(";") + ";")
+        parts.append(
+            f'CREATE TABLE "{table}" (src TEXT NOT NULL PRIMARY KEY,'
+            " n INTEGER, wall REAL);"
+        )
+        agent.store.apply_schema_sql("\n".join(parts))
+
+    try:
+        await asyncio.to_thread(ensure_table)
+        handle, _created = await agent.subs.get_or_insert(
+            f'SELECT src, n, wall FROM "{table}"'
+        )
+    except Exception:
+        log.exception("canary disabled: table/subscription setup failed")
+        return
+    q = handle.attach()
+    src = str(agent.actor_id)
+    n = 0
+    loop = asyncio.get_running_loop()
+    try:
+        while not agent.tripwire.tripped:
+            n += 1
+            wall = _time.time()
+            try:
+                await make_broadcastable_changes(
+                    agent,
+                    lambda tx: [
+                        tx.execute(
+                            f'INSERT OR REPLACE INTO "{table}"'
+                            " (src, n, wall) VALUES (?, ?, ?)",
+                            [src, n, wall],
+                        )
+                    ],
+                )
+            except Exception:
+                METRICS.counter("corro.slo.canary.missed.total").inc()
+                await asyncio.sleep(cfg.canary_interval_secs)
+                continue
+            METRICS.counter("corro.slo.canary.writes.total").inc()
+            # drain subscription events until our own row's event lands
+            # (or the wait budget elapses → a miss); remote canary rows
+            # observed along the way measure cross-node latency
+            deadline = loop.time() + max(2.0, cfg.canary_interval_secs)
+            got = False
+            while not got:
+                timeout = deadline - loop.time()
+                if timeout <= 0:
+                    break
+                try:
+                    item = await asyncio.wait_for(q.get(), timeout)
+                except asyncio.TimeoutError:
+                    break
+                if item is None or isinstance(item, SubDead):
+                    return  # subscription torn down: canary ends
+                for ev in item:
+                    vals = ev.values
+                    if len(vals) < 3:
+                        continue
+                    if vals[0] == src:
+                        if vals[1] == n:
+                            lat = _time.time() - wall
+                            e2e_observe("canary", lat, scope="local")
+                            METRICS.gauge(
+                                "corro.slo.canary.last.seconds"
+                            ).set(lat)
+                            FLIGHT.record_host_frame(
+                                "canary",
+                                {"lat_us": int(lat * 1e6), "remote": 0},
+                            )
+                            got = True
+                    elif vals[2]:
+                        lat = e2e_observe(
+                            "canary",
+                            _time.time() - float(vals[2]),
+                            scope="remote",
+                        )
+                        FLIGHT.record_host_frame(
+                            "canary",
+                            {"lat_us": int(lat * 1e6), "remote": 1},
+                        )
+            if not got:
+                METRICS.counter("corro.slo.canary.missed.total").inc()
+            if agent.slo is not None:
+                agent.slo.check()
+            remain = (wall + cfg.canary_interval_secs) - _time.time()
+            if remain > 0:
+                with contextlib.suppress(asyncio.TimeoutError):
+                    await asyncio.wait_for(agent.tripwire.wait(), remain)
+    finally:
+        handle.detach(q)
+
+
 async def shutdown(agent: Agent) -> None:
     """Graceful: leave the cluster, trip, drain counted tasks ≤60 s."""
     with contextlib.suppress(Exception):
@@ -377,6 +533,22 @@ async def make_broadcastable_changes(
     `fn(tx)` executes statements against the WriteTx and returns
     per-statement results.
     """
+    from corrosion_tpu.runtime.trace import span
+
+    # one span per local write: its W3C context rides the broadcast
+    # envelope so remote applies stitch to this trace (r11 — the eager
+    # path's counterpart of the SyncStart traceparent)
+    with span("write.local") as write_span:
+        return await _make_broadcastable_changes_inner(
+            agent, fn, write_span.ctx.traceparent()
+        )
+
+
+async def _make_broadcastable_changes_inner(
+    agent: Agent, fn: Callable[["object"], List[object]], traceparent: str
+) -> ExecResult:
+    import time as _time
+
     # local client writes take the PRIORITY lane (agent.rs:586)
     async with agent.write_gate.priority():
         ts = agent.clock.new_timestamp()
@@ -404,7 +576,10 @@ async def make_broadcastable_changes(
         results, changes, db_version, last_seq = await asyncio.to_thread(txn)
 
     if changes:
-        agent.notify_change_hooks(changes)
+        # the ORIGIN stamp: wall clock at local commit — every
+        # corro.e2e.* stage downstream measures against this instant
+        origin_wall = _time.time()
+        agent.notify_change_hooks(changes, origin_wall)
         for chunk, seqs in chunk_changes(changes, last_seq):
             cv = ChangeV1(
                 actor_id=agent.actor_id,
@@ -415,6 +590,8 @@ async def make_broadcastable_changes(
                     last_seq=last_seq,
                     ts=ts,
                 ),
+                origin_ts=origin_wall,
+                traceparent=traceparent,
             )
             await agent.tx_bcast.send(BroadcastInput(change=cv, is_local=True))
     rows = sum(r for r in _int_results(results))

@@ -64,6 +64,20 @@ MAX_NEEDS_PER_TURN = 10  # peer/mod.rs: round-robin ≤10 needs/peer/turn
 VERSIONS_PER_CHUNK = 10  # chunk Full ranges to ≤10 versions
 RECV_TIMEOUT = 10.0
 
+# r11 latency plane: sync-served changesets carry an origin wall stamp
+# (envelope ext) only when the change is FRESH — live catch-up during
+# write traffic, the case the e2e `apply{source="sync"}` histogram is
+# meant to measure.  Cold bulk catch-up of hours-old versions is gated
+# out so it cannot masquerade as write→event latency.
+E2E_SYNC_FRESH_S = 60.0
+
+
+def _sync_origin(ts) -> "float | None":
+    if ts is None or ts.is_zero():
+        return None
+    wall = ts.to_unix()
+    return wall if 0 <= time.time() - wall < E2E_SYNC_FRESH_S else None
+
 # adaptive chunk sizing (peer/mod.rs:444-447, 808-869)
 CHUNK_TARGET_MAX = 8 * 1024  # grow back up to the 8 KiB target
 CHUNK_TARGET_FLOOR = 1024  # never below 1 KiB
@@ -210,6 +224,7 @@ async def _handle_need(
                 for chunk, seqs in chunk_changes(
                     changes, last_seq, max_bytes_fn=lambda: chunker.target
                 ):
+                    ts = chunk[-1].ts if chunk else Timestamp(0)
                     cv = ChangeV1(
                         actor_id=actor_id,
                         changeset=ChangesetFull(
@@ -217,8 +232,9 @@ async def _handle_need(
                             changes=tuple(chunk),
                             seqs=seqs,
                             last_seq=last_seq,
-                            ts=chunk[-1].ts if chunk else Timestamp(0),
+                            ts=ts,
                         ),
+                        origin_ts=_sync_origin(ts),
                     )
                     await chunker.timed_send(stream, encode_sync_msg(cv))
                     sent += len(chunk)
@@ -294,6 +310,7 @@ async def _handle_need(
             for chunk, chunk_seqs in _partial_chunks(
                 chosen, wanted, max_bytes_fn=lambda: chunker.target
             ):
+                ts = chunk[-1].ts if chunk else Timestamp(0)
                 cv = ChangeV1(
                     actor_id=actor_id,
                     changeset=ChangesetFull(
@@ -301,8 +318,9 @@ async def _handle_need(
                         changes=tuple(chunk),
                         seqs=chunk_seqs,
                         last_seq=last_seq,
-                        ts=chunk[-1].ts if chunk else Timestamp(0),
+                        ts=ts,
                     ),
+                    origin_ts=_sync_origin(ts),
                 )
                 await chunker.timed_send(stream, encode_sync_msg(cv))
                 sent += len(chunk)
@@ -313,6 +331,7 @@ async def _handle_need(
                 for chunk, seqs in chunk_changes(
                     changes, last_seq, max_bytes_fn=lambda: chunker.target
                 ):
+                    ts = chunk[-1].ts if chunk else Timestamp(0)
                     cv = ChangeV1(
                         actor_id=actor_id,
                         changeset=ChangesetFull(
@@ -320,8 +339,9 @@ async def _handle_need(
                             changes=tuple(chunk),
                             seqs=seqs,
                             last_seq=last_seq,
-                            ts=chunk[-1].ts if chunk else Timestamp(0),
+                            ts=ts,
                         ),
+                        origin_ts=_sync_origin(ts),
                     )
                     await chunker.timed_send(stream, encode_sync_msg(cv))
                     sent += len(chunk)

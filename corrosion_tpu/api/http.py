@@ -85,6 +85,7 @@ class ApiServer:
         app.router.add_post("/v1/updates/{table}", self.h_updates)
         app.router.add_get("/v1/status", self.h_status)
         app.router.add_get("/v1/flight", self.h_flight)
+        app.router.add_get("/v1/slo", self.h_slo)
         return app
 
     async def start(self) -> None:
@@ -452,6 +453,16 @@ class ApiServer:
                     "corro.subs.executor.submitted.total"
                 ),
             },
+            # r11 SLO plane pointer: the canary's live numbers (full
+            # per-stage percentiles live at GET /v1/slo)
+            "slo": {
+                "canary_enabled": agent.config.slo.canary,
+                "canary_writes": peek("corro.slo.canary.writes.total"),
+                "canary_missed": peek("corro.slo.canary.missed.total"),
+                "canary_last_seconds": peek(
+                    "corro.slo.canary.last.seconds"
+                ),
+            },
             "loop": {
                 "lag_max_seconds": peek(
                     "corro.runtime.loop.lag.max.seconds"
@@ -497,6 +508,69 @@ class ApiServer:
                 "event_lanes": list(KERNEL_EVENTS),
                 "census_lanes": list(FLIGHT_CENSUS),
                 "frames": frames,
+            }
+        )
+
+    async def h_slo(self, request: web.Request) -> web.Response:
+        """SLO latency plane (r11): per-stage windowed p50/p90/p99/p999
+        of the write→event path (`corro.e2e.*`), cumulative percentiles,
+        the configured targets, and error-budget burn per stage — the
+        question every perf round is judged by ("what is p99 write→event
+        latency right now"), answered from the log-bucketed windowed
+        histograms without a sorted-array pass.  `?window=K` overrides
+        the sliding window (seconds).  Checking ALSO advances the
+        breach tracker: a sustained breach trips a flight-recorder
+        incident dump, so polling this endpoint (or running the canary)
+        is what arms the black box."""
+        from corrosion_tpu.runtime.latency import SloMonitor
+
+        agent = self.agent
+        window: Optional[float] = None
+        raw = request.query.get("window")
+        if raw is not None:
+            try:
+                window = float(raw)
+            except ValueError:
+                raise web.HTTPBadRequest(text="window must be a number")
+            if window <= 0:
+                raise web.HTTPBadRequest(text="window must be positive")
+        slo = agent.slo
+        if slo is None:  # agents assembled without setup() (tests)
+            slo = agent.slo = SloMonitor(targets=agent.config.slo.targets)
+        stages = slo.check(window_secs=window)
+
+        snap = METRICS.snapshot()
+
+        def peek(name: str, default: float = 0.0, **labels) -> float:
+            for _kind, sname, slabels, value in snap:
+                if sname == name and slabels == labels:
+                    return value
+            return default
+
+        skew = {
+            labels["stage"]: value
+            for _k, name, labels, value in snap
+            if name == "corro.e2e.skew.clamped.total" and "stage" in labels
+        }
+        return web.json_response(
+            {
+                "actor_id": str(agent.actor_id),
+                "window_secs": window
+                if window is not None
+                else slo.window_secs,
+                "objective": slo.objective,
+                "stages": stages,
+                "skew_clamped": skew,
+                "canary": {
+                    "enabled": agent.config.slo.canary,
+                    "writes": peek("corro.slo.canary.writes.total"),
+                    "missed": peek("corro.slo.canary.missed.total"),
+                    "last_seconds": peek("corro.slo.canary.last.seconds"),
+                    "observed": peek(
+                        "corro.e2e.canary.seconds_count", scope="local"
+                    )
+                    + peek("corro.e2e.canary.seconds_count", scope="remote"),
+                },
             }
         )
 

@@ -22,6 +22,7 @@ dropped — every ChangeId is delivered exactly once, in order
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Any, List, Optional
 
 from aiohttp import web
@@ -209,6 +210,7 @@ async def _stream_sub(
                 except asyncio.QueueEmpty:
                     break
             chunks: List[bytes] = []
+            shipped: List[Any] = []
             terminal = None
             for item in pending:
                 if item is None or isinstance(item, SubDead):
@@ -218,6 +220,7 @@ async def _stream_sub(
                     # whole batch is post-replay (events are id-ordered):
                     # ship the ONE payload every subscriber shares
                     chunks.append(item.payload())
+                    shipped.append(item)
                 else:
                     lines = [
                         ev.line()
@@ -226,8 +229,23 @@ async def _stream_sub(
                     ]
                     if lines:
                         chunks.append(("\n".join(lines) + "\n").encode())
+                        shipped.append(item)
             if chunks:
                 await resp.write(b"".join(chunks))
+                # r11 latency plane: event→delivered per shipped batch,
+                # and origin-commit→delivered when the origin stamp
+                # traveled the whole path (skew-clamped: the origin may
+                # be another machine's clock)
+                from corrosion_tpu.runtime.latency import e2e_observe
+
+                now = time.time()
+                for item in shipped:
+                    ew = getattr(item, "event_wall", None)
+                    if ew is not None:
+                        e2e_observe("deliver", now - ew)
+                    og = getattr(item, "origin", None)
+                    if og is not None:
+                        e2e_observe("total", now - og)
             if terminal is None:
                 continue
             if isinstance(terminal, SubDead):  # matcher died

@@ -171,7 +171,7 @@ class SubsManager:
                 cands.update(handle.matcher.materialized_pks(t.name))
                 if cands:
                     handle.loop.call_soon_threadsafe(
-                        handle._queue.put_nowait, {t.name: cands}
+                        handle._queue.put_nowait, ({t.name: cands}, None)
                     )
 
     def _read_meta_sql(self, db: Path) -> str:
@@ -188,14 +188,15 @@ class SubsManager:
 
     # -- feeding -----------------------------------------------------------
 
-    def match_changes(self, changes: Sequence[Change]) -> None:
+    def match_changes(self, changes: Sequence[Change], stamp=None) -> None:
         """Change hook: route committed changes through the inverted
         index (updates.rs:424-488). Thread-safe. One dict hop per
         change, candidate pk sets accumulated per hit matcher —
         `filter_candidates` never runs here, and matchers whose
         (table, cid) index misses do no work at all. Dead matchers are
         skipped (their queue has no consumer) and torn down from the
-        loop."""
+        loop.  `stamp` (runtime/latency.py BatchStamp) rides with the
+        candidates so the matcher can attribute apply→event time."""
         router = self._router
         if not router:
             return
@@ -227,7 +228,7 @@ class SubsManager:
                     self._schedule_removal, handle.id
                 )
                 continue
-            handle.enqueue_candidates(cands)
+            handle.enqueue_candidates(cands, stamp)
 
     def _schedule_removal(self, sub_id: str) -> None:
         asyncio.ensure_future(self.remove(sub_id, purge=True))

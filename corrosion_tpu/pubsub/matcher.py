@@ -129,9 +129,15 @@ class EventBatch(list):
     replay filtering) a stream ships `payload()` — the same bytes
     object — so a 128-stream fan-out costs 128 socket writes, not
     128 × len(batch) string joins.  Subclasses list so event-level
-    consumers iterate it unchanged."""
+    consumers iterate it unchanged.
 
-    __slots__ = ("_payload",)
+    r11 latency stamps (set by `_fan_out`, read by the HTTP stream
+    write): `event_wall` is when the diff produced these events,
+    `origin` the origin node's commit wall clock when a stamp traveled
+    with the batch — what event→delivered and the end-to-end total are
+    measured against."""
+
+    __slots__ = ("_payload", "event_wall", "origin")
 
     def payload(self) -> bytes:
         """All events as NDJSON lines (newline-terminated), lazily
@@ -880,20 +886,25 @@ class MatcherHandle:
 
     # -- feeding (thread-safe; called from change hooks on any thread) -----
 
-    def match_changes(self, changes: Sequence[Change]) -> None:
+    def match_changes(self, changes: Sequence[Change], stamp=None) -> None:
         """Filter + enqueue. Standalone-handle path: a manager-owned
         handle receives pre-filtered candidates via
         `enqueue_candidates` from the routing index instead."""
-        self.enqueue_candidates(self.matcher.filter_candidates(changes))
+        self.enqueue_candidates(
+            self.matcher.filter_candidates(changes), stamp
+        )
 
     def enqueue_candidates(
-        self, cands: Dict[str, Set[bytes]]
+        self, cands: Dict[str, Set[bytes]], stamp=None
     ) -> None:
-        """Feed pre-filtered candidate pks (thread-safe)."""
+        """Feed pre-filtered candidate pks (thread-safe).  `stamp` is
+        the committed batch's latency stamp (BatchStamp) or None."""
         if not cands:
             return
         METRICS.counter("corro.subs.matched.count", id=self.id).inc(sum(len(v) for v in cands.values()))
-        self.loop.call_soon_threadsafe(self._queue.put_nowait, cands)
+        self.loop.call_soon_threadsafe(
+            self._queue.put_nowait, (cands, stamp)
+        )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -911,8 +922,9 @@ class MatcherHandle:
                 first = await self._queue.get()
                 if first is None:
                     break
+                cands, stamp = first
                 deadline = self.loop.time() + CANDIDATE_BATCH_WAIT
-                for t, pks in first.items():
+                for t, pks in cands.items():
                     batch.setdefault(t, set()).update(pks)
                     n += len(pks)
                 while n < CANDIDATE_BATCH_MAX:
@@ -928,7 +940,12 @@ class MatcherHandle:
                     if more is None:
                         self._queue.put_nowait(None)  # re-signal stop
                         break
-                    for t, pks in more.items():
+                    more_cands, more_stamp = more
+                    if more_stamp is not None:
+                        # coalesced batches keep the OLDEST stamp: the
+                        # batch's latency is its worst element's
+                        stamp = more_stamp.oldest(stamp)
+                    for t, pks in more_cands.items():
                         batch.setdefault(t, set()).update(pks)
                         n += len(pks)
                 events = await self._run_blocking(
@@ -936,7 +953,7 @@ class MatcherHandle:
                 )
                 self.processed += n
                 if events:
-                    self._fan_out(events)
+                    self._fan_out(events, stamp)
                 if time.monotonic() - last_prune > PRUNE_INTERVAL:
                     await self._run_blocking(self.matcher.prune_log)
                     last_prune = time.monotonic()
@@ -958,7 +975,7 @@ class MatcherHandle:
             return await self._executor.run(fn, *args)
         return await asyncio.to_thread(fn, *args)
 
-    def _fan_out(self, events: List[SubEvent]) -> None:
+    def _fan_out(self, events: List[SubEvent], stamp=None) -> None:
         """ONE queue put per subscriber per diff batch: each attached
         stream receives the same EventBatch (shared object — per-event
         encoding happened once in the diff, the wire payload encodes
@@ -966,6 +983,13 @@ class MatcherHandle:
         write.  Per-event-per-subscriber puts were the 128-stream
         fan-out's dominant loop cost."""
         batch = EventBatch(events)
+        batch.event_wall = time.time()
+        batch.origin = stamp.origin if stamp is not None else None
+        if stamp is not None:
+            # apply→event: candidate batching window + diff execution
+            from corrosion_tpu.runtime.latency import e2e_observe
+
+            e2e_observe("match", batch.event_wall - stamp.applied)
         with self._sub_lock:
             subs = list(self._subscribers)
         for q in subs:

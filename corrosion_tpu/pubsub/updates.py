@@ -178,7 +178,9 @@ class UpdatesManager:
     def handles(self) -> List[UpdateHandle]:
         return list(self._by_table.values())
 
-    def match_changes(self, changes: Sequence[Change]) -> None:
+    def match_changes(self, changes: Sequence[Change], stamp=None) -> None:
+        # `stamp` (the batch latency stamp the change hooks pass) is
+        # unused here: NotifyEvents carry no per-event payload to bill
         for h in list(self._by_table.values()):
             if h.error is None:  # dead handles drain nothing; skip
                 h.match_changes(changes)

@@ -109,6 +109,37 @@ class PerfConfig:
 
 
 @dataclass
+class SloConfig:
+    """[slo] — the write→event latency objectives served by GET /v1/slo
+    (r11).  `targets` maps e2e stage → latency target in seconds at the
+    `objective` quantile (a stage absent from the map is reported but
+    never judged); burn rate is the violating fraction over the error
+    budget `1 - objective`, and a burn > 1 sustained for
+    `breach_checks` consecutive checks trips a flight-recorder incident
+    dump.  The canary probe is opt-in: a background loop writing tiny
+    synthetic rows to `canary_table` under a self-subscription,
+    continuously measuring TRUE end-to-end write→event latency on a
+    live cluster (remote rows measure cross-node latency from their
+    embedded origin wall stamp)."""
+
+    window_secs: float = 60.0
+    objective: float = 0.99
+    targets: dict = field(
+        default_factory=lambda: {
+            "broadcast": 0.75,
+            "apply": 1.5,
+            "match": 1.5,
+            "deliver": 0.25,
+            "total": 3.0,
+        }
+    )
+    breach_checks: int = 3
+    canary: bool = False
+    canary_interval_secs: float = 1.0
+    canary_table: str = "corro_canary"
+
+
+@dataclass
 class AdminConfig:
     uds_path: str = "./admin.sock"
 
@@ -154,6 +185,7 @@ class Config:
     consul: ConsulConfig = field(default_factory=ConsulConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     log: LogConfig = field(default_factory=LogConfig)
+    slo: SloConfig = field(default_factory=SloConfig)
 
 
 _ENV_PREFIX = "CORRO_"

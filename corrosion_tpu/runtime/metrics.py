@@ -97,6 +97,10 @@ class Registry:
         self._counters: Dict[Tuple[str, LabelKey], Counter] = {}
         self._gauges: Dict[Tuple[str, LabelKey], Gauge] = {}
         self._histograms: Dict[Tuple[str, LabelKey], Histogram] = {}
+        # r11: windowed log-bucketed percentile histograms
+        # (runtime/latency.py WindowedLatency) — the write→event SLO
+        # substrate; carries its own internal lock like the others
+        self._latencies: Dict[Tuple[str, LabelKey], object] = {}
         self._lock = threading.Lock()
 
     def counter(self, name: str, **labels: str) -> Counter:
@@ -123,6 +127,29 @@ class Registry:
                 h = self._histograms[key] = Histogram()
             return h
 
+    def latency(self, name: str, **labels: str):
+        """Windowed percentile histogram (runtime/latency.py): log
+        buckets at ~5 % resolution, p50…p999 over the sliding window
+        and cumulative.  Use for every latency an SLO is judged on."""
+        from corrosion_tpu.runtime.latency import WindowedLatency
+
+        key = (name, _labels_key(labels))
+        with self._lock:
+            w = self._latencies.get(key)
+            if w is None:
+                w = self._latencies[key] = WindowedLatency()
+            return w
+
+    def latency_family(self, name: str):
+        """All label sets of one latency series, as (name, labels,
+        instrument) rows — what cross-label aggregation (the SLO plane)
+        iterates without minting series."""
+        with self._lock:
+            items = list(self._latencies.items())
+        return [
+            (n, dict(labels), w) for (n, labels), w in items if n == name
+        ]
+
     def snapshot(self) -> List[Tuple[str, str, Dict[str, str], float]]:
         """Point-in-time read of every series as (kind, name, labels,
         value) rows — the non-mutating peek the status plane renders
@@ -144,6 +171,12 @@ class Registry:
                 cnt, tot = h.count, h.total
             out.append(("histogram", name + "_count", dict(labels), cnt))
             out.append(("histogram", name + "_sum", dict(labels), tot))
+        with self._lock:
+            lats = list(self._latencies.items())
+        for (name, labels), w in lats:
+            c = w.snapshot_cumulative()
+            out.append(("latency", name + "_count", dict(labels), c.count))
+            out.append(("latency", name + "_sum", dict(labels), c.total))
         return out
 
     def render_prometheus(self) -> str:
@@ -183,6 +216,31 @@ class Registry:
                 )
                 out.append(f"{fmt(name + '_sum', labels)} {total}")
                 out.append(f"{fmt(name + '_count', labels)} {count}")
+            for (name, labels), w in sorted(self._latencies.items()):
+                # cumulative log buckets (sparse: only occupied edges —
+                # cumulative counts at the emitted le values stay exact)
+                # + summary-style windowed quantile gauges
+                from corrosion_tpu.runtime import latency as _lat
+
+                c = w.snapshot_cumulative()
+                cum = 0
+                for i, n in c.nonzero_buckets():
+                    cum += n
+                    out.append(
+                        f"{fmt(name + '_bucket', labels, {'le': format(_lat.bucket_upper(i), '.6g')})} {cum}"
+                    )
+                out.append(
+                    f"{fmt(name + '_bucket', labels, {'le': '+Inf'})} {c.count}"
+                )
+                out.append(f"{fmt(name + '_sum', labels)} {c.total}")
+                out.append(f"{fmt(name + '_count', labels)} {c.count}")
+                qs = w.quantiles(window_secs=_lat.DEFAULT_WINDOW_SECS)
+                for q in _lat.QUANTILES:
+                    v = qs[_lat._qname(q)]
+                    if v is not None:
+                        out.append(
+                            f"{fmt(name, labels, {'quantile': format(q, 'g'), 'window': format(_lat.DEFAULT_WINDOW_SECS, 'g')})} {v}"
+                        )
         return "\n".join(out) + "\n"
 
 

@@ -98,6 +98,15 @@ Changeset = object  # union: ChangesetEmpty | ChangesetEmptySet | ChangesetFull
 class ChangeV1:
     actor_id: ActorId
     changeset: object  # Changeset union
+    # r11 latency-plane envelope metadata (compare=False: identity is
+    # the change content; these ride the version-gated trailing ext of
+    # the broadcast/sync envelopes — types/codec.py — and old peers
+    # simply never see them).  `origin_ts` is the wall clock at the
+    # ORIGIN node's commit, the stamp every corro.e2e.* stage histogram
+    # measures against; `traceparent` stitches cross-node spans on the
+    # eager broadcast path (sync already carries one in SyncStart).
+    origin_ts: Optional[float] = field(default=None, compare=False)
+    traceparent: Optional[str] = field(default=None, compare=False)
 
     @property
     def versions(self) -> Tuple[int, int]:

@@ -262,6 +262,51 @@ def read_change_v1(r: Reader) -> ChangeV1:
     return ChangeV1(actor_id=ActorId(r.raw(16)), changeset=read_changeset(r))
 
 
+# -- envelope extension (r11 latency plane) --------------------------------
+#
+# A version-gated OPTIONAL trailing block appended after the last field
+# old decoders read.  Compatibility is structural in both directions:
+# old peers stop reading before the ext (trailing bytes are ignored, the
+# same default_on_eof tolerance the cluster_id field already relies on),
+# and new peers treat eof-before-ext as "no ext".  The block is only
+# written when it has content, so pre-r11 byte layouts are reproduced
+# exactly for unstamped payloads (golden tests stay valid).
+#
+#   ext := u8 version(=1) · opt<f64 origin_ts> · opt<string traceparent>
+
+_ENVELOPE_EXT_V1 = 1
+
+
+def _write_envelope_ext(
+    w: Writer, origin_ts: Optional[float], traceparent: Optional[str]
+) -> None:
+    if origin_ts is None and traceparent is None:
+        return
+    w.u8(_ENVELOPE_EXT_V1)
+    w.opt(origin_ts, w.f64)
+    w.opt(traceparent, w.string)
+
+
+def _read_envelope_ext(r: Reader) -> Tuple[Optional[float], Optional[str]]:
+    if r.eof():
+        return None, None
+    if r.u8() < _ENVELOPE_EXT_V1:  # pragma: no cover — never written
+        return None, None
+    origin_ts = r.opt(r.f64)
+    traceparent = r.opt(r.string)
+    return origin_ts, traceparent
+
+
+def _with_ext(
+    cv: ChangeV1, origin_ts: Optional[float], traceparent: Optional[str]
+) -> ChangeV1:
+    if origin_ts is None and traceparent is None:
+        return cv
+    from dataclasses import replace
+
+    return replace(cv, origin_ts=origin_ts, traceparent=traceparent)
+
+
 # -- UniPayload / BiPayload (derived, u32 tags) ----------------------------
 
 
@@ -272,6 +317,7 @@ def encode_uni_payload(cv: ChangeV1, cluster_id: ClusterId = ClusterId(0)) -> by
     w.u32(0)  # BroadcastV1::Change
     write_change_v1(w, cv)
     w.u16(cluster_id.value)
+    _write_envelope_ext(w, cv.origin_ts, cv.traceparent)
     return w.bytes()
 
 
@@ -281,6 +327,7 @@ def decode_uni_payload(data: bytes) -> Tuple[ChangeV1, ClusterId]:
         raise ValueError("unknown UniPayload variant")
     cv = read_change_v1(r)
     cluster_id = ClusterId(r.u16()) if not r.eof() else ClusterId(0)  # default_on_eof
+    cv = _with_ext(cv, *_read_envelope_ext(r))
     return cv, cluster_id
 
 
@@ -456,6 +503,9 @@ def encode_sync_msg(msg) -> bytes:
     elif isinstance(msg, ChangeV1):
         w.u32(_SYNC_CHANGESET)
         write_change_v1(w, msg)
+        # next to the W3C traceparent that already rides SyncStart:
+        # the origin wall stamp (freshness-gated by the sync server)
+        _write_envelope_ext(w, msg.origin_ts, msg.traceparent)
     elif isinstance(msg, Timestamp):
         w.u32(_SYNC_CLOCK)
         w.u64(msg.ntp64)
@@ -483,7 +533,8 @@ def decode_sync_msg(data: bytes):
     if tag == _SYNC_STATE:
         return _read_sync_state(r)
     if tag == _SYNC_CHANGESET:
-        return read_change_v1(r)
+        cv = read_change_v1(r)
+        return _with_ext(cv, *_read_envelope_ext(r))
     if tag == _SYNC_CLOCK:
         return Timestamp(r.u64())
     if tag == _SYNC_REJECTION:

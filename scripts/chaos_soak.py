@@ -4,9 +4,13 @@ Runs tests/test_chaos_soak.run_soak twice (different seeds — the
 flake-free-repeat requirement of VERDICT r4 #8) under
 CORRO_INVARIANTS=strict and writes CHAOS_SOAK.json.  Any
 always-invariant violation raises; the sometimes coverage contract is
-asserted inside the soak.
+asserted inside the soak.  r11 adds the SLO baseline phase: per-stage
+write→event percentiles (quiet / churn / degraded-writer scenarios on a
+3-node devcluster with the canary probe live) banked to
+SLO_BASELINE.json.
 
 Usage: python scripts/chaos_soak.py [seed1 seed2 ...]
+       python scripts/chaos_soak.py --phase slo   (SLO baseline only)
 """
 
 from __future__ import annotations
@@ -155,6 +159,153 @@ def flaky_node_phase(seeds=(3, 11)) -> dict:
             "runs": runs}
 
 
+def slo_baseline_phase(writes: int = 40) -> dict:
+    """r11: bank the first write→event SLO baseline — per-stage
+    percentiles (`corro.e2e.*`) from a 3-node devcluster under three
+    scenarios: quiet (steady writes), churn (a node bounced mid-run:
+    sync catch-up + regossip while writes flow), degraded (the writer's
+    traffic delayed 50 ms one-way through the mem-net fault knobs).
+    Every scenario runs the canary probe on all nodes and must produce
+    a non-empty percentile table for all five stages; the snapshot-diff
+    isolation (`latency.stage_report(before=...)`) keeps scenarios
+    exact despite the shared process registry."""
+    from corrosion_tpu.agent.membership import SwimConfig
+    from corrosion_tpu.devcluster import DevCluster, Topology
+    from corrosion_tpu.net.mem import MemNetwork
+    from corrosion_tpu.runtime import latency as lat
+
+    schema = (
+        "CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, text TEXT);"
+    )
+
+    async def run_scenario(name: str, seed: int) -> dict:
+        from corrosion_tpu.agent.run import (
+            canary_loop,
+            make_broadcastable_changes,
+        )
+        from corrosion_tpu.api.http import ApiServer
+        from corrosion_tpu.client import CorrosionApiClient
+
+        net = MemNetwork(seed=seed)
+        cluster = DevCluster(
+            Topology.parse("A -> C\nB -> C\n"),
+            schema,
+            network=net,
+            swim_config=SwimConfig(
+                probe_period=0.05, probe_rtt=0.02, suspicion_mult=1.0
+            ),
+        )
+        await cluster.start()
+        api = client = None
+        canaries = []
+        try:
+            await cluster.wait_converged(timeout=30.0)
+            writer = cluster.agents["A"]
+            subber = cluster.agents["C"]
+            subber.config.api.bind_addr = ["127.0.0.1:0"]
+            api = ApiServer(subber)
+            await api.start()
+            client = CorrosionApiClient(api.addrs[0])
+            stream = client.subscribe("SELECT id, text FROM tests")
+            it = stream.__aiter__()
+            while True:
+                ev = await asyncio.wait_for(it.__anext__(), 10)
+                if "eoq" in ev:
+                    break
+            for ag in cluster.agents.values():
+                ag.config.slo.canary = True
+                ag.config.slo.canary_interval_secs = 0.25
+                canaries.append(asyncio.ensure_future(canary_loop(ag)))
+            before = lat.snapshot_stages()
+            if name == "degraded":
+                net.degrade("A", latency=0.05)
+            got = 0
+            for i in range(writes):
+                if name == "churn" and i in (writes // 3, 2 * writes // 3):
+                    net.take_down("B")
+                    await asyncio.sleep(0.2)
+                    net.bring_up("B")
+                await make_broadcastable_changes(
+                    writer,
+                    lambda tx, i=i: [
+                        tx.execute(
+                            "INSERT OR REPLACE INTO tests (id, text) "
+                            "VALUES (?, ?)",
+                            [i, f"{name}-{i}"],
+                        )
+                    ],
+                )
+                while got <= i:
+                    ev = await asyncio.wait_for(it.__anext__(), 30)
+                    if "change" in ev:
+                        got += 1
+            await asyncio.sleep(1.2)  # canary cycles + sync stragglers
+            rep = lat.stage_report(before=before)
+            for stage in lat.E2E_STAGES:
+                assert rep[stage]["count"] > 0, (
+                    f"slo baseline {name}: stage {stage} observed nothing"
+                )
+            # the live plane serves the same stages over HTTP
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://{api.addrs[0]}/v1/slo"
+                ) as resp:
+                    assert resp.status == 200
+                    slo_body = await resp.json()
+            canary_n = sum(
+                w.snapshot_cumulative().count
+                for _n, _l, w in lat._registry().latency_family(
+                    "corro.e2e.canary.seconds"
+                )
+            )
+            return {
+                "writes": writes,
+                "stages": rep,
+                "canary_probes_cumulative": canary_n,
+                "slo_breached_now": {
+                    s: slo_body["stages"][s]["breached"]
+                    for s in slo_body["stages"]
+                },
+            }
+        finally:
+            for c in canaries:
+                c.cancel()
+            for c in canaries:
+                try:
+                    await c
+                except (asyncio.CancelledError, Exception):
+                    pass
+            if client is not None:
+                await client.close()
+            if api is not None:
+                await api.stop()
+            await cluster.stop()
+
+    out: dict = {"scenarios": {}}
+    for i, name in enumerate(("quiet", "churn", "degraded")):
+        t0 = time.monotonic()
+        rec = asyncio.new_event_loop().run_until_complete(
+            asyncio.wait_for(run_scenario(name, seed=97 + i), 600)
+        )
+        rec["wall_s"] = round(time.monotonic() - t0, 1)
+        out["scenarios"][name] = rec
+        p99 = rec["stages"]["total"]["p99"]
+        print(
+            f"slo baseline {name}: total p99="
+            f"{p99 * 1e3 if p99 else float('nan'):.1f}ms "
+            f"counts={{"
+            + ", ".join(
+                f"{s}: {rec['stages'][s]['count']}"
+                for s in rec["stages"]
+            )
+            + "}}",
+            flush=True,
+        )
+    return out
+
+
 def _bank(update: dict) -> None:
     """Merge keys into CHAOS_SOAK.json, preserving phases not re-run."""
     path = os.path.join(REPO, "CHAOS_SOAK.json")
@@ -172,6 +323,18 @@ def _bank(update: dict) -> None:
         json.dump(record, f, indent=1)
 
 
+def _bank_slo_baseline(slo: dict) -> None:
+    """SLO_BASELINE.json: the write→event percentile baseline the next
+    perf rounds (ingest, sync catch-up) are judged against — its own
+    artifact (not CHAOS_SOAK.json) because those rounds re-bank it."""
+    path = os.path.join(REPO, "SLO_BASELINE.json")
+    slo["code"] = _soak_fingerprint()
+    slo["measured_at"] = time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime())
+    with open(path, "w") as f:
+        json.dump(slo, f, indent=1)
+    print(f"wrote {path}", flush=True)
+
+
 def main() -> None:
     args = sys.argv[1:]
     phase_only = None
@@ -179,6 +342,14 @@ def main() -> None:
         i = args.index("--phase")
         phase_only = args[i + 1]
         args = args[:i] + args[i + 2:]
+    if phase_only == "slo":
+        t0 = time.monotonic()
+        slo = slo_baseline_phase()
+        slo["wall_s"] = round(time.monotonic() - t0, 1)
+        _bank_slo_baseline(slo)
+        print(json.dumps({"metric": "chaos_soak", "phase": "slo",
+                          "scenarios": sorted(slo["scenarios"])}))
+        return
     if phase_only == "flaky-node":
         t0 = time.monotonic()
         fl = flaky_node_phase()
@@ -211,6 +382,10 @@ def main() -> None:
     t0 = time.monotonic()
     flaky = flaky_node_phase()
     flaky["wall_s"] = round(time.monotonic() - t0, 1)
+    t0 = time.monotonic()
+    slo = slo_baseline_phase()
+    slo["wall_s"] = round(time.monotonic() - t0, 1)
+    _bank_slo_baseline(slo)
     _bank({
         "mode": "strict",
         "runs": runs,

@@ -6,7 +6,8 @@ emitting call site — otherwise dashboards rot silently (the reference's
 `metrics.rs` principle: the inventory IS the contract).  Wired as a
 tier-1 test (`tests/test_metrics_lint.py`) so drift fails CI.
 
-What counts as a call site: any `<registry>.counter(/gauge(/histogram(`
+What counts as a call site: any
+`<registry>.counter(/gauge(/histogram(/latency(`
 whose first argument is a string literal (possibly on the next line),
 scanned over `corrosion_tpu/` and `scripts/`.  f-string names (one site:
 the write-gate lane gauges) are matched as wildcards — every table entry
@@ -26,7 +27,7 @@ from typing import Dict, List, Set, Tuple
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _CALL_RE = re.compile(
-    r"\.(counter|gauge|histogram)\(\s*(f?)\"([^\"\n]+)\"", re.S
+    r"\.(counter|gauge|histogram|latency)\(\s*(f?)\"([^\"\n]+)\"", re.S
 )
 _TABLE_ROW_RE = re.compile(r"^\|\s*`([^`]+)`\s*\|")
 

@@ -17,8 +17,9 @@ points must share a platform to be comparable.
 
 Usage:  python scripts/obs_report.py
 Env:    OBS_REPORT_N (default 2048), OBS_REPORT_SLOTS (default 256),
-        OBS_REPORT_MAX_TICKS (default 600), OBS_REPORT_OUT (path
-        override, default OBS_REPORT.md)
+        OBS_REPORT_MAX_TICKS (default 600), OBS_REPORT_E2E_WRITES
+        (default 30 — the SLO section's write→event workload),
+        OBS_REPORT_OUT (path override, default OBS_REPORT.md)
 """
 
 from __future__ import annotations
@@ -149,6 +150,165 @@ def render_flight_section(emit, kernel: str = "pview", window: int = 64):
     emit()
 
 
+def _run_e2e_workload(writes: int) -> None:
+    """Drive the write→event path so the SLO section has real samples:
+    two in-process agents over a mem network, an HTTP subscription on B,
+    `writes` cross-node writes on A, and the canary probe running on
+    both nodes for a few cycles (its remote rows measure cross-node
+    latency from the embedded origin wall stamp)."""
+    import asyncio
+
+    async def workload() -> None:
+        from corrosion_tpu.agent.run import (
+            canary_loop,
+            make_broadcastable_changes,
+            run,
+            setup,
+            shutdown,
+        )
+        from corrosion_tpu.api.http import ApiServer
+        from corrosion_tpu.client import CorrosionApiClient
+        from corrosion_tpu.net.mem import MemNetwork
+        from corrosion_tpu.runtime.config import Config
+        from corrosion_tpu.runtime.tmpdb import fresh_db_path
+
+        net = MemNetwork(seed=41)
+        agents, apis, clients = [], [], []
+
+        async def boot(name: str, bootstrap=()):
+            cfg = Config()
+            cfg.db.path = fresh_db_path(name)
+            cfg.gossip.bind_addr = name
+            cfg.gossip.bootstrap = list(bootstrap)
+            cfg.perf.broadcast_interval_ms = 20
+            cfg.perf.apply_queue_timeout_ms = 5
+            cfg.api.bind_addr = ["127.0.0.1:0"]
+            a = await setup(cfg, network=net)
+            a.store.apply_schema_sql(
+                "CREATE TABLE obs_e2e "
+                "(id INTEGER NOT NULL PRIMARY KEY, text TEXT);"
+            )
+            await run(a)
+            api = ApiServer(a)
+            await api.start()
+            agents.append(a)
+            apis.append(api)
+            clients.append(CorrosionApiClient(api.addrs[0]))
+            return a
+
+        a = await boot("obs-a")
+        b = await boot("obs-b", ["obs-a"])
+        canaries = []
+        try:
+            loop = asyncio.get_event_loop()
+            deadline = loop.time() + 15
+            while (
+                len(a.members) < 1 or len(b.members) < 1
+            ) and loop.time() < deadline:
+                await asyncio.sleep(0.02)
+            stream = clients[1].subscribe("SELECT id, text FROM obs_e2e")
+            it = stream.__aiter__()
+            while True:
+                ev = await asyncio.wait_for(it.__anext__(), 10)
+                if "eoq" in ev:
+                    break
+            for ag in (a, b):
+                ag.config.slo.canary = True
+                ag.config.slo.canary_interval_secs = 0.25
+                canaries.append(asyncio.ensure_future(canary_loop(ag)))
+            got = 0
+            for i in range(writes):
+                await make_broadcastable_changes(
+                    a,
+                    lambda tx, i=i: [
+                        tx.execute(
+                            "INSERT OR REPLACE INTO obs_e2e (id, text) "
+                            "VALUES (?, ?)",
+                            [i, f"w{i}"],
+                        )
+                    ],
+                )
+                while got <= i:
+                    ev = await asyncio.wait_for(it.__anext__(), 10)
+                    if "change" in ev:
+                        got += 1
+            await asyncio.sleep(1.5)  # a few canary cycles on each node
+        finally:
+            for c in canaries:
+                c.cancel()
+            for c in canaries:
+                try:
+                    await c
+                except (asyncio.CancelledError, Exception):
+                    pass
+            for cl in clients:
+                await cl.close()
+            for api in apis:
+                await api.stop()
+            for ag in agents:
+                await shutdown(ag)
+
+    asyncio.run(workload())
+
+
+def render_slo_section(emit, writes: int = 30) -> None:
+    """r11: the SLO latency plane — run a tiny e2e workload, then render
+    the per-stage write→event percentile table (what GET /v1/slo serves)
+    and the canary's measured round-trip sparkline."""
+    from corrosion_tpu.runtime import latency as lat
+
+    before = lat.snapshot_stages(METRICS)
+    frames_before = len(FLIGHT.window(10_000, kernel="canary"))
+    _run_e2e_workload(writes)
+    rep = lat.stage_report(before=before, registry=METRICS)
+
+    emit("## SLO latency plane (corro.e2e.*, write→event hop stamps)")
+    emit(
+        f"two-agent mem-net workload: {writes} cross-node writes + the "
+        "canary probe on both nodes; per-stage percentiles in ms "
+        "(~5 % log-bucket resolution, GET /v1/slo serves the same rows)"
+    )
+
+    def ms(v) -> str:
+        return f"{v * 1e3:>9.3f}" if v is not None else f"{'—':>9}"
+
+    emit(
+        f"{'stage':<10} {'count':>6} {'p50':>9} {'p90':>9} {'p99':>9} "
+        f"{'p999':>9} {'mean':>9}"
+    )
+    for stage in lat.E2E_STAGES:
+        row = rep[stage]
+        emit(
+            f"{stage:<10} {row['count']:>6} "
+            + " ".join(
+                ms(row[k]) for k in ("p50", "p90", "p99", "p999", "mean")
+            )
+        )
+    skew = sum(
+        v
+        for _k, name, labels, v in METRICS.snapshot()
+        if name == "corro.e2e.skew.clamped.total"
+    )
+    emit(f"skew_clamped_total={skew:.0f}")
+    emit()
+
+    frames = FLIGHT.window(10_000, kernel="canary")[frames_before:]
+    emit("## canary round trips (corro.e2e.canary.seconds)")
+    if not frames:
+        emit("(no canary frames recorded)")
+        emit()
+        return
+    series = [f["events"].get("lat_us", 0) / 1e3 for f in frames]
+    remote = sum(f["events"].get("remote", 0) for f in frames)
+    emit(
+        f"{len(series)} probes ({remote} cross-node); ms "
+        f"min={min(series):.3f} max={max(series):.3f} "
+        f"last={series[-1]:.3f}"
+    )
+    emit(f"trend {sparkline(series[-64:])}")
+    emit()
+
+
 def main() -> None:
     n = int(os.environ.get("OBS_REPORT_N", "2048"))
     slots = int(os.environ.get("OBS_REPORT_SLOTS", "256"))
@@ -191,6 +351,9 @@ def main() -> None:
     emit()
     render_registry_tables(emit, sim.ticks)
     render_flight_section(emit, kernel="pview")
+    render_slo_section(
+        emit, writes=int(os.environ.get("OBS_REPORT_E2E_WRITES", "30"))
+    )
 
     path = os.environ.get(
         "OBS_REPORT_OUT", os.path.join(REPO, "OBS_REPORT.md")

@@ -214,6 +214,12 @@ def test_backup_then_restore_roundtrip(tmp_path):
 
 
 def test_tls_generate(tmp_path):
+    # the CLI subcommand imports corrosion_tpu.tls in the subprocess,
+    # which needs the optional `cryptography` package
+    pytest.importorskip(
+        "cryptography",
+        reason="`tls generate` needs the optional `cryptography` package",
+    )
     ca_cert = tmp_path / "ca-cert.pem"
     ca_key = tmp_path / "ca-key.pem"
     r = run_cli(

@@ -275,3 +275,80 @@ def test_randomized_uni_roundtrip_fuzz():
         cv = ChangeV1(actor_id=aid, changeset=cs)
         out, _cluster = decode_uni_payload(encode_uni_payload(cv))
         assert out == cv, f"trial {trial}: {cv!r} != {out!r}"
+
+
+# -- r11 envelope ext: origin wall stamp + traceparent ----------------------
+
+
+def _stamped_cv(**ext):
+    return ChangeV1(
+        actor_id=ActorId(b"\x22" * 16),
+        changeset=ChangesetFull(
+            version=7,
+            changes=(mk_change(),),
+            seqs=(0, 0),
+            last_seq=0,
+            ts=Timestamp(11),
+        ),
+        **ext,
+    )
+
+
+def test_envelope_ext_roundtrip_uni_and_sync():
+    tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    cv = _stamped_cv(origin_ts=1722800000.125, traceparent=tp)
+    out, cid = decode_uni_payload(encode_uni_payload(cv, ClusterId(5)))
+    assert cid == ClusterId(5)
+    assert out.origin_ts == pytest.approx(1722800000.125)
+    assert out.traceparent == tp
+    assert out == cv  # ext fields are compare=False: identity unchanged
+
+    got = decode_sync_msg(encode_sync_msg(cv))
+    assert got.origin_ts == pytest.approx(1722800000.125)
+    assert got.traceparent == tp
+
+    # each stamp travels independently
+    only_ts = _stamped_cv(origin_ts=2.5)
+    out2, _ = decode_uni_payload(encode_uni_payload(only_ts))
+    assert out2.origin_ts == pytest.approx(2.5)
+    assert out2.traceparent is None
+
+
+def test_envelope_ext_old_new_compat():
+    """Both directions of the version-gate: an unstamped (old-layout)
+    payload decodes on a new peer with empty ext, a NEW stamped payload
+    decodes on an OLD peer (which stops reading at cluster_id and
+    ignores the trailing ext — the same default_on_eof tolerance the
+    cluster_id field itself relies on)."""
+    from corrosion_tpu.types.codec import Reader, read_change_v1
+
+    plain = _stamped_cv()
+    stamped = _stamped_cv(
+        origin_ts=123.5, traceparent="00-" + "11" * 16 + "-" + "22" * 8 + "-01"
+    )
+
+    # old payload → new decoder: unstamped bytes are byte-identical to
+    # the pre-r11 layout (the ext block is only written when non-empty)
+    data_old = encode_uni_payload(plain, ClusterId(1))
+    out, cid = decode_uni_payload(data_old)
+    assert (out.origin_ts, out.traceparent) == (None, None)
+    assert cid == ClusterId(1)
+    data_new = encode_uni_payload(stamped, ClusterId(1))
+    assert len(data_new) > len(data_old)
+    assert data_new[: len(data_old)] == data_old  # strictly trailing ext
+
+    # new payload → OLD decoder (emulated pre-r11 read path)
+    r = Reader(data_new)
+    # UniPayload::V1 / UniPayloadV1::Broadcast / BroadcastV1::Change
+    assert (r.u32(), r.u32(), r.u32()) == (0, 0, 0)
+    old_cv = read_change_v1(r)
+    old_cid = ClusterId(r.u16())
+    assert old_cv == plain
+    assert old_cid == ClusterId(1)
+    assert not r.eof()  # the ext bytes are simply left unread
+
+    # same property on the sync wire
+    sync_old = encode_sync_msg(plain)
+    sync_new = encode_sync_msg(stamped)
+    assert sync_new[: len(sync_old)] == sync_old
+    assert decode_sync_msg(sync_old).origin_ts is None

@@ -19,6 +19,9 @@ def test_obs_report_renders_event_counters(tmp_path):
         OBS_REPORT_N="256",
         OBS_REPORT_SLOTS="32",
         OBS_REPORT_MAX_TICKS="400",
+        # each cross-node write pays the matcher's 600 ms candidate
+        # batching window — keep the tier-1 replica tiny
+        OBS_REPORT_E2E_WRITES="5",
         OBS_REPORT_OUT=str(out),
     )
     proc = subprocess.run(
@@ -44,3 +47,11 @@ def test_obs_report_renders_event_counters(tmp_path):
     assert m, "no gossip_emitted sparkline row"
     assert re.search(r"^census_alive\s+", text, re.M)
     assert re.search(r"^suspect_raised\s+", text, re.M)
+    # r11: the SLO latency section renders non-empty per-stage rows from
+    # a real write→event workload plus the canary round-trip sparkline
+    assert "## SLO latency plane" in text
+    for stage in ("broadcast", "apply", "match", "deliver", "total"):
+        m = re.search(rf"^{stage}\s+(\d+)\s", text, re.M)
+        assert m and int(m.group(1)) > 0, f"stage {stage} has no samples"
+    assert "## canary round trips" in text
+    assert re.search(r"^trend [▁▂▃▄▅▆▇█]+$", text, re.M)

@@ -174,7 +174,7 @@ class _Spy:
         handle.enqueue_candidates = self.enqueue  # type: ignore
         handle.matcher.filter_candidates = self.filter  # type: ignore
 
-    def enqueue(self, cands):
+    def enqueue(self, cands, stamp=None):
         self.enqueued.append(cands)
 
     def filter(self, changes):

@@ -15,6 +15,14 @@ import ssl
 
 import pytest
 
+# the whole module drives cert generation through corrosion_tpu.tls,
+# which needs the optional `cryptography` package — skip cleanly (not a
+# collection error) on images without it
+pytest.importorskip(
+    "cryptography",
+    reason="gossip-plane TLS needs the optional `cryptography` package",
+)
+
 from corrosion_tpu import tls
 from corrosion_tpu.net.tcp import TcpListener, TcpTransport
 from corrosion_tpu.runtime.config import Config, GossipTlsConfig

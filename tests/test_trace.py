@@ -65,6 +65,35 @@ def test_trace_context_rides_sync_start_wire():
     assert tr.parse_traceparent(got_trace.traceparent).trace_id == sp.ctx.trace_id
 
 
+def test_trace_context_rides_eager_broadcast_wire():
+    """r11: the eager dissemination path carries a traceparent too (sync
+    already does via SyncStart), so cross-node spans stitch on BOTH
+    paths.  The stamp rides the version-gated envelope ext of the uni
+    payload."""
+    from corrosion_tpu.types.base import Timestamp
+    from corrosion_tpu.types.change import ChangeV1, ChangesetEmpty
+    from corrosion_tpu.types.codec import (
+        decode_uni_payload,
+        encode_uni_payload,
+    )
+
+    aid = ActorId.new_random()
+    with tr.span("write.local") as sp:
+        cv = ChangeV1(
+            actor_id=aid,
+            changeset=ChangesetEmpty(versions=(3, 3), ts=Timestamp(9)),
+            traceparent=sp.ctx.traceparent(),
+        )
+        frame = encode_uni_payload(cv, ClusterId(2))
+    got, got_cid = decode_uni_payload(frame)
+    assert got_cid == ClusterId(2)
+    assert tr.parse_traceparent(got.traceparent).trace_id == sp.ctx.trace_id
+    # the receiver adopts it exactly like the sync server does
+    with tr.continue_from(got.traceparent, "broadcast.recv") as child:
+        assert child.ctx.trace_id == sp.ctx.trace_id
+        assert child.ctx.span_id != sp.ctx.span_id
+
+
 def test_timed_query_counts_slow():
     import time as _time
 
