@@ -88,7 +88,18 @@ async def broadcast_loop(agent: Agent) -> None:
 
         now = time.monotonic()
         for item in batch:
-            payload = encode_uni_payload(item.change, agent.cluster_id)
+            # r12: offer the envelope ext to the observatory — a digest
+            # (own or relayed) piggybacks the broadcast plane the same
+            # way it rides gossip datagrams; uni frames have no packet
+            # budget, so any digest size fits
+            digest = (
+                agent.observatory.pick_ext(1 << 20, plane="broadcast")
+                if agent.observatory is not None
+                else None
+            )
+            payload = encode_uni_payload(
+                item.change, agent.cluster_id, digest=digest
+            )
             seq += 1
             heapq.heappush(
                 pending,

@@ -80,6 +80,9 @@ class Agent:
     # r11 SLO plane: per-agent latency-objective monitor
     # (runtime/latency.py SloMonitor), checked by /v1/slo + the canary
     slo: Optional[object] = None
+    # r12 cluster observatory (agent/observatory.py): digest
+    # anti-entropy store + view-divergence detector, serves /v1/cluster
+    observatory: Optional[object] = None
     # instrumented-lock registry (agent.rs:707-1066), admin `locks` command
     lock_registry: LockRegistry = field(default_factory=LockRegistry)
 

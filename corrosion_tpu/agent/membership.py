@@ -187,6 +187,14 @@ class Membership:
         self._ring_set: set = set()  # O(1) membership for the hot add path
         self._probe_pos = 0
         self._tasks: List[asyncio.Task] = []
+        # r12 cluster observatory hooks (agent/observatory.py): every
+        # outgoing datagram offers its spare packet budget to
+        # `digest_source(budget) -> encoded digest | None`, and every
+        # received digest ext is handed to `on_digest(src, bytes)`.
+        # Both default None — standalone Membership instances (tests,
+        # sims) gossip exactly the pre-r12 bytes.
+        self.digest_source: Optional[Callable[[int], Optional[bytes]]] = None
+        self.on_digest: Optional[Callable[[str, bytes], None]] = None
 
     # -- public surface ----------------------------------------------------
 
@@ -295,6 +303,13 @@ class Membership:
     async def _send(self, addr: str, msg: SwimMessage) -> None:
         self._piggyback(msg)
         data = encode_swim(msg)
+        if self.digest_source is not None:
+            # offer the packet's remaining budget to the observatory;
+            # the trailing ext keeps digest-free bytes byte-identical
+            ext = self.digest_source(MAX_PACKET - len(data))
+            if ext is not None:
+                msg.digest = ext
+                data = encode_swim(msg)
         try:
             await self.transport.send_datagram(addr, data)
             METRICS.counter("corro.gossip.message.sent", kind=msg.kind.name).inc()
@@ -470,6 +485,8 @@ class Membership:
             )
         for u in msg.updates:
             self._apply_update(u, via=msg.sender.id)
+        if msg.digest is not None and self.on_digest is not None:
+            self.on_digest(src, msg.digest)
 
         k, me = msg.kind, self.identity
         if k == MsgKind.PING:

@@ -50,7 +50,7 @@ from corrosion_tpu.store.crdt import CrdtStore
 from corrosion_tpu.types.actor import Actor, ClusterId
 from corrosion_tpu.types.base import HLClock, Timestamp
 from corrosion_tpu.types.change import ChangeV1, ChangesetFull, chunk_changes
-from corrosion_tpu.types.codec import decode_uni_payload
+from corrosion_tpu.types.codec import decode_uni_payload_ext
 from corrosion_tpu.types.rangeset import RangeSet
 
 
@@ -204,7 +204,11 @@ async def setup(
     # live-query + raw-update engines fed from every committed batch
     from corrosion_tpu.pubsub import SubsManager, UpdatesManager
 
-    agent.subs = SubsManager(store, config.db.subscriptions_path)
+    agent.subs = SubsManager(
+        store,
+        config.db.subscriptions_path,
+        batch_wait=config.pubsub.candidate_batch_wait,
+    )
     agent.updates = UpdatesManager(store)
 
     # r11 SLO plane: per-stage latency objectives + error-budget burn
@@ -216,6 +220,18 @@ async def setup(
         window_secs=config.slo.window_secs,
         breach_checks=config.slo.breach_checks,
     )
+
+    # r12 cluster observatory: telemetry digests piggyback the gossip
+    # datagrams (hooks below) + broadcast envelopes (broadcast_loop);
+    # received digests feed the anti-entropy store behind /v1/cluster
+    if config.cluster.digests:
+        from corrosion_tpu.agent.observatory import Observatory
+
+        agent.observatory = Observatory(agent)
+        membership.digest_source = agent.observatory.pick_ext
+        membership.on_digest = (
+            lambda _src, data: agent.observatory.receive(data)
+        )
     agent.change_hooks.append(agent.subs.match_changes)
     agent.change_hooks.append(agent.updates.match_changes)
 
@@ -240,12 +256,17 @@ async def run(agent: Agent) -> None:
 
     async def on_uni(src: str, frame: bytes) -> None:
         try:
-            cv, cluster_id = decode_uni_payload(frame)
+            cv, cluster_id, dig = decode_uni_payload_ext(frame)
         except (ValueError, IndexError):
             METRICS.counter("corro.agent.uni.decode.failed").inc()
             return
         if cluster_id != agent.cluster_id:
             return
+        if dig is not None and agent.observatory is not None:
+            # r12: a telemetry digest rode the broadcast envelope ext —
+            # adopt it even when the CHANGE is our own reflected back
+            # (the relaying peer picked the digest, not the origin)
+            agent.observatory.receive(dig)
         if cv.actor_id == agent.actor_id:
             return  # our own broadcast reflected back
         if cv.traceparent:
@@ -287,6 +308,11 @@ async def run(agent: Agent) -> None:
         # self-subscription, continuously measuring true write→event
         # latency on the live cluster
         t.spawn(canary_loop(agent))
+    if agent.observatory is not None:
+        # r12: periodic digest build/dissemination + divergence checks
+        from corrosion_tpu.agent.observatory import observatory_loop
+
+        t.spawn(observatory_loop(agent))
     # db maintenance: WAL truncate ladder + incremental vacuum
     # (handlers.rs:379-547) — this is what makes perf.wal_threshold_gb live
     from corrosion_tpu.store.maintenance import vacuum_loop, wal_maintenance_loop

@@ -86,6 +86,7 @@ class ApiServer:
         app.router.add_get("/v1/status", self.h_status)
         app.router.add_get("/v1/flight", self.h_flight)
         app.router.add_get("/v1/slo", self.h_slo)
+        app.router.add_get("/v1/cluster", self.h_cluster)
         return app
 
     async def start(self) -> None:
@@ -438,6 +439,9 @@ class ApiServer:
             # the shared diff executor is backing up (depth > workers =
             # matchers queueing for a diff slot)
             "subscriptions": {
+                # r12: the matcher's candidate-batching window — the
+                # knob the SLO plane named as the match-stage p50 floor
+                "candidate_batch_wait": agent.config.pubsub.candidate_batch_wait,
                 "count": len(self.subs.handles()) if self.subs else 0,
                 "streams": sum(
                     h.subscriber_count for h in self.subs.handles()
@@ -573,6 +577,22 @@ class ApiServer:
                 },
             }
         )
+
+    async def h_cluster(self, request: web.Request) -> web.Response:
+        """Cluster observatory plane (r12): the CLUSTER-wide answer any
+        single node can serve — digest coverage/staleness per node,
+        per-node health roll-up (census, LHM, loop lag, sync backlog),
+        exact cluster-merged write→event stage percentiles (the gossiped
+        digests carry mergeable histograms), and the view-divergence
+        verdict.  Serving rebuilds the local digest and runs one
+        divergence check, so polling this endpoint also advances
+        detection — same discipline as /v1/slo's breach tracker."""
+        obs = self.agent.observatory
+        if obs is None:
+            raise web.HTTPNotImplemented(
+                text="cluster observatory disabled ([cluster] digests=false)"
+            )
+        return web.json_response(obs.cluster_report())
 
     # -- pubsub routes (wired when managers are attached) ------------------
 

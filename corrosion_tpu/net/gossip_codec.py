@@ -57,6 +57,16 @@ class SwimMessage:
     target: Optional[Actor] = None  # PING_REQ/INDIRECT_*: who to probe
     origin: Optional[Actor] = None  # INDIRECT_*: who asked
     updates: List[MemberUpdate] = field(default_factory=list)
+    # r12 cluster observatory: an encoded telemetry digest
+    # (runtime/digest.py) riding a version-gated TRAILING ext — opaque
+    # bytes here, same compat discipline as the broadcast envelope ext
+    # (types/codec.py): digest-free packets are byte-identical to the
+    # pre-r12 layout and old decoders stop reading before the ext
+    digest: Optional[bytes] = None
+
+
+# trailing-ext version byte (only written when a digest rides along)
+_SWIM_EXT_V1 = 1
 
 
 def write_actor(w: Writer, a: Actor) -> None:
@@ -110,6 +120,9 @@ def encode_swim(msg: SwimMessage) -> bytes:
         write_actor(w, u.actor)
         w.u32(u.incarnation)
         w.u8(int(u.state))
+    if msg.digest is not None:
+        w.u8(_SWIM_EXT_V1)
+        w.vec_u8(msg.digest)
     return w.bytes()
 
 
@@ -125,6 +138,9 @@ def decode_swim(data: bytes) -> SwimMessage:
         MemberUpdate(read_actor(r), r.u32(), MemberState(r.u8()))
         for _ in range(n)
     ]
+    digest = None
+    if not r.eof() and r.u8() >= _SWIM_EXT_V1 and not r.eof():
+        digest = r.vec_u8()
     return SwimMessage(
         kind=kind,
         probe_no=probe_no,
@@ -132,4 +148,5 @@ def decode_swim(data: bytes) -> SwimMessage:
         target=target,
         origin=origin,
         updates=updates,
+        digest=digest,
     )

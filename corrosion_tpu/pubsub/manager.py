@@ -49,9 +49,17 @@ Router = Dict[str, Dict[str, Tuple[MatcherHandle, ...]]]
 class SubsManager:
     """Registry of running matchers, keyed by id and by SQL hash."""
 
-    def __init__(self, store, subs_path: Optional[str] = None):
+    def __init__(
+        self,
+        store,
+        subs_path: Optional[str] = None,
+        batch_wait: Optional[float] = None,
+    ):
         self.store = store
         self.subs_path = subs_path
+        # matcher candidate-batching window ([pubsub] candidate_batch_wait,
+        # r12); None keeps the per-matcher pubsub.rs-parity default
+        self.batch_wait = batch_wait
         self._by_id: Dict[str, MatcherHandle] = {}
         self._by_hash: Dict[str, str] = {}  # sql hash -> id
         self._lock = asyncio.Lock()
@@ -112,7 +120,10 @@ class SubsManager:
                 matcher.close()
                 self._purge_dir(sub_id)
                 raise ParseError(str(e)) from e
-            handle = MatcherHandle(matcher, loop, executor=self.executor)
+            handle = MatcherHandle(
+                matcher, loop, executor=self.executor,
+                batch_wait=self.batch_wait,
+            )
             handle.start()
             self._by_id[sub_id] = handle
             self._by_hash[sql_hash(sql)] = sub_id
@@ -144,7 +155,8 @@ class SubsManager:
                 shutil.rmtree(d, ignore_errors=True)
                 continue
             handle = MatcherHandle(
-                matcher, asyncio.get_running_loop(), executor=self.executor
+                matcher, asyncio.get_running_loop(), executor=self.executor,
+                batch_wait=self.batch_wait,
             )
             handle.start()
             self._by_id[d.name] = handle

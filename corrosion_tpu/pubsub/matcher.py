@@ -847,6 +847,7 @@ class MatcherHandle:
         matcher: Matcher,
         loop: asyncio.AbstractEventLoop,
         executor=None,
+        batch_wait: Optional[float] = None,
     ):
         self.matcher = matcher
         self.loop = loop
@@ -855,6 +856,12 @@ class MatcherHandle:
         # shared bounded DiffExecutor (pubsub/executor.py) when owned by
         # a SubsManager; None falls back to asyncio.to_thread
         self._executor = executor
+        # candidate-batching window: config [pubsub] candidate_batch_wait
+        # (r12 — the knob the r11 SLO plane named as the ~600 ms p50
+        # `match` culprit); None keeps the pubsub.rs-parity default
+        self.batch_wait = (
+            batch_wait if batch_wait is not None else CANDIDATE_BATCH_WAIT
+        )
         self._queue: asyncio.Queue = asyncio.Queue()
         self._subscribers: List[asyncio.Queue] = []
         self._sub_lock = threading.Lock()
@@ -912,8 +919,8 @@ class MatcherHandle:
         self._task = self.loop.create_task(self._cmd_loop())
 
     async def _cmd_loop(self) -> None:
-        """Batch candidates 600 ms / 1000 entries then diff
-        (pubsub.rs:1062-1226)."""
+        """Batch candidates `batch_wait` s / 1000 entries then diff
+        (pubsub.rs:1062-1226; window configurable since r12)."""
         last_prune = time.monotonic()
         try:
             while True:
@@ -923,7 +930,7 @@ class MatcherHandle:
                 if first is None:
                     break
                 cands, stamp = first
-                deadline = self.loop.time() + CANDIDATE_BATCH_WAIT
+                deadline = self.loop.time() + self.batch_wait
                 for t, pks in cands.items():
                     batch.setdefault(t, set()).update(pks)
                     n += len(pks)

@@ -140,6 +140,45 @@ class SloConfig:
 
 
 @dataclass
+class PubsubConfig:
+    """[pubsub] — live-query matcher knobs.  `candidate_batch_wait` is
+    the matcher's candidate-batching window in seconds (pubsub.rs:1069
+    parity default 0.6): the PR-6 SLO plane attributed today's ~600 ms
+    p50 write→event total to exactly this wait, so it is now an
+    operator knob (surfaced in /v1/status) — lower it to trade matcher
+    batching efficiency for `corro.e2e.match` latency."""
+
+    candidate_batch_wait: float = 0.6
+
+
+@dataclass
+class ClusterObsConfig:
+    """[cluster] — the r12 cluster observatory (agent/observatory.py).
+    Each node builds a telemetry digest every `digest_interval_secs`
+    and piggybacks it on the gossip/broadcast planes; aggregation is
+    freshest-per-node with digests older than `stale_after_secs`
+    excluded from /v1/cluster merges.  The view-divergence detector
+    opens an episode (one flight-recorder incident dump) after
+    `divergence_checks` consecutive divergent checks; an ACTIVE member
+    whose digests stop arriving for `silent_after_secs` (default:
+    `silent_after_mult × digest_interval_secs`) counts as divergent,
+    and remembered view hashes are compared for
+    `divergence_memory_secs` after the last digest."""
+
+    digests: bool = True
+    digest_interval_secs: float = 2.0
+    stale_after_secs: float = 20.0
+    silent_after_secs: float = 0.0  # 0 → silent_after_mult × interval
+    # the silence threshold must undercut the SWIM suspicion window
+    # (~9 s at n=3 defaults) or the membership downs a partitioned peer
+    # before the observatory can flag the divergence: 2.5 × 2 s = 5 s
+    # silence + 2 divergent checks ≈ 9 s worst-case detection
+    silent_after_mult: float = 2.5
+    divergence_checks: int = 2
+    divergence_memory_secs: float = 120.0
+
+
+@dataclass
 class AdminConfig:
     uds_path: str = "./admin.sock"
 
@@ -186,6 +225,8 @@ class Config:
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     log: LogConfig = field(default_factory=LogConfig)
     slo: SloConfig = field(default_factory=SloConfig)
+    pubsub: PubsubConfig = field(default_factory=PubsubConfig)
+    cluster: ClusterObsConfig = field(default_factory=ClusterObsConfig)
 
 
 _ENV_PREFIX = "CORRO_"

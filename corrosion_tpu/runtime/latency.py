@@ -146,6 +146,25 @@ class LatencyHistogram:
     def nonzero_buckets(self) -> List[Tuple[int, int]]:
         return sorted(self.counts.items())
 
+    def to_sparse(self) -> Tuple[List[Tuple[int, int]], float]:
+        """(sorted (bucket, count) pairs, total) — the exact state a
+        digest wire codec needs (`runtime/digest.py`): `from_sparse`
+        reconstructs an identical histogram, so merge-of-decoded ≡
+        decode-of-merged holds bucket-for-bucket."""
+        return self.nonzero_buckets(), self.total
+
+    @classmethod
+    def from_sparse(
+        cls, pairs: Sequence[Tuple[int, int]], total: float
+    ) -> "LatencyHistogram":
+        out = cls()
+        for i, c in pairs:
+            if c > 0:
+                out.counts[int(i)] = out.counts.get(int(i), 0) + int(c)
+        out.count = sum(out.counts.values())
+        out.total = float(total)
+        return out
+
 
 class WindowedLatency:
     """A cumulative LatencyHistogram + a ring of time-slot

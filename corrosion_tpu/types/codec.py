@@ -85,6 +85,17 @@ class Writer:
             self.u8(1)
             write_fn(v)
 
+    def uvarint(self, v: int):
+        """LEB128 unsigned varint — the compact-integer encoding the
+        r12 telemetry-digest codec uses (runtime/digest.py); NOT part of
+        any reference speedy layout."""
+        if v < 0:
+            raise ValueError(f"uvarint of negative {v}")
+        while v >= 0x80:
+            self.buf.append((v & 0x7F) | 0x80)
+            v >>= 7
+        self.buf.append(v)
+
     def bytes(self) -> bytes:
         return bytes(self.buf)
 
@@ -132,6 +143,18 @@ class Reader:
 
     def opt(self, read_fn):
         return read_fn() if self.u8() else None
+
+    def uvarint(self) -> int:
+        out = 0
+        shift = 0
+        while True:
+            b = self.u8()
+            out |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return out
+            shift += 7
+            if shift > 63:
+                raise ValueError("uvarint too long")
 
     def eof(self) -> bool:
         return self.pos >= len(self.data)
@@ -262,7 +285,7 @@ def read_change_v1(r: Reader) -> ChangeV1:
     return ChangeV1(actor_id=ActorId(r.raw(16)), changeset=read_changeset(r))
 
 
-# -- envelope extension (r11 latency plane) --------------------------------
+# -- envelope extension (r11 latency plane, r12 telemetry digests) ---------
 #
 # A version-gated OPTIONAL trailing block appended after the last field
 # old decoders read.  Compatibility is structural in both directions:
@@ -272,29 +295,48 @@ def read_change_v1(r: Reader) -> ChangeV1:
 # written when it has content, so pre-r11 byte layouts are reproduced
 # exactly for unstamped payloads (golden tests stay valid).
 #
-#   ext := u8 version(=1) · opt<f64 origin_ts> · opt<string traceparent>
+#   ext v1 := u8 version(=1) · opt<f64 origin_ts> · opt<string traceparent>
+#   ext v2 := u8 version(=2) · opt<f64 origin_ts> · opt<string traceparent>
+#             · vec<u8> digest          (r12: an encoded telemetry digest,
+#                                        runtime/digest.py — opaque here)
+#
+# v2 is only written when a digest rides along, so v1 readers (which
+# read the stamps and ignore anything after) parse v2 payloads, and
+# digest-free payloads stay byte-identical to the r11 layout.
 
 _ENVELOPE_EXT_V1 = 1
+_ENVELOPE_EXT_V2 = 2
 
 
 def _write_envelope_ext(
-    w: Writer, origin_ts: Optional[float], traceparent: Optional[str]
+    w: Writer,
+    origin_ts: Optional[float],
+    traceparent: Optional[str],
+    digest: Optional[bytes] = None,
 ) -> None:
-    if origin_ts is None and traceparent is None:
+    if origin_ts is None and traceparent is None and digest is None:
         return
-    w.u8(_ENVELOPE_EXT_V1)
+    w.u8(_ENVELOPE_EXT_V2 if digest is not None else _ENVELOPE_EXT_V1)
     w.opt(origin_ts, w.f64)
     w.opt(traceparent, w.string)
+    if digest is not None:
+        w.vec_u8(digest)
 
 
-def _read_envelope_ext(r: Reader) -> Tuple[Optional[float], Optional[str]]:
+def _read_envelope_ext(
+    r: Reader,
+) -> Tuple[Optional[float], Optional[str], Optional[bytes]]:
     if r.eof():
-        return None, None
-    if r.u8() < _ENVELOPE_EXT_V1:  # pragma: no cover — never written
-        return None, None
+        return None, None, None
+    ver = r.u8()
+    if ver < _ENVELOPE_EXT_V1:  # pragma: no cover — never written
+        return None, None, None
     origin_ts = r.opt(r.f64)
     traceparent = r.opt(r.string)
-    return origin_ts, traceparent
+    digest = (
+        r.vec_u8() if ver >= _ENVELOPE_EXT_V2 and not r.eof() else None
+    )
+    return origin_ts, traceparent, digest
 
 
 def _with_ext(
@@ -310,24 +352,40 @@ def _with_ext(
 # -- UniPayload / BiPayload (derived, u32 tags) ----------------------------
 
 
-def encode_uni_payload(cv: ChangeV1, cluster_id: ClusterId = ClusterId(0)) -> bytes:
+def encode_uni_payload(
+    cv: ChangeV1,
+    cluster_id: ClusterId = ClusterId(0),
+    digest: Optional[bytes] = None,
+) -> bytes:
+    """`digest` (r12): an encoded telemetry digest piggybacking the
+    broadcast plane (agent/observatory.py) — rides the trailing envelope
+    ext, never changes digest-free bytes."""
     w = Writer()
     w.u32(0)  # UniPayload::V1
     w.u32(0)  # UniPayloadV1::Broadcast
     w.u32(0)  # BroadcastV1::Change
     write_change_v1(w, cv)
     w.u16(cluster_id.value)
-    _write_envelope_ext(w, cv.origin_ts, cv.traceparent)
+    _write_envelope_ext(w, cv.origin_ts, cv.traceparent, digest)
     return w.bytes()
 
 
-def decode_uni_payload(data: bytes) -> Tuple[ChangeV1, ClusterId]:
+def decode_uni_payload_ext(
+    data: bytes,
+) -> Tuple[ChangeV1, ClusterId, Optional[bytes]]:
+    """Like `decode_uni_payload` but also surfaces the piggybacked
+    telemetry digest bytes (None when the payload carries none)."""
     r = Reader(data)
     if r.u32() != 0 or r.u32() != 0 or r.u32() != 0:
         raise ValueError("unknown UniPayload variant")
     cv = read_change_v1(r)
     cluster_id = ClusterId(r.u16()) if not r.eof() else ClusterId(0)  # default_on_eof
-    cv = _with_ext(cv, *_read_envelope_ext(r))
+    origin_ts, traceparent, digest = _read_envelope_ext(r)
+    return _with_ext(cv, origin_ts, traceparent), cluster_id, digest
+
+
+def decode_uni_payload(data: bytes) -> Tuple[ChangeV1, ClusterId]:
+    cv, cluster_id, _digest = decode_uni_payload_ext(data)
     return cv, cluster_id
 
 
@@ -534,7 +592,8 @@ def decode_sync_msg(data: bytes):
         return _read_sync_state(r)
     if tag == _SYNC_CHANGESET:
         cv = read_change_v1(r)
-        return _with_ext(cv, *_read_envelope_ext(r))
+        origin_ts, traceparent, _digest = _read_envelope_ext(r)
+        return _with_ext(cv, origin_ts, traceparent)
     if tag == _SYNC_CLOCK:
         return Timestamp(r.u64())
     if tag == _SYNC_REJECTION:

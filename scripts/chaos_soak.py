@@ -10,7 +10,11 @@ write→event percentiles (quiet / churn / degraded-writer scenarios on a
 SLO_BASELINE.json.
 
 Usage: python scripts/chaos_soak.py [seed1 seed2 ...]
-       python scripts/chaos_soak.py --phase slo   (SLO baseline only)
+       python scripts/chaos_soak.py --phase slo      (SLO baseline only)
+       python scripts/chaos_soak.py --phase cluster  (r12 cluster
+           observatory: CLUSTER_OBS.json — 3-node devcluster x {quiet,
+           partition→heal, churn}, divergence detection-round latency +
+           one incident dump per episode)
 """
 
 from __future__ import annotations
@@ -306,6 +310,81 @@ def slo_baseline_phase(writes: int = 40) -> dict:
     return out
 
 
+def cluster_obs_phase() -> dict:
+    """r12: bank the cluster-observatory baseline — a 3-node devcluster
+    under {quiet, partition→heal, churn}, all through the shared
+    scenario harness (`models/cluster.py::cluster_observatory_scenario`)
+    whose internal pins already assert the exactness contract (cluster-
+    merged stage percentiles == merge of the per-node local histograms,
+    over HTTP on one node).  This phase adds the black-box accounting on
+    top: each scenario runs with a FRESH $CORRO_FLIGHT_DIR and the
+    number of `cluster_divergence` incident dumps must equal the number
+    of divergence episodes the agents recorded — exactly one dump per
+    episode, zero in quiet.  Headline number: detection latency in
+    digest rounds."""
+    import glob
+    import tempfile
+
+    from corrosion_tpu.models.cluster import cluster_observatory_scenario
+
+    out: dict = {"scenarios": {}}
+    for i, name in enumerate(("quiet", "partition", "churn")):
+        with tempfile.TemporaryDirectory() as flight_dir:
+            old = os.environ.get("CORRO_FLIGHT_DIR")
+            os.environ["CORRO_FLIGHT_DIR"] = flight_dir
+            try:
+                t0 = time.monotonic()
+                timeline: list = []
+                rec = asyncio.new_event_loop().run_until_complete(
+                    asyncio.wait_for(
+                        cluster_observatory_scenario(
+                            name, seed=211 + i, timeline=timeline
+                        ),
+                        300,
+                    )
+                )
+                rec["wall_s"] = round(time.monotonic() - t0, 1)
+                dumps = len(
+                    glob.glob(
+                        os.path.join(flight_dir, "*cluster_divergence*")
+                    )
+                )
+            finally:
+                if old is None:
+                    os.environ.pop("CORRO_FLIGHT_DIR", None)
+                else:
+                    os.environ["CORRO_FLIGHT_DIR"] = old
+        expected_dumps = rec.get("episodes_total", 0)
+        assert dumps == expected_dumps, (
+            f"cluster obs {name}: {dumps} incident dumps for "
+            f"{expected_dumps} divergence episodes"
+        )
+        rec["incident_dumps"] = dumps
+        rec["timeline"] = timeline[-64:]
+        out["scenarios"][name] = rec
+        msg = f"cluster obs {name}: coverage_rounds={rec['coverage_rounds']}"
+        if "detect_rounds" in rec:
+            msg += (
+                f" detect_rounds={rec['detect_rounds']}"
+                f" ({rec['detect_secs']}s)"
+                f" heal_rounds={rec['heal_rounds']}"
+                f" episodes={rec['episodes_total']} dumps={dumps}"
+            )
+        print(msg, flush=True)
+    return out
+
+
+def _bank_cluster_obs(rec: dict) -> None:
+    """CLUSTER_OBS.json: the cluster-observatory detection baseline —
+    its own artifact because topology/convergence rounds re-bank it."""
+    path = os.path.join(REPO, "CLUSTER_OBS.json")
+    rec["code"] = _soak_fingerprint()
+    rec["measured_at"] = time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime())
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(f"wrote {path}", flush=True)
+
+
 def _bank(update: dict) -> None:
     """Merge keys into CHAOS_SOAK.json, preserving phases not re-run."""
     path = os.path.join(REPO, "CHAOS_SOAK.json")
@@ -350,6 +429,19 @@ def main() -> None:
         print(json.dumps({"metric": "chaos_soak", "phase": "slo",
                           "scenarios": sorted(slo["scenarios"])}))
         return
+    if phase_only == "cluster":
+        t0 = time.monotonic()
+        cl = cluster_obs_phase()
+        cl["wall_s"] = round(time.monotonic() - t0, 1)
+        _bank_cluster_obs(cl)
+        print(json.dumps({
+            "metric": "chaos_soak", "phase": "cluster",
+            "detect_rounds": {
+                n: s.get("detect_rounds")
+                for n, s in cl["scenarios"].items()
+            },
+        }))
+        return
     if phase_only == "flaky-node":
         t0 = time.monotonic()
         fl = flaky_node_phase()
@@ -386,6 +478,10 @@ def main() -> None:
     slo = slo_baseline_phase()
     slo["wall_s"] = round(time.monotonic() - t0, 1)
     _bank_slo_baseline(slo)
+    t0 = time.monotonic()
+    cl = cluster_obs_phase()
+    cl["wall_s"] = round(time.monotonic() - t0, 1)
+    _bank_cluster_obs(cl)
     _bank({
         "mode": "strict",
         "runs": runs,

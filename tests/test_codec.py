@@ -352,3 +352,117 @@ def test_envelope_ext_old_new_compat():
     sync_new = encode_sync_msg(stamped)
     assert sync_new[: len(sync_old)] == sync_old
     assert decode_sync_msg(sync_old).origin_ts is None
+
+
+# -- r12 envelope ext v2 + SWIM trailing ext: telemetry digests -------------
+
+
+def test_envelope_ext_v2_digest_compat():
+    """Both directions of the r12 digest gate on the broadcast
+    envelope: digest-free payloads stay byte-identical to the r11
+    layout (v2 is only written when a digest rides along), and an
+    emulated r11 reader parses a digest-carrying v2 payload — it reads
+    the version byte (2 passes its `>= v1` gate), the two optional
+    stamps, and leaves the digest bytes unread."""
+    from corrosion_tpu.types.codec import (
+        Reader,
+        decode_uni_payload_ext,
+        read_change_v1,
+    )
+
+    dig = b"\x01" + b"opaque-digest-bytes" * 3
+    plain = _stamped_cv()
+    stamped = _stamped_cv(origin_ts=99.25)
+
+    # digest-free bytes: the digest kwarg existing changes nothing
+    assert encode_uni_payload(plain, ClusterId(1), digest=None) == (
+        encode_uni_payload(plain, ClusterId(1))
+    )
+    base = encode_uni_payload(stamped, ClusterId(1))
+    with_dig = encode_uni_payload(stamped, ClusterId(1), digest=dig)
+    assert len(with_dig) > len(base)
+
+    # new payload → new reader: the digest surfaces
+    cv, cid, got = decode_uni_payload_ext(with_dig)
+    assert got == dig
+    assert cid == ClusterId(1)
+    assert cv.origin_ts == pytest.approx(99.25)
+    # ...and the digest-less decode of the SAME bytes ignores it
+    cv2, _ = decode_uni_payload(with_dig)
+    assert cv2 == stamped
+
+    # digest-free payload → new reader: no digest
+    assert decode_uni_payload_ext(base)[2] is None
+
+    # new payload → OLD (r11) reader: emulated v1 ext read path
+    r = Reader(with_dig)
+    assert (r.u32(), r.u32(), r.u32()) == (0, 0, 0)
+    old_cv = read_change_v1(r)
+    assert ClusterId(r.u16()) == ClusterId(1)
+    assert r.u8() >= 1  # r11 gate: `< v1` is the only rejection
+    assert r.opt(r.f64) == pytest.approx(99.25)  # origin_ts
+    assert r.opt(r.string) is None  # traceparent
+    assert old_cv == stamped
+    assert not r.eof()  # digest vec left unread, exactly like r11 would
+
+    # a digest can ride a fully UNSTAMPED change too (the broadcast
+    # loop offers the ext regardless of stamps)
+    only_dig = encode_uni_payload(plain, ClusterId(1), digest=dig)
+    cv3, _, got3 = decode_uni_payload_ext(only_dig)
+    assert got3 == dig and cv3 == plain and cv3.origin_ts is None
+
+
+def test_swim_digest_ext_compat():
+    """Same discipline on the gossip datagrams: a digest-free SWIM
+    packet encodes zero ext bytes (an emulated pre-r12 decoder consumes
+    the WHOLE packet), and a digest-carrying packet is a strict trailing
+    extension the old decoder never reaches."""
+    from corrosion_tpu.net.gossip_codec import (
+        MemberState,
+        MemberUpdate,
+        MsgKind,
+        SwimMessage,
+        decode_swim,
+        encode_swim,
+        read_actor,
+    )
+    from corrosion_tpu.types.actor import Actor
+    from corrosion_tpu.types.codec import Reader
+
+    a = Actor(id=ActorId(b"\x31" * 16), addr="a:1", ts=Timestamp(3))
+    b = Actor(id=ActorId(b"\x32" * 16), addr="b:2", ts=Timestamp(4))
+    msg = SwimMessage(
+        kind=MsgKind.PING,
+        probe_no=9,
+        sender=a,
+        updates=[MemberUpdate(b, 2, MemberState.ALIVE)],
+    )
+    plain_bytes = encode_swim(msg)
+    msg.digest = b"\x01tiny-digest"
+    dig_bytes = encode_swim(msg)
+
+    # strict trailing extension of the byte-identical digest-free packet
+    assert dig_bytes[: len(plain_bytes)] == plain_bytes
+    assert len(dig_bytes) > len(plain_bytes)
+
+    # new decoder: digest surfaces on the ext'd packet, None otherwise
+    assert decode_swim(dig_bytes).digest == msg.digest
+    assert decode_swim(plain_bytes).digest is None
+
+    # emulated pre-r12 decoder on the NEW packet: reads through the
+    # updates list and stops — the ext bytes are simply left unread
+    r = Reader(dig_bytes)
+    assert MsgKind(r.u8()) == MsgKind.PING
+    assert r.u32() == 9
+    assert read_actor(r) == a
+    assert r.u8() == 0 and r.u8() == 0  # no target / origin
+    n = r.u16()
+    assert n == 1
+    assert read_actor(r) == b and r.u32() == 2 and r.u8() == 0
+    assert not r.eof()  # trailing digest ext, invisible to old readers
+    # ...and on the digest-free packet the old decoder consumes it ALL
+    r2 = Reader(plain_bytes)
+    r2.u8(), r2.u32(), read_actor(r2), r2.u8(), r2.u8()
+    for _ in range(r2.u16()):
+        read_actor(r2), r2.u32(), r2.u8()
+    assert r2.eof()

@@ -1,0 +1,328 @@
+"""r12 telemetry-digest wire codec + observatory units.
+
+Property tests for the sparse histogram codec (digest → bytes → digest
+identical; merge-of-decoded ≡ decode-of-merged), the full NodeDigest
+roundtrip over randomized field content, the canonical view hash, the
+freshest-per-node adoption rule, the budgeted ext picker, and the
+divergence episode state machine on fabricated digests — the unit half
+of what tests/test_cluster_obs.py exercises live.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+import pytest
+
+from corrosion_tpu.runtime import latency as lat
+from corrosion_tpu.runtime.digest import (
+    NodeDigest,
+    decode_digest,
+    encode_digest,
+    merge_stage_hists,
+    read_hist,
+    view_hash,
+    write_hist,
+)
+from corrosion_tpu.types.codec import Reader, Writer
+
+
+def _rand_hist(rng, n_samples=200, scale=2.0):
+    h = lat.LatencyHistogram()
+    for _ in range(rng.randrange(n_samples)):
+        h.observe(rng.lognormvariate(-6.0, scale))
+    return h
+
+
+def _rand_digest(rng, seq=1):
+    stages = {
+        s: _rand_hist(rng)
+        for s in lat.E2E_STAGES
+        if rng.random() < 0.8
+    }
+    return NodeDigest(
+        actor_id=rng.randbytes(16),
+        seq=seq,
+        wall=time.time() + rng.uniform(-5, 5),
+        view_hash=rng.getrandbits(64),
+        view_size=rng.randrange(1, 1000),
+        alive=rng.randrange(1000),
+        suspect=rng.randrange(50),
+        downed=rng.randrange(50),
+        lhm=rng.randrange(9),
+        loop_lag=rng.random(),
+        sync_backlog={
+            rng.randbytes(16): rng.randrange(1, 1 << 40)
+            for _ in range(rng.randrange(4))
+        },
+        events={
+            f"ev_{i}": rng.randrange(1 << 32)
+            for i in range(rng.randrange(6))
+        },
+        stages=stages,
+    )
+
+
+def test_hist_codec_roundtrip_identical():
+    rng = random.Random(1)
+    for _ in range(50):
+        h = _rand_hist(rng, scale=rng.uniform(0.5, 4.0))
+        w = Writer()
+        write_hist(w, h)
+        out = read_hist(Reader(w.bytes()))
+        assert out.nonzero_buckets() == h.nonzero_buckets()
+        assert out.count == h.count
+        assert out.total == pytest.approx(h.total)
+        for q in lat.QUANTILES:
+            assert out.quantile(q) == h.quantile(q)
+
+
+def test_hist_codec_merge_of_decoded_equals_decode_of_merged():
+    rng = random.Random(2)
+    for _ in range(25):
+        a, b = _rand_hist(rng), _rand_hist(rng)
+        wa, wb = Writer(), Writer()
+        write_hist(wa, a)
+        write_hist(wb, b)
+        merged_then = a.copy().merge(b)
+        decoded_then = read_hist(Reader(wa.bytes())).merge(
+            read_hist(Reader(wb.bytes()))
+        )
+        wm = Writer()
+        write_hist(wm, merged_then)
+        decode_of_merged = read_hist(Reader(wm.bytes()))
+        assert (
+            decoded_then.nonzero_buckets()
+            == decode_of_merged.nonzero_buckets()
+            == merged_then.nonzero_buckets()
+        )
+        assert decoded_then.total == pytest.approx(decode_of_merged.total)
+
+
+def test_digest_roundtrip_randomized():
+    rng = random.Random(3)
+    for trial in range(40):
+        d = _rand_digest(rng, seq=trial)
+        out = decode_digest(encode_digest(d))
+        assert out.actor_id == d.actor_id
+        assert out.seq == d.seq
+        assert out.wall == pytest.approx(d.wall)
+        assert out.view_hash == d.view_hash
+        assert out.view_size == d.view_size
+        assert (out.alive, out.suspect, out.downed) == (
+            d.alive, d.suspect, d.downed,
+        )
+        assert out.lhm == d.lhm
+        assert out.loop_lag == pytest.approx(d.loop_lag)
+        assert out.sync_backlog == d.sync_backlog
+        assert out.events == d.events
+        # only non-empty histograms travel
+        want = {s for s, h in d.stages.items() if h.count > 0}
+        assert set(out.stages) == want
+        for s in want:
+            assert (
+                out.stages[s].nonzero_buckets()
+                == d.stages[s].nonzero_buckets()
+            )
+
+
+def test_digest_decode_rejects_garbage_and_wrong_version():
+    with pytest.raises(Exception):
+        decode_digest(b"")
+    rng = random.Random(4)
+    good = encode_digest(_rand_digest(rng))
+    with pytest.raises(ValueError):
+        decode_digest(b"\x63" + good[1:])  # future major version
+    with pytest.raises(Exception):
+        decode_digest(good[: len(good) // 2])  # truncated
+
+
+def test_view_hash_canonical_and_discriminating():
+    ids = [bytes([i]) * 16 for i in range(5)]
+    rng = random.Random(5)
+    shuffled = list(ids)
+    rng.shuffle(shuffled)
+    assert view_hash(ids) == view_hash(shuffled)  # order-free
+    assert view_hash(ids) != view_hash(ids[:-1])  # set-sensitive
+    assert view_hash([]) != view_hash(ids)
+    with pytest.raises(ValueError):
+        view_hash([b"\x01" * 15])
+
+
+def test_merge_stage_hists_exact_across_digests():
+    rng = random.Random(6)
+    a, b = _rand_digest(rng), _rand_digest(rng)
+    merged = merge_stage_hists([a, b])
+    for s in lat.E2E_STAGES:
+        want = lat.LatencyHistogram()
+        for d in (a, b):
+            if s in d.stages:
+                want.merge(d.stages[s])
+        assert merged[s].nonzero_buckets() == want.nonzero_buckets()
+
+
+# -- observatory units (fabricated agents, no network) ----------------------
+
+
+class _FakeMembership:
+    def __init__(self):
+        from corrosion_tpu.agent.membership import SwimConfig
+
+        self.members = {}
+        self.downed = {}
+        self.config = SwimConfig()
+        self.lhm = 0
+
+    @property
+    def cluster_size(self):
+        return 1 + len(self.members)
+
+
+class _FakeBookie:
+    def items(self):
+        return {}
+
+
+class _FakeAgent:
+    def __init__(self, name: bytes):
+        from corrosion_tpu.runtime.config import Config
+        from corrosion_tpu.types.actor import Actor, ActorId
+
+        self.config = Config()
+        self.actor = Actor(id=ActorId(name), addr="fake")
+        self.membership = _FakeMembership()
+        self.bookie = _FakeBookie()
+
+    @property
+    def actor_id(self):
+        return self.actor.id
+
+
+def _mk_obs(name=b"\x01" * 16):
+    from corrosion_tpu.agent.observatory import Observatory
+
+    return Observatory(_FakeAgent(name))
+
+
+def _held_digest(obs, actor_id: bytes, seq=1, wall=None, vh=0):
+    d = NodeDigest(
+        actor_id=actor_id,
+        seq=seq,
+        wall=wall if wall is not None else time.time(),
+        view_hash=vh,
+        view_size=1,
+    )
+    return obs.receive(encode_digest(d))
+
+
+def test_observatory_freshest_per_node_wins():
+    obs = _mk_obs()
+    other = b"\x02" * 16
+    assert _held_digest(obs, other, seq=5, wall=100.0) is not None
+    # older wall → dropped
+    assert _held_digest(obs, other, seq=9, wall=50.0) is None
+    assert obs._store[other].digest.seq == 5
+    # newer wall → adopted
+    assert _held_digest(obs, other, seq=6, wall=200.0) is not None
+    assert obs._store[other].digest.seq == 6
+    # our own digest relayed back → ignored
+    assert _held_digest(obs, b"\x01" * 16, seq=99, wall=1e12) is None
+
+
+def test_observatory_pick_ext_budget_and_rotation():
+    obs = _mk_obs()
+    _held_digest(obs, b"\x02" * 16, seq=1)
+    _held_digest(obs, b"\x03" * 16, seq=1)
+    seen = set()
+    # both digests fit a generous budget; rotation must alternate
+    for _ in range(4):
+        ext = obs.pick_ext(10_000)
+        assert ext is not None
+        seen.add(decode_digest(ext).actor_id)
+    assert seen == {b"\x02" * 16, b"\x03" * 16}
+    # a hopeless budget yields nothing (and counts the skip)
+    assert obs.pick_ext(4) is None
+    # sends_left exhausts: transmissions are bounded per adoption
+    total = 0
+    while obs.pick_ext(10_000) is not None:
+        total += 1
+        assert total < 100, "sends never exhausted"
+    assert total > 0
+
+
+def test_observatory_divergence_episode_state_machine(tmp_path, monkeypatch):
+    monkeypatch.setenv("CORRO_FLIGHT_DIR", str(tmp_path))
+    from corrosion_tpu.agent.membership import MemberState, _Member
+    from corrosion_tpu.types.actor import Actor, ActorId
+
+    obs = _mk_obs()
+    obs.cfg.divergence_checks = 2
+    obs.cfg.digest_interval_secs = 10.0  # silence never fires here
+    peer = b"\x02" * 16
+    obs.agent.membership.members[ActorId(peer)] = _Member(
+        actor=Actor(id=ActorId(peer), addr="peer"),
+        state=MemberState.ALIVE,
+    )
+    my_hash = view_hash([b"\x01" * 16, peer])
+
+    # agreeing view → clean
+    _held_digest(obs, peer, seq=1, vh=my_hash)
+    r = obs.check_divergence()
+    assert not r["divergent"] and r["groups"] == 1
+
+    # conflicting view hash → divergent, episode opens on the SECOND
+    # consecutive check, exactly one incident + episode
+    _held_digest(obs, peer, seq=2, vh=my_hash ^ 0xDEAD)
+    r1 = obs.check_divergence()
+    assert r1["divergent"] and not r1["episode_open"]
+    r2 = obs.check_divergence()
+    assert r2["episode_open"] and r2["episodes"] == 1
+    obs.check_divergence()
+    assert obs._episodes == 1  # still the same episode
+    dumps = list(tmp_path.glob("*cluster_divergence*"))
+    assert len(dumps) == 1
+
+    # agreement again: hysteresis holds the episode for one clean
+    # check, the second closes it; a NEW divergence is a NEW episode
+    _held_digest(obs, peer, seq=3, vh=my_hash)
+    assert obs.check_divergence()["episode_open"]
+    assert not obs.check_divergence()["episode_open"]
+    _held_digest(obs, peer, seq=4, vh=my_hash ^ 0xBEEF)
+    obs.check_divergence()
+    assert obs.check_divergence()["episodes"] == 2
+    assert len(list(tmp_path.glob("*cluster_divergence*"))) == 2
+
+    # disarm freezes the state machine (planned teardown)
+    _held_digest(obs, peer, seq=5, vh=my_hash)
+    obs.disarm()
+    obs.check_divergence()
+    obs.check_divergence()
+    assert obs._episode_open  # frozen open, no bonus episode
+    assert obs._episodes == 2
+
+
+def test_observatory_silence_requires_prior_report(monkeypatch):
+    """An ACTIVE member that has NEVER sent a digest is not 'silent'
+    (boot grace); one that reported and stopped is."""
+    from corrosion_tpu.agent.membership import MemberState, _Member
+    from corrosion_tpu.types.actor import Actor, ActorId
+
+    obs = _mk_obs()
+    obs.cfg.divergence_checks = 1
+    obs.cfg.digest_interval_secs = 0.01  # silent_after = 25 ms
+    peer = b"\x02" * 16
+    obs.agent.membership.members[ActorId(peer)] = _Member(
+        actor=Actor(id=ActorId(peer), addr="peer"),
+        state=MemberState.ALIVE,
+    )
+    assert not obs.check_divergence()["divergent"]  # never reported
+    my_hash = view_hash([b"\x01" * 16, peer])
+    _held_digest(obs, peer, seq=1, vh=my_hash)
+    assert not obs.check_divergence()["divergent"]  # fresh
+    time.sleep(0.05)
+    r = obs.check_divergence()
+    assert r["divergent"] and r["silent"]  # went silent
+    # ... but not when the local loop itself was late (lag suppression)
+    obs._self_lagged = True
+    assert not obs.check_divergence()["silent"]

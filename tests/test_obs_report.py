@@ -22,6 +22,10 @@ def test_obs_report_renders_event_counters(tmp_path):
         # each cross-node write pays the matcher's 600 ms candidate
         # batching window — keep the tier-1 replica tiny
         OBS_REPORT_E2E_WRITES="5",
+        # r12 cluster section: the two-node partition replay's wall is
+        # dominated by detection/heal rounds, writes just seed the
+        # digests' stage histograms — trim to the minimum
+        OBS_REPORT_CLUSTER_WRITES="3",
         OBS_REPORT_OUT=str(out),
     )
     proc = subprocess.run(
@@ -55,3 +59,13 @@ def test_obs_report_renders_event_counters(tmp_path):
         assert m and int(m.group(1)) > 0, f"stage {stage} has no samples"
     assert "## canary round trips" in text
     assert re.search(r"^trend [▁▂▃▄▅▆▇█]+$", text, re.M)
+    # r12: the cluster-observatory section renders the coverage table
+    # and a divergence timeline whose episode actually opened + cleared
+    assert "## cluster observatory" in text
+    m = re.search(r"partition detected in (\d+) digest rounds", text)
+    assert m and int(m.group(1)) >= 1, "no detection headline"
+    assert "digest coverage at full aggregation" in text
+    assert re.search(r"\bOPEN\b", text), "episode never rendered OPEN"
+    assert re.search(r"^episode trend [▁▂▃▄▅▆▇█]+$", text, re.M)
+    # both nodes' coverage rows rendered fresh
+    assert len(re.findall(r"^\S+\s+True\s+\d+\s+", text, re.M)) == 2

@@ -288,3 +288,58 @@ def test_dead_handle_changes_since_raises():
         await subs.stop_all()
 
     run_async(main())
+
+
+def test_candidate_batch_wait_config_shrinks_match_latency():
+    """[pubsub] candidate_batch_wait (r12): the matcher's
+    candidate-batching window is the floor under the observed
+    `corro.e2e.match` stage — the r11 SLO plane attributed the ~600 ms
+    write→event p50 to exactly the hard-coded 0.6 s default.  Now that
+    the window is an operator knob, pin both halves: a high value shows
+    up as a structural latency floor, and lowering it shrinks the
+    observed match-stage histogram."""
+    import time as _time
+
+    from corrosion_tpu.runtime import latency as lat
+    from corrosion_tpu.runtime.latency import BatchStamp
+
+    async def run_once(wait, batches=3):
+        store = make_store(50)
+        subs = SubsManager(store, batch_wait=wait)
+        handle, _ = await subs.get_or_insert(
+            "SELECT id, name FROM items WHERE qty >= 0"
+        )
+        assert handle.batch_wait == wait  # knob reaches the cmd loop
+        q = handle.attach()
+        before = lat.stage_hists(window_secs=None)["match"]
+        for i in range(batches):
+            write(
+                store,
+                "UPDATE items SET name = name || 'y' WHERE id = ?",
+                (i,),
+            )
+            handle.enqueue_candidates(
+                _candidates([i]),
+                BatchStamp(origin=None, applied=_time.time()),
+            )
+            await asyncio.wait_for(q.get(), 30)
+        after = lat.stage_hists(window_secs=None)["match"]
+        handle.detach(q)
+        await subs.stop_all()
+        d = after.diff(before)
+        assert d.count == batches
+        return d
+
+    async def main():
+        lo = await run_once(0.05)
+        hi = await run_once(0.5)
+        # structural: nothing beats the batching window — every sample
+        # waited out the full deadline before the diff ran
+        assert hi.quantile(0.5) >= 0.45, hi.nonzero_buckets()
+        # directional: the lowered knob shrinks the observed stage
+        assert lo.quantile(0.5) < hi.quantile(0.5), (
+            lo.nonzero_buckets(), hi.nonzero_buckets(),
+        )
+        assert lo.total / lo.count < hi.total / hi.count
+
+    run_async(main())
