@@ -84,8 +84,13 @@ def snapshot_membership(agent) -> Dict[ActorId, str]:
 
 
 def _min_rtt_ms(agent, addr: str) -> Optional[float]:
+    # worker thread (diff_member_states' to_thread) vs event-loop
+    # appends: copy the deque in one GIL-held C call before iterating,
+    # same idiom as snapshot_membership's dict(...) above
     window = agent.members.rtts.get(addr)
-    return min(window) if window else None
+    if not window:
+        return None
+    return min(window.copy())
 
 
 def diff_member_states(

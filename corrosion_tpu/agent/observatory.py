@@ -37,6 +37,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import logging
+import threading
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
@@ -69,14 +70,21 @@ class _Held:
 
 
 class Observatory:
-    """One agent's digest store + divergence tracker.  Mutated from the
-    event loop (digest loop, datagram handlers) and read by the API
-    handlers on the same loop — no lock needed; `receive` is also safe
-    to call re-entrantly from transport callbacks."""
+    """One agent's digest store + divergence tracker.
+
+    Thread contract: `build_and_store` runs on a WORKER thread
+    (observatory_loop's `asyncio.to_thread` — the bookie read locks and
+    histogram encodes must not stall the loop) while `receive` /
+    `pick_ext` mutate the same digest store from transport callbacks on
+    the event loop, and `cluster_report` iterates it from the API
+    handler.  Every `_store`/`_seq` touch therefore holds `_lock` (the
+    r7 metrics-lock discipline); the divergence episode counters are
+    loop-only and stay lock-free."""
 
     def __init__(self, agent):
         self.agent = agent
         self.cfg = agent.config.cluster
+        self._lock = threading.Lock()
         self._store: Dict[bytes, _Held] = {}
         self._seq = 0
         self._pick_rr = 0
@@ -141,10 +149,12 @@ class Observatory:
             for ev, v in by_event.items():
                 events[ev] = events.get(ev, 0) + int(v)
 
-        self._seq += 1
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
         return NodeDigest(
             actor_id=self.agent.actor_id.bytes16,
-            seq=self._seq,
+            seq=seq,
             wall=time.time(),
             view_hash=view_hash(active_ids),
             view_size=len(active_ids),
@@ -163,15 +173,17 @@ class Observatory:
         a full infection-style transmission budget."""
         d = self.snapshot_local()
         enc = encode_digest(d)
-        self._store[d.actor_id] = _Held(
-            digest=d,
-            encoded=enc,
-            sends_left=self._transmissions(),
-            received_mono=time.monotonic(),
-        )
+        with self._lock:
+            self._store[d.actor_id] = _Held(
+                digest=d,
+                encoded=enc,
+                sends_left=self._transmissions(),
+                received_mono=time.monotonic(),
+            )
+            nodes = len(self._store)
         METRICS.counter("corro.digest.built.total").inc()
         METRICS.gauge("corro.digest.size.bytes").set(len(enc))
-        METRICS.gauge("corro.digest.nodes").set(len(self._store))
+        METRICS.gauge("corro.digest.nodes").set(nodes)
         return d
 
     def _transmissions(self) -> int:
@@ -188,20 +200,25 @@ class Observatory:
         piggyback uses).  Returns the encoded bytes or None."""
         if not self.cfg.digests or not self._store:
             return None
-        keys = sorted(self._store)
-        n = len(keys)
         skipped_oversize = False
-        for i in range(n):
-            held = self._store[keys[(self._pick_rr + i) % n]]
-            if held.sends_left <= 0:
-                continue
-            if len(held.encoded) + _EXT_OVERHEAD > budget:
-                skipped_oversize = True
-                continue
-            self._pick_rr = (self._pick_rr + i + 1) % n
-            held.sends_left -= 1
+        picked = None
+        with self._lock:  # vs build_and_store on the worker thread
+            keys = sorted(self._store)
+            n = len(keys)
+            for i in range(n):
+                held = self._store[keys[(self._pick_rr + i) % n]]
+                if held.sends_left <= 0:
+                    continue
+                if len(held.encoded) + _EXT_OVERHEAD > budget:
+                    skipped_oversize = True
+                    continue
+                self._pick_rr = (self._pick_rr + i + 1) % n
+                held.sends_left -= 1
+                picked = held.encoded
+                break
+        if picked is not None:
             METRICS.counter("corro.digest.sent.total", plane=plane).inc()
-            return held.encoded
+            return picked
         if skipped_oversize:
             METRICS.counter("corro.digest.oversize.skipped.total").inc()
         return None
@@ -217,18 +234,24 @@ class Observatory:
             return None
         if d.actor_id == self.agent.actor_id.bytes16:
             return None  # our own digest relayed back — ours is fresher
-        known = self._store.get(d.actor_id)
-        if not d.fresher_than(known.digest if known else None):
+        with self._lock:  # vs build_and_store on the worker thread
+            known = self._store.get(d.actor_id)
+            if not d.fresher_than(known.digest if known else None):
+                stale = True
+            else:
+                stale = False
+                self._store[d.actor_id] = _Held(
+                    digest=d,
+                    encoded=bytes(data),
+                    sends_left=self._transmissions(),
+                    received_mono=time.monotonic(),
+                )
+            nodes = len(self._store)
+        if stale:
             METRICS.counter("corro.digest.stale.total").inc()
             return None
-        self._store[d.actor_id] = _Held(
-            digest=d,
-            encoded=bytes(data),
-            sends_left=self._transmissions(),
-            received_mono=time.monotonic(),
-        )
         METRICS.counter("corro.digest.received.total").inc()
-        METRICS.gauge("corro.digest.nodes").set(len(self._store))
+        METRICS.gauge("corro.digest.nodes").set(nodes)
         return d
 
     # -- divergence detection ----------------------------------------------
@@ -256,8 +279,10 @@ class Observatory:
             my_hash: [str(self.agent.actor_id)]
         }
         silent: List[str] = []
+        with self._lock:  # snapshot vs the worker-thread builder
+            store = dict(self._store)
         for mid in my_ids:
-            held = self._store.get(mid)
+            held = store.get(mid)
             if held is None:
                 continue  # never reported — no evidence either way
             age = now_mono - held.received_mono
@@ -281,7 +306,7 @@ class Observatory:
                 "silent": len(silent),
                 "streak": self._div_streak,
                 "episode_open": int(self._episode_open),
-                "digest_nodes": len(self._store),
+                "digest_nodes": len(store),
                 "view_size": len(my_ids) + 1,
             },
         )
@@ -349,7 +374,9 @@ class Observatory:
         stale_after = self.cfg.stale_after_secs
         nodes: Dict[str, dict] = {}
         fresh: List[NodeDigest] = []
-        for held in self._store.values():
+        with self._lock:  # snapshot vs the worker-thread builder
+            held_all = list(self._store.values())
+        for held in held_all:
             d = held.digest
             age = now_mono - held.received_mono
             is_fresh = age <= stale_after

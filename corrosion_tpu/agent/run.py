@@ -60,18 +60,30 @@ async def setup(
     tripwire: Optional[Tripwire] = None,
 ) -> Agent:
     tripwire = tripwire or Tripwire()
-    store = CrdtStore(config.db.path)
-    # the canary table is system-owned (created at runtime by the SLO
-    # canary probe, r11) and never appears in the user's schema files:
-    # carry a persisted one through the declarative re-apply, or a
-    # restart would be refused as a destructive table drop
-    canary_t = store.schema.tables.get(config.slo.canary_table)
-    canary_ddl = canary_t.raw_sql.rstrip(";") + ";" if canary_t else None
-    for schema_path in config.db.schema_paths:
-        sql = Path(schema_path).read_text()
-        if canary_ddl:
-            sql = sql + "\n" + canary_ddl
-        store.apply_schema_sql(sql)
+
+    def _boot_store() -> CrdtStore:
+        # sqlite open + schema file reads + declarative re-apply are
+        # all blocking I/O; a caller embedding setup() next to live
+        # traffic (devcluster scale-up, tests with a running loop) must
+        # not stall its event loop for the duration of a schema apply
+        store = CrdtStore(config.db.path)
+        # the canary table is system-owned (created at runtime by the
+        # SLO canary probe, r11) and never appears in the user's schema
+        # files: carry a persisted one through the declarative
+        # re-apply, or a restart would be refused as a destructive
+        # table drop
+        canary_t = store.schema.tables.get(config.slo.canary_table)
+        canary_ddl = (
+            canary_t.raw_sql.rstrip(";") + ";" if canary_t else None
+        )
+        for schema_path in config.db.schema_paths:
+            sql = Path(schema_path).read_text()
+            if canary_ddl:
+                sql = sql + "\n" + canary_ddl
+            store.apply_schema_sql(sql)
+        return store
+
+    store = await asyncio.to_thread(_boot_store)
     clock = HLClock()
 
     if network is not None:

@@ -1,0 +1,36 @@
+"""corro-analyze: AST-based static analysis over the corrosion-tpu tree.
+
+Every bug class this repo has paid for by hand is statically visible —
+the r7 GIL-racy metric mutations, the r10 blocking-SQL-in-async matcher
+deaths, the per-PR lockstep edits of the 30+ protocol lanes in both SWIM
+kernels.  This package turns those into lint-time failures: a small
+`Checker` framework (`core.py`), one checker module per rule, a committed
+`ANALYSIS_BASELINE.json` for grandfathered findings, and per-finding
+`# corro: noqa[rule]` suppressions.  `scripts/corro_lint.py` is the one
+driver; `tests/test_static_analysis.py` is the tier-1 gate.
+
+Rules (see COMPONENTS.md "Static analysis" for the full table):
+    kernel-purity   host syncs / host materialization / Python control
+                    flow on traced values inside ops/* jitted tick code
+    lane-parity     SwimState <-> PViewState <-> parallel/mesh.py lane
+                    name/dtype/ordering drift (the lane-registry
+                    refactor's static precursor)
+    async-blocking  blocking SQL / sleeps / file I/O directly in
+                    `async def` bodies under agent/, api/, pubsub/
+    lock-discipline state mutated from both worker-thread and event-loop
+                    contexts without a lock
+    codec-ext       every version-gated codec ext has a read path, a
+                    write path and a compat test
+    metrics-doc     emitted series <-> COMPONENTS.md observability table
+                    (both directions; the former scripts/lint_metrics.py)
+"""
+
+from corrosion_tpu.analysis.core import (  # noqa: F401
+    AnalysisContext,
+    Checker,
+    Finding,
+    all_checkers,
+    load_baseline,
+    run_analysis,
+    save_baseline,
+)

@@ -1,0 +1,307 @@
+"""kernel-purity: host escapes inside ops/* jitted tick code.
+
+The bug class: a host sync or host materialization inside a traced
+kernel body — `.item()` / `float()` on a traced value, `np.*` on device
+data, `time.*` inside the tick, or a Python `if` branching on a traced
+array (trace-time constant-folds one arm, or dies with a
+ConcretizationTypeError at the worst possible shape).  These are the
+copy/host-sync bugs PR 1's fused-tick restructure and the memguard tests
+chase after the fact; this checker catches them at lint time.
+
+Scope: functions REACHABLE FROM A JIT ROOT in `corrosion_tpu/ops/*.py`.
+A jit root is a function wrapped by `@jax.jit`, `@functools.partial(
+jax.jit, ...)`, or a module-level `name = functools.partial(jax.jit,
+...)(fn)` / `jax.jit(fn)` application.  Reachability follows same-module
+calls and bare-name references (a function handed to `pl.pallas_call` or
+`lax.scan` is traced too).  Host-side wrappers in the same files
+(`stats_and_events`, `merge_table_array`) are NOT in the closure and may
+do host work freely.
+
+Traced-value approximation (documented, deliberately simple):
+- In a jit root, every parameter NOT named in `static_argnames` /
+  `static_argnums` is a traced root.
+- A local name assigned from an expression containing a traced name or
+  a `jnp.*` / `jax.*` call is traced ("taint-lite": one forward pass,
+  no fixpoint across reassignments-before-definition).
+- In non-root traced functions parameter staticness is unknown, so only
+  locally-derived taint (`jnp.*`/`jax.*` results) is tracked — branchy
+  helpers keyed off static params stay clean, `if jnp.any(mask):` does
+  not.
+- `x is None` / `x is not None` tests are exempt: tracers are never
+  None, so optionality branching is trace-safe.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from corrosion_tpu.analysis.core import (
+    AnalysisContext,
+    Checker,
+    Finding,
+    enclosing_symbols,
+)
+
+SCOPE = ("corrosion_tpu/ops",)
+
+# modules whose mere use inside a traced body is a host escape
+_HOST_MODULES = {"np", "numpy", "time"}
+# builtins that force a concrete value out of a tracer
+_CONCRETIZERS = {"float", "int", "bool", "complex"}
+
+
+def _jit_roots(tree: ast.Module) -> Dict[str, Set[str]]:
+    """function name -> set of STATIC parameter names, for every
+    function the module jits (decorator or wrapper-application form)."""
+
+    def _static_names(call: ast.Call, fn: ast.FunctionDef) -> Set[str]:
+        names: Set[str] = set()
+        params = [a.arg for a in fn.args.args]
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for elt in ast.walk(kw.value):
+                    if isinstance(elt, ast.Constant) and isinstance(
+                        elt.value, str
+                    ):
+                        names.add(elt.value)
+            elif kw.arg == "static_argnums":
+                for elt in ast.walk(kw.value):
+                    if isinstance(elt, ast.Constant) and isinstance(
+                        elt.value, int
+                    ):
+                        if 0 <= elt.value < len(params):
+                            names.add(params[elt.value])
+        return names
+
+    def _is_jit_call(call: ast.Call) -> bool:
+        # jax.jit(...) or functools.partial(jax.jit, ...)
+        src = ast.unparse(call.func)
+        if src.endswith("jax.jit") or src == "jit":
+            return True
+        if src.endswith("functools.partial") or src == "partial":
+            return bool(call.args) and ast.unparse(call.args[0]).endswith(
+                "jit"
+            )
+        return False
+
+    fns = {
+        n.name: n
+        for n in ast.walk(tree)
+        if isinstance(n, ast.FunctionDef)
+    }
+    roots: Dict[str, Set[str]] = {}
+    for fn in fns.values():
+        for dec in fn.decorator_list:
+            if isinstance(dec, ast.Call) and _is_jit_call(dec):
+                roots[fn.name] = _static_names(dec, fn)
+            elif ast.unparse(dec).endswith("jax.jit"):
+                roots[fn.name] = set()
+    # wrapper-application form: X = functools.partial(jax.jit, ...)(fn)
+    # and X = jax.jit(fn)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        target = node.args[0]
+        if not (isinstance(target, ast.Name) and target.id in fns):
+            continue
+        f = node.func
+        if isinstance(f, ast.Call) and _is_jit_call(f):
+            roots[target.id] = _static_names(f, fns[target.id])
+        elif isinstance(f, ast.Attribute) and ast.unparse(f).endswith(
+            "jax.jit"
+        ):
+            roots[target.id] = set()
+    return roots
+
+
+def _called_names(fn: ast.FunctionDef) -> Set[str]:
+    """Bare names called OR referenced inside fn (a reference covers
+    functions handed to lax.scan / pallas_call / while_loop)."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            out.add(node.id)
+    return out
+
+
+class _TaintVisitor(ast.NodeVisitor):
+    """Flags host escapes inside ONE traced function (nested defs
+    included — they trace with their parent)."""
+
+    def __init__(
+        self,
+        checker: "KernelPurityChecker",
+        path: str,
+        symbol: str,
+        traced_params: Set[str],
+        findings: List[Finding],
+    ):
+        self.checker = checker
+        self.path = path
+        self.symbol = symbol
+        self.tainted: Set[str] = set(traced_params)
+        self.findings = findings
+
+    # array metadata is static at trace time — `x.shape[0]` of a traced
+    # x is an ordinary Python int, not a tracer
+    _STATIC_ATTRS = frozenset(("shape", "ndim", "dtype", "size"))
+
+    def _expr_tainted(self, node: ast.AST) -> bool:
+        stack = [node]
+        while stack:
+            sub = stack.pop()
+            if (
+                isinstance(sub, ast.Attribute)
+                and sub.attr in self._STATIC_ATTRS
+            ):
+                continue  # don't descend: metadata reads are static
+            if isinstance(sub, ast.Name) and sub.id in self.tainted:
+                return True
+            if isinstance(sub, ast.Call):
+                src = ast.unparse(sub.func)
+                if src.startswith(("jnp.", "jax.")):
+                    return True
+            stack.extend(ast.iter_child_nodes(sub))
+        return False
+
+    def _flag(self, node: ast.AST, message: str) -> None:
+        self.findings.append(
+            Finding(
+                rule=KernelPurityChecker.rule,
+                path=self.path,
+                line=getattr(node, "lineno", 0),
+                symbol=self.symbol,
+                message=message,
+                snippet=Checker.snippet_of(node),
+            )
+        )
+
+    # -- taint propagation --------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        if self._expr_tainted(node.value):
+            for t in node.targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        self.tainted.add(sub.id)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.generic_visit(node)
+        if self._expr_tainted(node.value) and isinstance(
+            node.target, ast.Name
+        ):
+            self.tainted.add(node.target.id)
+
+    # -- escapes ------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "item":
+            self._flag(node, ".item() forces a device->host sync")
+        if (
+            isinstance(f, ast.Name)
+            and f.id in _CONCRETIZERS
+            and node.args
+            and self._expr_tainted(node.args[0])
+        ):
+            self._flag(
+                node,
+                f"{f.id}() on a traced value concretizes it "
+                "(host sync / trace-time constant)",
+            )
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (
+            isinstance(node.value, ast.Name)
+            and node.value.id in _HOST_MODULES
+        ):
+            mod = node.value.id
+            what = (
+                "wall-clock reads are invisible to the trace"
+                if mod == "time"
+                else "host/numpy materialization in traced code"
+            )
+            self._flag(node, f"{mod}.{node.attr}: {what}")
+        self.generic_visit(node)
+
+    def _check_test(self, node, kind: str) -> None:
+        test = node.test
+        # `x is (not) None` alone is trace-safe (tracers are never None)
+        if isinstance(test, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+        ):
+            return
+        if self._expr_tainted(test):
+            self._flag(
+                node,
+                f"Python `{kind}` on a traced value — use jnp.where / "
+                "lax.cond (trace-time branch freezes one arm)",
+            )
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_test(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_test(node, "while")
+        self.generic_visit(node)
+
+
+class KernelPurityChecker(Checker):
+    rule = "kernel-purity"
+    description = (
+        "no host syncs / host materialization / Python branches on "
+        "traced values inside ops/* jit-reachable code"
+    )
+
+    def __init__(self, scope=SCOPE):
+        self.scope = scope
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in ctx.walk(*self.scope):
+            tree = sf.tree
+            # module-level functions only: nested defs are visited
+            # through their parent's visitor (shared taint state), and
+            # keeping the closure at module granularity avoids name
+            # collisions between unrelated nested `body`/`cond` helpers
+            fns = {
+                n.name: n
+                for n in tree.body
+                if isinstance(n, ast.FunctionDef)
+            }
+            roots = _jit_roots(tree)
+            # closure over same-module calls/references
+            traced: Set[str] = set(roots)
+            frontier = list(roots)
+            while frontier:
+                name = frontier.pop()
+                fn = fns.get(name)
+                if fn is None:
+                    continue
+                for callee in _called_names(fn) & set(fns):
+                    if callee not in traced:
+                        traced.add(callee)
+                        frontier.append(callee)
+            symbols = enclosing_symbols(tree)
+            for name in sorted(traced):
+                fn = fns.get(name)
+                if fn is None:
+                    continue
+                static = roots.get(name, set())
+                traced_params = (
+                    {a.arg for a in fn.args.args} - static
+                    if name in roots
+                    else set()
+                )
+                _TaintVisitor(
+                    self,
+                    sf.path,
+                    symbols.get(fn, name),
+                    traced_params,
+                    findings,
+                ).visit(fn)
+        return findings

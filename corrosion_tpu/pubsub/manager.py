@@ -118,7 +118,7 @@ class SubsManager:
                 await asyncio.to_thread(build)
             except (sqlite3.Error, MatcherError) as e:
                 matcher.close()
-                self._purge_dir(sub_id)
+                await asyncio.to_thread(self._purge_dir, sub_id)
                 raise ParseError(str(e)) from e
             handle = MatcherHandle(
                 matcher, loop, executor=self.executor,
@@ -147,12 +147,16 @@ class SubsManager:
             if not d.is_dir() or not db.exists():
                 continue
             try:
-                sql = self._read_meta_sql(db)
+                sql = await asyncio.to_thread(self._read_meta_sql, db)
                 parsed = parse_select(sql, self.store.schema)
                 matcher = Matcher(self.store, parsed, d.name, sql, self.subs_path)
                 await asyncio.to_thread(matcher.reattach)
             except (sqlite3.Error, MatcherError, ParseError, KeyError):
-                shutil.rmtree(d, ignore_errors=True)
+                # purge off-loop: an incomplete sub dir can hold a
+                # multi-MB sub.sqlite and rmtree would stall the loop
+                await asyncio.to_thread(
+                    shutil.rmtree, d, ignore_errors=True
+                )
                 continue
             handle = MatcherHandle(
                 matcher, asyncio.get_running_loop(), executor=self.executor,
@@ -259,7 +263,7 @@ class SubsManager:
         self._rebuild_router()
         await handle.stop()
         if purge:
-            self._purge_dir(sub_id)
+            await asyncio.to_thread(self._purge_dir, sub_id)
         METRICS.gauge("corro.subs.count").set(len(self._by_id))
 
     def _purge_dir(self, sub_id: str) -> None:

@@ -1,0 +1,123 @@
+"""corro-analyze driver: run every static-analysis rule repo-wide.
+
+The one CLI for the AST-based checker suite
+(`corrosion_tpu/analysis/`): kernel-purity, lane-parity,
+async-blocking, lock-discipline, codec-ext and metrics-doc (the folded
+r7 metric-name lint).  Wired into tier-1 via
+tests/test_static_analysis.py, so a NEW finding — or a STALE baseline
+entry — fails CI.
+
+Usage:
+    python scripts/corro_lint.py                 # exit 0 clean / 1 findings
+    python scripts/corro_lint.py --rules a,b     # run a subset
+    python scripts/corro_lint.py -v              # also list grandfathered
+    python scripts/corro_lint.py --baseline      # re-bank ANALYSIS_BASELINE.json
+                                                 # (keeps justifications of
+                                                 # surviving entries; NEW
+                                                 # entries get an UNREVIEWED
+                                                 # placeholder you must edit)
+
+Suppression: `# corro: noqa[rule]` on the flagged line.  Baseline: only
+for proven-benign findings, one-line justification each — see
+COMPONENTS.md "Static analysis".
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from corrosion_tpu.analysis import (  # noqa: E402
+    AnalysisContext,
+    all_checkers,
+    load_baseline,
+    run_analysis,
+    save_baseline,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--baseline",
+        action="store_true",
+        help="re-bank current findings into ANALYSIS_BASELINE.json",
+    )
+    ap.add_argument(
+        "--rules",
+        help="comma-separated rule subset (default: all)",
+    )
+    ap.add_argument(
+        "-v", "--verbose", action="store_true",
+        help="also list baselined and suppressed findings",
+    )
+    args = ap.parse_args(argv)
+
+    ctx = AnalysisContext(REPO)
+    checkers = all_checkers()
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",")}
+        known = {c.rule for c in checkers}
+        unknown = wanted - known
+        if unknown:
+            print(
+                f"corro_lint: unknown rule(s) {sorted(unknown)} — "
+                f"available: {sorted(known)}"
+            )
+            return 2
+        checkers = [c for c in checkers if c.rule in wanted]
+
+    t0 = time.monotonic()
+    baseline = load_baseline(ctx.root)
+    result = run_analysis(ctx, checkers, baseline)
+    elapsed = time.monotonic() - t0
+
+    if args.baseline:
+        fired = (
+            result.new
+            + [f for f, _ in result.baselined]
+        )
+        path = save_baseline(ctx.root, fired, baseline)
+        print(
+            f"corro_lint: banked {len(fired)} finding(s) to {path} — "
+            "replace any UNREVIEWED justification before committing"
+        )
+        return 0
+
+    for f in result.new:
+        print(f"corro_lint: NEW {f.render()}")
+    for key in result.stale_keys:
+        print(
+            f"corro_lint: STALE baseline entry no longer fires: {key} — "
+            "run --baseline to shrink the grandfather list"
+        )
+    if args.verbose:
+        for f, why in result.baselined:
+            print(f"corro_lint: baselined {f.render()}  [{why}]")
+        for f in result.suppressed:
+            print(f"corro_lint: noqa'd {f.render()}")
+
+    n_rules = len(checkers)
+    if result.ok:
+        print(
+            f"corro_lint: OK — {n_rules} rule(s) clean in {elapsed:.2f}s "
+            f"({len(result.baselined)} baselined, "
+            f"{len(result.suppressed)} suppressed)"
+        )
+        return 0
+    print(
+        f"corro_lint: {len(result.new)} new finding(s), "
+        f"{len(result.stale_keys)} stale baseline entr(ies) "
+        f"in {elapsed:.2f}s"
+    )
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

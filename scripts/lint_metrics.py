@@ -1,18 +1,9 @@
-"""Metric-name drift lint: call sites ↔ COMPONENTS.md observability table.
-
-Every series the code can emit must be documented in the COMPONENTS.md
-"Observability" table, and every documented series must still have an
-emitting call site — otherwise dashboards rot silently (the reference's
-`metrics.rs` principle: the inventory IS the contract).  Wired as a
-tier-1 test (`tests/test_metrics_lint.py`) so drift fails CI.
-
-What counts as a call site: any
-`<registry>.counter(/gauge(/histogram(/latency(`
-whose first argument is a string literal (possibly on the next line),
-scanned over `corrosion_tpu/` and `scripts/`.  f-string names (one site:
-the write-gate lane gauges) are matched as wildcards — every table entry
-the pattern covers is considered emitted, and the pattern must cover at
-least one entry.
+"""Back-compat shim: the metric-name drift lint moved into the
+corro-analyze framework (`corrosion_tpu/analysis/metricsdoc.py`, rule
+`metrics-doc`) so ONE driver — `scripts/corro_lint.py` — runs every
+static-analysis rule.  This shim keeps the r7 CLI and the module API
+(`scan_call_sites` / `parse_components_table` / `lint`) stable for
+existing callers and tests/test_metrics_lint.py.
 
 Usage:  python scripts/lint_metrics.py   (exit 0 clean / 1 drift)
 """
@@ -20,105 +11,26 @@ Usage:  python scripts/lint_metrics.py   (exit 0 clean / 1 drift)
 from __future__ import annotations
 
 import os
-import re
 import sys
 from typing import Dict, List, Set, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-_CALL_RE = re.compile(
-    r"\.(counter|gauge|histogram|latency)\(\s*(f?)\"([^\"\n]+)\"", re.S
-)
-_TABLE_ROW_RE = re.compile(r"^\|\s*`([^`]+)`\s*\|")
-
-TABLE_BEGIN = "<!-- metrics-table:begin -->"
-TABLE_END = "<!-- metrics-table:end -->"
-
-SCAN_DIRS = ("corrosion_tpu", "scripts")
+from corrosion_tpu.analysis import metricsdoc  # noqa: E402
 
 
 def scan_call_sites() -> Tuple[Dict[str, Set[str]], List[str]]:
-    """(literal series name → emitting files, f-string wildcard regexes)."""
-    literals: Dict[str, Set[str]] = {}
-    wildcards: List[str] = []
-    for top in SCAN_DIRS:
-        for dirpath, _dirs, files in os.walk(os.path.join(REPO, top)):
-            for fn in files:
-                if not fn.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, fn)
-                rel = os.path.relpath(path, REPO)
-                with open(path, encoding="utf-8") as f:
-                    text = f.read()
-                for m in _CALL_RE.finditer(text):
-                    is_f, name = m.group(2), m.group(3)
-                    if is_f:
-                        # {expr} holes become wildcards over one label
-                        # segment; the pattern must cover ≥1 table row
-                        pat = "^" + re.sub(
-                            r"\\\{[^}]*\\\}", "[^.]+",
-                            re.escape(name)
-                        ) + "$"
-                        wildcards.append(pat)
-                    else:
-                        literals.setdefault(name, set()).add(rel)
-    return literals, wildcards
+    return metricsdoc.scan_call_sites(REPO)
 
 
 def parse_components_table() -> List[str]:
-    """Backticked series names from column 1 of the fenced table."""
-    path = os.path.join(REPO, "COMPONENTS.md")
-    with open(path, encoding="utf-8") as f:
-        text = f.read()
-    if TABLE_BEGIN not in text or TABLE_END not in text:
-        raise SystemExit(
-            f"COMPONENTS.md is missing the {TABLE_BEGIN}/{TABLE_END} "
-            "markers around the observability table"
-        )
-    section = text.split(TABLE_BEGIN, 1)[1].split(TABLE_END, 1)[0]
-    names = []
-    for line in section.splitlines():
-        m = _TABLE_ROW_RE.match(line.strip())
-        if m:
-            names.append(m.group(1))
-    return names
+    return metricsdoc.parse_components_table(REPO)
 
 
 def lint() -> List[str]:
-    """Return a list of drift complaints (empty = clean)."""
-    literals, wildcards = scan_call_sites()
-    table = parse_components_table()
-    table_set = set(table)
-    problems: List[str] = []
-
-    dupes = {n for n in table_set if table.count(n) > 1}
-    for n in sorted(dupes):
-        problems.append(f"duplicate table row: {n}")
-
-    for name in sorted(literals):
-        if name not in table_set:
-            where = ", ".join(sorted(literals[name]))
-            problems.append(
-                f"emitted but undocumented: {name} ({where}) — add a row "
-                "to the COMPONENTS.md observability table"
-            )
-
-    covered_by_wildcard: Set[str] = set()
-    for pat in wildcards:
-        hits = {n for n in table_set if re.match(pat, n)}
-        if not hits:
-            problems.append(
-                f"f-string call site matches no table row: /{pat}/"
-            )
-        covered_by_wildcard |= hits
-
-    for name in sorted(table_set):
-        if name not in literals and name not in covered_by_wildcard:
-            problems.append(
-                f"documented but never emitted: {name} — remove the row "
-                "or restore the call site"
-            )
-    return problems
+    return metricsdoc.lint(REPO)
 
 
 def main() -> None:

@@ -1,0 +1,639 @@
+"""Tier-1 gate for corro-analyze (`corrosion_tpu/analysis/`).
+
+Three layers, mirroring what the suite promises:
+
+1. THE REPO IS CLEAN: every rule runs repo-wide against the committed
+   `ANALYSIS_BASELINE.json` with no new findings and no stale baseline
+   entries, in well under the 10 s budget.
+2. EVERY CHECKER FIRES: per-rule seeded-violation fixtures — the
+   true-positive snippet fails, the minimal fix passes, and a
+   `# corro: noqa[rule]` comment suppresses (proving the whole
+   driver-side filter chain, not just the checker).
+3. THE FOLD IS LOSSLESS: the metrics lint folded into the framework
+   still reports the same 175 literal series + 2 wildcard sites in both
+   directions, and the `scripts/lint_metrics.py` shim keeps its API.
+
+All pure-AST: no jax tracing, no sqlite, no network — the gate must
+stay cheap (tier-1 runs near the 870 s kill).
+"""
+
+import json
+import os
+import sys
+import textwrap
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from corrosion_tpu.analysis import (  # noqa: E402
+    AnalysisContext,
+    run_analysis,
+)
+from corrosion_tpu.analysis.blocking import AsyncBlockingChecker  # noqa: E402
+from corrosion_tpu.analysis.codecext import CodecExtChecker  # noqa: E402
+from corrosion_tpu.analysis.lockcheck import (  # noqa: E402
+    LockDisciplineChecker,
+)
+from corrosion_tpu.analysis.metricsdoc import MetricsDocChecker  # noqa: E402
+from corrosion_tpu.analysis.parity import LaneParityChecker  # noqa: E402
+from corrosion_tpu.analysis.purity import KernelPurityChecker  # noqa: E402
+
+
+def _write(root, rel, body):
+    path = os.path.join(str(root), rel)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(textwrap.dedent(body))
+    return rel
+
+
+# -- 1. the repo itself -----------------------------------------------------
+
+
+def test_repo_runs_clean_against_baseline():
+    t0 = time.monotonic()
+    result = run_analysis(AnalysisContext(REPO))
+    elapsed = time.monotonic() - t0
+    assert result.new == [], "\n".join(f.render() for f in result.new)
+    assert result.stale_keys == [], result.stale_keys
+    # the CI/tooling satellite: the whole ≥6-rule pass stays cheap
+    assert elapsed < 10.0, f"corro-analyze took {elapsed:.1f}s (budget 10s)"
+
+
+def test_driver_cli_is_clean_and_fast():
+    import corro_lint
+
+    assert corro_lint.main([]) == 0
+    assert corro_lint.main(["--rules", "metrics-doc"]) == 0
+    assert corro_lint.main(["--rules", "nonsense"]) == 2
+
+
+def test_baseline_file_is_committed_and_justified():
+    with open(os.path.join(REPO, "ANALYSIS_BASELINE.json")) as f:
+        data = json.load(f)
+    assert data["version"] == 1
+    for e in data["entries"]:
+        assert e.get("justification"), f"unjustified baseline entry {e}"
+        assert "UNREVIEWED" not in e["justification"], e
+
+
+# -- 2. kernel-purity -------------------------------------------------------
+
+_PURE_KERNEL = """
+    import functools
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=("params",))
+    def tick_impl(state, rng, params):
+        mask = jnp.greater(state, 0)
+        if params.fancy:              # static branch: fine
+            extra = jnp.sum(mask)
+        else:
+            extra = jnp.int32(0)
+        return jnp.where(mask, state + extra, state)
+"""
+
+_IMPURE_KERNEL = """
+    import functools
+    import time
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=("params",))
+    def tick_impl(state, rng, params):
+        t0 = time.monotonic()
+        host = np.asarray(state)
+        total = float(jnp.sum(state))
+        peek = state.sum().item()
+        if jnp.any(state > 0):
+            state = state + 1
+        mask = jnp.greater(state, 0)
+        while mask.all():
+            break
+        return state
+"""
+
+
+def test_kernel_purity_fires_on_seeded_violations(tmp_path):
+    rel = _write(tmp_path, "ops/kern.py", _IMPURE_KERNEL)
+    ctx = AnalysisContext(str(tmp_path))
+    fs = KernelPurityChecker(scope=("ops",)).run(ctx)
+    msgs = "\n".join(f.message for f in fs)
+    assert any("time." in f.message for f in fs), msgs
+    assert any("numpy" in f.message for f in fs), msgs
+    assert any("float()" in f.message for f in fs), msgs
+    assert any(".item()" in f.message for f in fs), msgs
+    assert any("`if`" in f.message for f in fs), msgs
+    assert any("`while`" in f.message for f in fs), msgs
+    assert all(f.path == rel and f.symbol == "tick_impl" for f in fs)
+
+
+def test_kernel_purity_minimal_fix_passes(tmp_path):
+    _write(tmp_path, "ops/kern.py", _PURE_KERNEL)
+    ctx = AnalysisContext(str(tmp_path))
+    assert KernelPurityChecker(scope=("ops",)).run(ctx) == []
+
+
+def test_kernel_purity_ignores_host_wrappers(tmp_path):
+    # the un-jitted drain next to the kernel may do host work freely
+    _write(
+        tmp_path,
+        "ops/kern.py",
+        _PURE_KERNEL
+        + """
+    def stats_and_events(state):
+        import numpy as np
+        return float(np.asarray(state).sum())
+""",
+    )
+    ctx = AnalysisContext(str(tmp_path))
+    assert KernelPurityChecker(scope=("ops",)).run(ctx) == []
+
+
+def test_kernel_purity_noqa_suppresses(tmp_path):
+    body = _IMPURE_KERNEL.replace(
+        "peek = state.sum().item()",
+        "peek = state.sum().item()  # corro: noqa[kernel-purity]",
+    )
+    _write(tmp_path, "ops/kern.py", body)
+    ctx = AnalysisContext(str(tmp_path))
+    result = run_analysis(
+        ctx, [KernelPurityChecker(scope=("ops",))], baseline={}
+    )
+    assert any(".item()" in f.message for f in result.suppressed)
+    assert not any(".item()" in f.message for f in result.new)
+    assert result.new  # the other violations still fail
+
+
+# -- 3. lane-parity ---------------------------------------------------------
+
+
+def _parity_fixture(
+    tmp_path,
+    pview_lane="lhm",
+    pview_dtype="jnp.int32",
+    mesh_names='"events"',
+    extra_dense_lane="",
+):
+    dense_ring_init = (
+        "ring=jnp.zeros((8, 4), dtype=jnp.int32),"
+        if extra_dense_lane
+        else ""
+    )
+    _write(
+        tmp_path,
+        "ops/swim.py",
+        f"""
+        import jax
+        import jax.numpy as jnp
+        from corrosion_tpu.runtime.metrics import FLIGHT_CENSUS, KERNEL_EVENTS
+
+        class SwimState:
+            t: jax.Array
+            alive: jax.Array
+            events: jax.Array
+            lhm: jax.Array
+            {extra_dense_lane}
+
+        def _census_frame(n, alive):
+            return jnp.stack([jnp.sum(alive), jnp.max(alive)])
+
+        def _event_vector(**counts):
+            return jnp.stack([counts[k] for k in KERNEL_EVENTS])
+
+        def _init_state_impl(params, n):
+            return SwimState(
+                t=jnp.int32(0),
+                alive=jnp.ones(n, dtype=bool),
+                events=jnp.zeros(4, dtype=jnp.int32),
+                lhm=jnp.zeros(n, dtype=jnp.int32),
+                {dense_ring_init}
+            )
+        """,
+    )
+    _write(
+        tmp_path,
+        "ops/swim_pview.py",
+        f"""
+        import jax
+        import jax.numpy as jnp
+        from corrosion_tpu.ops.swim import _census_frame, _event_vector
+
+        LANE_DTYPE = jnp.int16
+
+        class PViewState:
+            t: jax.Array
+            alive: jax.Array
+            events: jax.Array
+            {pview_lane}: jax.Array
+
+        def _init_impl(params, n):
+            return PViewState(
+                t=jnp.int32(0),
+                alive=jnp.ones(n, dtype=bool),
+                events=jnp.zeros(4, dtype=jnp.int32),
+                {pview_lane}=jnp.zeros(n, dtype={pview_dtype}),
+            )
+        """,
+    )
+    _write(
+        tmp_path,
+        "mesh.py",
+        f"""
+        def _state_shardings(state, mesh):
+            out = {{}}
+            for name, arr in state._asdict().items():
+                if getattr(arr, "ndim", 0) == 0 or name in ({mesh_names},):
+                    out[name] = None
+            return out
+        """,
+    )
+    _write(
+        tmp_path,
+        "metrics.py",
+        """
+        KERNEL_EVENTS = ("a", "b", "c")
+        FLIGHT_CENSUS = ("census_alive", "inc_max")
+        FLIGHT_LANES = KERNEL_EVENTS + FLIGHT_CENSUS
+        """,
+    )
+    return LaneParityChecker(
+        dense="ops/swim.py",
+        pview="ops/swim_pview.py",
+        mesh="mesh.py",
+        metrics="metrics.py",
+    )
+
+
+def test_lane_parity_clean_on_matching_kernels(tmp_path):
+    checker = _parity_fixture(tmp_path)
+    assert checker.run(AnalysisContext(str(tmp_path))) == []
+
+
+def test_lane_parity_fires_on_name_drift(tmp_path):
+    checker = _parity_fixture(tmp_path, pview_lane="lhm_score")
+    fs = checker.run(AnalysisContext(str(tmp_path)))
+    assert any("diverges" in f.message and "lhm" in f.message for f in fs)
+
+
+def test_lane_parity_fires_on_dtype_drift(tmp_path):
+    checker = _parity_fixture(tmp_path, pview_dtype="LANE_DTYPE")
+    fs = checker.run(AnalysisContext(str(tmp_path)))
+    assert any(
+        "dtype diverges" in f.message and "int16" in f.message for f in fs
+    )
+
+
+def test_lane_parity_fires_on_unrouted_replicated_lane(tmp_path):
+    # dense kernel grows a non-per-member `ring` lane that mesh.py's
+    # by-name tuple does not replicate -> it would be member-sharded
+    checker = _parity_fixture(
+        tmp_path, extra_dense_lane="ring: jax.Array"
+    )
+    fs = checker.run(AnalysisContext(str(tmp_path)))
+    assert any("ring" in f.message and "replicated" in f.message for f in fs)
+
+
+def test_lane_parity_real_tree_is_clean():
+    assert LaneParityChecker().run(AnalysisContext(REPO)) == []
+
+
+# -- 4. async-blocking ------------------------------------------------------
+
+_BLOCKING_ASYNC = """
+    import asyncio
+    import shutil
+    import sqlite3
+    import time
+    from pathlib import Path
+
+    async def handler(conn, path):
+        time.sleep(0.1)
+        conn.execute("SELECT 1")
+        sqlite3.connect("x.db")
+        open(path).read()
+        Path(path).read_text()
+        shutil.rmtree(path)
+"""
+
+_ROUTED_ASYNC = """
+    import asyncio
+    import shutil
+    import sqlite3
+    import time
+    from pathlib import Path
+
+    async def handler(conn, path):
+        def work():
+            time.sleep(0.1)
+            conn.execute("SELECT 1")
+            sqlite3.connect("x.db")
+            open(path).read()
+            Path(path).read_text()
+            shutil.rmtree(path)
+        await asyncio.to_thread(work)
+        await asyncio.sleep(0.1)
+"""
+
+
+def test_async_blocking_fires_on_seeded_violations(tmp_path):
+    _write(tmp_path, "agent/loopy.py", _BLOCKING_ASYNC)
+    ctx = AnalysisContext(str(tmp_path))
+    fs = AsyncBlockingChecker(scope=("agent",)).run(ctx)
+    msgs = "\n".join(f.message for f in fs)
+    assert len(fs) == 6, msgs
+    assert any("time.sleep" in m for m in msgs.splitlines())
+    assert any(".execute" in f.message for f in fs)
+    assert any("sqlite3.connect" in f.message for f in fs)
+    assert any("open()" in f.message for f in fs)
+    assert any("Path.read_text" in f.message for f in fs)
+    assert any("rmtree" in f.message for f in fs)
+
+
+def test_async_blocking_nested_thread_bodies_pass(tmp_path):
+    # the SAME calls inside a nested sync def handed to to_thread are
+    # exactly the repo's discipline — zero findings
+    _write(tmp_path, "agent/loopy.py", _ROUTED_ASYNC)
+    ctx = AnalysisContext(str(tmp_path))
+    assert AsyncBlockingChecker(scope=("agent",)).run(ctx) == []
+
+
+def test_async_blocking_import_resolution(tmp_path):
+    # dataclasses.replace is not os.replace; asyncio.sleep is not
+    # time.sleep even when it arrives via `from asyncio import sleep`
+    _write(
+        tmp_path,
+        "agent/loopy.py",
+        """
+        from dataclasses import replace
+        from asyncio import sleep
+
+        async def handler(obj):
+            await sleep(0.1)
+            return replace(obj, x=1)
+        """,
+    )
+    ctx = AnalysisContext(str(tmp_path))
+    assert AsyncBlockingChecker(scope=("agent",)).run(ctx) == []
+
+
+def test_async_blocking_noqa_suppresses(tmp_path):
+    body = _BLOCKING_ASYNC.replace(
+        "time.sleep(0.1)",
+        "time.sleep(0.1)  # corro: noqa[async-blocking]",
+    )
+    _write(tmp_path, "agent/loopy.py", body)
+    ctx = AnalysisContext(str(tmp_path))
+    result = run_analysis(
+        ctx, [AsyncBlockingChecker(scope=("agent",))], baseline={}
+    )
+    assert len(result.suppressed) == 1
+    assert len(result.new) == 5
+
+
+# -- 5. lock-discipline -----------------------------------------------------
+
+_RACY_CLASS = """
+    import asyncio
+
+    class Store:
+        def __init__(self):
+            self.data = {}
+
+        def rebuild(self):
+            self.data["fresh"] = 1
+
+        def on_packet(self, k, v):
+            self.data[k] = v
+
+        async def loop(self):
+            await asyncio.to_thread(self.rebuild)
+"""
+
+_LOCKED_CLASS = """
+    import asyncio
+    import threading
+
+    class Store:
+        def __init__(self):
+            self.data = {}
+            self._lock = threading.Lock()
+
+        def rebuild(self):
+            with self._lock:
+                self.data["fresh"] = 1
+
+        def on_packet(self, k, v):
+            with self._lock:
+                self.data[k] = v
+
+        async def loop(self):
+            await asyncio.to_thread(self.rebuild)
+"""
+
+
+def test_lock_discipline_fires_on_thread_loop_race(tmp_path):
+    _write(tmp_path, "pkg/store.py", _RACY_CLASS)
+    ctx = AnalysisContext(str(tmp_path))
+    fs = LockDisciplineChecker(scope=("pkg",)).run(ctx)
+    assert len(fs) == 1
+    assert "Store.data" in fs[0].message
+    assert "rebuild" in fs[0].message
+
+
+def test_lock_discipline_locked_fix_passes(tmp_path):
+    _write(tmp_path, "pkg/store.py", _LOCKED_CLASS)
+    ctx = AnalysisContext(str(tmp_path))
+    assert LockDisciplineChecker(scope=("pkg",)).run(ctx) == []
+
+
+def test_lock_discipline_async_name_collision_exempt(tmp_path):
+    # another module to_threads a SYNC `close`; this class's `close` is
+    # async (cannot be a to_thread target) and must not be swept in
+    _write(
+        tmp_path,
+        "pkg/other.py",
+        """
+        import asyncio
+
+        class Worker:
+            def close(self):
+                pass
+
+        async def run(w):
+            await asyncio.to_thread(w.close)
+        """,
+    )
+    _write(
+        tmp_path,
+        "pkg/transport.py",
+        """
+        class Transport:
+            def __init__(self):
+                self.conns = {}
+
+            async def close(self):
+                self.conns.clear()
+
+            def on_open(self, k, v):
+                self.conns[k] = v
+        """,
+    )
+    ctx = AnalysisContext(str(tmp_path))
+    assert LockDisciplineChecker(scope=("pkg",)).run(ctx) == []
+
+
+def test_lock_discipline_noqa_suppresses(tmp_path):
+    body = _RACY_CLASS.replace(
+        'self.data["fresh"] = 1',
+        'self.data["fresh"] = 1  # corro: noqa[lock-discipline]',
+    )
+    _write(tmp_path, "pkg/store.py", body)
+    ctx = AnalysisContext(str(tmp_path))
+    result = run_analysis(
+        ctx, [LockDisciplineChecker(scope=("pkg",))], baseline={}
+    )
+    assert result.new == []
+    assert len(result.suppressed) == 1
+
+
+# -- 6. codec-ext -----------------------------------------------------------
+
+
+def _codec_fixture(tmp_path, with_reader=True, with_test=True):
+    reader = (
+        """
+    def decode_frame(data):
+        if data and data[-1] >= _FRAME_EXT_V1:
+            return data[:-1]
+        return data
+"""
+        if with_reader
+        else ""
+    )
+    _write(
+        tmp_path,
+        "codec.py",
+        """
+    _FRAME_EXT_V1 = 1
+
+    def encode_frame(payload, ext=False):
+        out = bytes(payload)
+        if ext:
+            out += bytes([_FRAME_EXT_V1])
+        return out
+"""
+        + reader,
+    )
+    _write(
+        tmp_path,
+        "tests/test_codec.py",
+        (
+            """
+    def test_frame_ext_old_new_compat():
+        from codec import encode_frame
+        assert encode_frame(b"x") == b"x"
+"""
+            if with_test
+            else "\n"
+        ),
+    )
+    return CodecExtChecker(
+        codec_files=("codec.py",), test_files=("tests/test_codec.py",)
+    )
+
+
+def test_codec_ext_clean_when_exhaustive(tmp_path):
+    checker = _codec_fixture(tmp_path)
+    assert checker.run(AnalysisContext(str(tmp_path))) == []
+
+
+def test_codec_ext_fires_on_missing_reader(tmp_path):
+    checker = _codec_fixture(tmp_path, with_reader=False)
+    fs = checker.run(AnalysisContext(str(tmp_path)))
+    assert any("no read path" in f.message for f in fs)
+
+
+def test_codec_ext_fires_on_missing_compat_test(tmp_path):
+    checker = _codec_fixture(tmp_path, with_test=False)
+    fs = checker.run(AnalysisContext(str(tmp_path)))
+    assert any("compat pin is missing" in f.message for f in fs)
+
+
+def test_codec_ext_real_tree_covers_all_gates():
+    # _SWIM_EXT_V1 + _ENVELOPE_EXT_V1/V2 all have both directions and
+    # compat tests today — and the checker actually saw them
+    from corrosion_tpu.analysis.codecext import _gate_constants
+
+    ctx = AnalysisContext(REPO)
+    gates = {}
+    for rel in CodecExtChecker().codec_files:
+        gates.update(_gate_constants(ctx.file(rel).tree))
+    assert {"_SWIM_EXT_V1", "_ENVELOPE_EXT_V1", "_ENVELOPE_EXT_V2"} <= set(
+        gates
+    )
+    assert CodecExtChecker().run(ctx) == []
+
+
+# -- 7. the metrics fold + baseline machinery -------------------------------
+
+
+def test_metrics_fold_reports_same_inventory():
+    """The lint_metrics fold is lossless: same 175 literal series, same
+    2 wildcard sites, both directions clean, via BOTH the framework
+    checker and the back-compat shim."""
+    import lint_metrics
+
+    assert MetricsDocChecker().run(AnalysisContext(REPO)) == []
+    assert lint_metrics.lint() == []
+    literals, wildcards = lint_metrics.scan_call_sites()
+    assert len(literals) == 175
+    assert len(wildcards) == 2
+    names = lint_metrics.parse_components_table()
+    assert len(names) == len(set(names))
+    assert set(literals) <= set(names)
+
+
+def test_baseline_grandfathers_and_goes_stale(tmp_path):
+    _write(tmp_path, "pkg/store.py", _RACY_CLASS)
+    ctx = AnalysisContext(str(tmp_path))
+    checker = LockDisciplineChecker(scope=("pkg",))
+    finding = checker.run(ctx)[0]
+
+    # grandfathered: the exact key is baselined -> not a new finding
+    result = run_analysis(
+        ctx, [checker], baseline={finding.key: "proven benign in test"}
+    )
+    assert result.new == [] and result.ok
+    assert [w for _, w in result.baselined] == ["proven benign in test"]
+
+    # stale: the violation is fixed but the baseline entry remains ->
+    # the run fails so the grandfather list can only shrink on purpose
+    _write(tmp_path, "pkg/store.py", _LOCKED_CLASS)
+    ctx2 = AnalysisContext(str(tmp_path))
+    result2 = run_analysis(
+        ctx2, [checker], baseline={finding.key: "proven benign in test"}
+    )
+    assert result2.new == [] and not result2.ok
+    assert result2.stale_keys == [finding.key]
+
+
+def test_baseline_keys_are_line_number_free(tmp_path):
+    # adding code ABOVE the finding must not churn the baseline key
+    _write(tmp_path, "pkg/store.py", _RACY_CLASS)
+    k1 = (
+        LockDisciplineChecker(scope=("pkg",))
+        .run(AnalysisContext(str(tmp_path)))[0]
+        .key
+    )
+    _write(tmp_path, "pkg/store.py", "X = 1\nY = 2\n" + textwrap.dedent(_RACY_CLASS))
+    k2 = (
+        LockDisciplineChecker(scope=("pkg",))
+        .run(AnalysisContext(str(tmp_path)))[0]
+        .key
+    )
+    assert k1 == k2
