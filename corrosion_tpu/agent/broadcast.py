@@ -24,7 +24,10 @@ from corrosion_tpu.net.transport import TransportError
 from corrosion_tpu.runtime.channels import ChannelClosed
 from corrosion_tpu.runtime.metrics import METRICS
 from corrosion_tpu.types.actor import Actor
-from corrosion_tpu.types.codec import encode_uni_payload
+from corrosion_tpu.types.codec import (
+    encode_uni_from_prefix,
+    encode_uni_prefix,
+)
 
 
 class TokenBucket:
@@ -50,12 +53,20 @@ class TokenBucket:
 class _Pending:
     due: float
     seq: int  # tiebreaker
+    # encode-once (r14): `payload` is the digest-free bytes, shared by
+    # every re-transmission; `prefix` (header + body + cluster id) is
+    # what a per-transmission digest ext gets appended to — the
+    # changeset body itself is never re-encoded after commit/decode
     payload: bytes = field(compare=False)
+    prefix: bytes = field(compare=False)
     origin: bytes = field(compare=False)  # actor id bytes to exclude
     send_count: int = field(compare=False, default=0)
     # origin commit wall clock (r11 latency plane): stamps the
     # commit→wire hop when the FIRST transmission happens
     origin_wall: Optional[float] = field(compare=False, default=None)
+    # envelope-ext stamps re-written per transmission with the digest
+    ext_origin_ts: Optional[float] = field(compare=False, default=None)
+    ext_traceparent: Optional[str] = field(compare=False, default=None)
 
 
 async def broadcast_loop(agent: Agent) -> None:
@@ -88,33 +99,28 @@ async def broadcast_loop(agent: Agent) -> None:
 
         now = time.monotonic()
         for item in batch:
-            # r12: offer the envelope ext to the observatory — a digest
-            # (own or relayed) piggybacks the broadcast plane the same
-            # way it rides gossip datagrams; uni frames have no packet
-            # budget, so any digest size fits
-            digest = (
-                agent.observatory.pick_ext(1 << 20, plane="broadcast")
-                if agent.observatory is not None
-                else None
-            )
-            payload = encode_uni_payload(
-                item.change, agent.cluster_id, digest=digest
-            )
+            cv = item.change
+            # encode-once: the body bytes were stamped at commit (local)
+            # or captured at decode (relay) — this wraps, not re-walks
+            prefix = encode_uni_prefix(cv, agent.cluster_id)
             seq += 1
             heapq.heappush(
                 pending,
                 _Pending(
                     due=now,
                     seq=seq,
-                    payload=payload,
-                    origin=item.change.actor_id.bytes16,
+                    payload=encode_uni_from_prefix(
+                        prefix, cv.origin_ts, cv.traceparent
+                    ),
+                    prefix=prefix,
+                    origin=cv.actor_id.bytes16,
                     send_count=0,
                     # only the ORIGIN node's own fresh changes stamp the
                     # commit→wire hop; relayed changes already counted
                     # theirs at their origin
-                    origin_wall=(
-                        item.change.origin_ts if item.is_local else None
-                    ),
+                    origin_wall=(cv.origin_ts if item.is_local else None),
+                    ext_origin_ts=cv.origin_ts,
+                    ext_traceparent=cv.traceparent,
                 ),
             )
 
@@ -156,7 +162,24 @@ async def _transmit(agent: Agent, bucket: TokenBucket, p: _Pending) -> bool:
     members = agent.members
     cfg = agent.membership.config
     limited = False
-    if len(p.payload) > bucket.capacity:
+    # r12/r14: offer the envelope ext to the observatory PER
+    # TRANSMISSION — a digest (own or relayed) piggybacks the broadcast
+    # plane the same way it rides gossip datagrams, appended to the
+    # shared prefix so the changeset body is never re-encoded; uni
+    # frames have no packet budget, so any digest size fits
+    digest = (
+        agent.observatory.pick_ext(1 << 20, plane="broadcast")
+        if agent.observatory is not None
+        else None
+    )
+    payload = (
+        p.payload
+        if digest is None
+        else encode_uni_from_prefix(
+            p.prefix, p.ext_origin_ts, p.ext_traceparent, digest
+        )
+    )
+    if len(payload) > bucket.capacity:
         # can never pass the bucket: drop instead of spinning forever
         METRICS.counter("corro.broadcast.oversized.dropped").inc()
         return False
@@ -187,14 +210,14 @@ async def _transmit(agent: Agent, bucket: TokenBucket, p: _Pending) -> bool:
 
     i = 0
     while i < len(targets):
-        if not bucket.try_take(len(p.payload)):
+        if not bucket.try_take(len(payload)):
             # halve remaining fanout under rate pressure (mod.rs:668-671)
             limited = True
             remaining = targets[i:]
             targets = targets[:i] + remaining[: max(1, len(remaining) // 2)]
             await asyncio.sleep(0.01)  # let the bucket refill a little
             continue
-        await _send_one(agent, targets[i], p.payload)
+        await _send_one(agent, targets[i], payload)
         i += 1
     return limited
 

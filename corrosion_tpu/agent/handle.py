@@ -83,6 +83,9 @@ class Agent:
     # r12 cluster observatory (agent/observatory.py): digest
     # anti-entropy store + view-divergence detector, serves /v1/cluster
     observatory: Optional[object] = None
+    # r14 write-path group commit (agent/run.py GroupCommitter):
+    # concurrent local writers coalesce into shared sqlite transactions
+    commit_group: Optional[object] = None
     # instrumented-lock registry (agent.rs:707-1066), admin `locks` command
     lock_registry: LockRegistry = field(default_factory=LockRegistry)
 

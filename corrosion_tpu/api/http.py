@@ -627,9 +627,12 @@ def _bind_params(stmt: Statement):
 
 
 def _execute_stmt(tx, stmt: Statement) -> int:
+    # both paths go through WriteTx.execute: one trace/timing point and
+    # one faithful rows_affected mapping (DML counts pass through, -1
+    # row-less statement classes report 0)
     if stmt.named_params:
-        cur = tx.conn.execute(
-            stmt.query, {k.lstrip(":@$"): v for k, v in stmt.named_params.items()}
+        return tx.execute(
+            stmt.query,
+            {k.lstrip(":@$"): v for k, v in stmt.named_params.items()},
         )
-        return cur.rowcount if cur.rowcount > 0 else 0
     return tx.execute(stmt.query, stmt.params)

@@ -96,6 +96,17 @@ class PerfConfig:
     sync_peers_min: int = 3
     sync_peers_max: int = 10
     max_concurrent_inbound_syncs: int = 3
+    # local-commit group coalescing (r14): concurrent local writers
+    # batch into one BEGIN IMMEDIATE..COMMIT (one fsync, one store-lock
+    # hold, consecutive db_versions; per-writer SAVEPOINT isolation).
+    # The first writer commits immediately when nobody else is queued,
+    # so solo p50 latency is unchanged; `group_commit_wait` > 0 adds an
+    # opt-in extra coalescing window for bursty single writers, and the
+    # writer/byte budgets bound one shared transaction's blast radius.
+    group_commit: bool = True
+    group_commit_wait: float = 0.0
+    group_commit_max_writers: int = 64
+    group_commit_max_bytes: int = 1 << 20
     # broadcast
     broadcast_interval_ms: int = 500
     broadcast_cutoff_bytes: int = 64 * 1024
@@ -142,13 +153,17 @@ class SloConfig:
 @dataclass
 class PubsubConfig:
     """[pubsub] — live-query matcher knobs.  `candidate_batch_wait` is
-    the matcher's candidate-batching window in seconds (pubsub.rs:1069
-    parity default 0.6): the PR-6 SLO plane attributed today's ~600 ms
-    p50 write→event total to exactly this wait, so it is now an
-    operator knob (surfaced in /v1/status) — lower it to trade matcher
-    batching efficiency for `corro.e2e.match` latency."""
+    the matcher's candidate-batching window in seconds: the PR-6 SLO
+    plane attributed the old ~600 ms p50 write→event total to exactly
+    this wait (the pubsub.rs:1069 parity value 0.6, kept as the
+    matcher-module constant), and since the r10 matcher is ~6 ms/batch
+    FLAT the wide window bought nothing — the default is now 0.1 s
+    (write→event p50 ~0.6 s → ~0.15 s, SLO_BASELINE.json, with no
+    events/s regression in PUBSUB_BENCH.json).  Operators can raise it
+    back to trade match latency for fewer, larger diff batches under
+    extreme write fan-in (surfaced in /v1/status)."""
 
-    candidate_batch_wait: float = 0.6
+    candidate_batch_wait: float = 0.1
 
 
 @dataclass

@@ -173,6 +173,49 @@ def _native_batch_enabled() -> bool:
     return os.environ.get("CORRO_NATIVE_BATCH", "1") != "0"
 
 
+def _finalize_engine() -> str:
+    """Engine for `WriteTx._finalize_pending` (the local-commit clock
+    bookkeeping).  "vector" (default, r14): bulk-probe current cl/clock
+    state for every pending pk with chunked IN(...) reads, run the
+    dedupe/sentinel/col_version decisions as pure in-memory passes, and
+    flush with a handful of prepared executemany statements — the
+    `_apply_batch` shape applied to the write side.  "percell": the
+    per-cell reference loop (one SELECT+upsert round-trip per pending
+    cell), kept as the semantic reference for the randomized
+    equivalence pin (tests/test_finalize_batch.py) and the ingest
+    bench's pre mode."""
+    eng = os.environ.get("CORRO_FINALIZE", "vector")
+    if eng not in ("vector", "percell"):
+        raise ValueError(
+            f"unknown CORRO_FINALIZE {eng!r} (expected 'vector' or 'percell')"
+        )
+    return eng
+
+
+# bound-variable budget for the finalize IN(...) probes: 3.32+ builds
+# allow 32766 bound parameters, older ones 999 — shrink once on the old
+# cap instead of pre-chunking everything to the worst case (the whole
+# point is one probe statement per table at real transaction sizes)
+_PROBE_CHUNK = [8000]
+
+
+def _iter_in_chunks(conn, sql_fmt: str, keys: Sequence):
+    """Yield rows of `sql_fmt.format(marks=...)` over `keys`, chunked to
+    the build's bound-variable budget."""
+    i = 0
+    while i < len(keys):
+        chunk = keys[i : i + _PROBE_CHUNK[0]]
+        try:
+            marks = ",".join("?" * len(chunk))
+            yield from conn.execute(sql_fmt.format(marks=marks), list(chunk))
+        except sqlite3.OperationalError as e:
+            if "too many" in str(e) and _PROBE_CHUNK[0] > 900:
+                _PROBE_CHUNK[0] = 900
+                continue
+            raise
+        i += len(chunk)
+
+
 def _merge_engine() -> str:
     """Engine order for the batch decision plane (phase B).
 
@@ -198,6 +241,46 @@ def _merge_engine() -> str:
     if eng == "native" and not _native_batch_enabled():
         return "python"
     return eng
+
+
+def _dedupe_pending(pending):
+    """Collapse one sub-transaction's trigger log (same output as the
+    per-cell reference's dedupe, O(n)): last write in the tx wins per
+    (table, pk, cid); a delete marker drops the row's other pending
+    entries; a key re-added after a delete re-appends, so the
+    reverse-dedupe keeps each surviving key's LAST fresh insertion slot
+    (the reference's `order.remove` + append behavior).
+
+    Returns (cells, order, deleted_rows)."""
+    cells: Dict[Tuple[str, bytes, str], SqliteValue] = {}
+    order: List[Tuple[str, bytes, str]] = []
+    deleted_rows: Dict[Tuple[str, bytes], bool] = {}
+    row_keys: Dict[Tuple[str, bytes], set] = {}
+    for r in pending:
+        tbl, pk, cid, val = r["tbl"], bytes(r["pk"]), r["cid"], r["val"]
+        if cid == SENTINEL + "X":  # delete marker from the del trigger
+            deleted_rows[(tbl, pk)] = True
+            for key in row_keys.pop((tbl, pk), ()):
+                cells.pop(key, None)
+            continue
+        if cid == SENTINEL:
+            deleted_rows.pop((tbl, pk), None)
+        key = (tbl, pk, cid)
+        if key not in cells:
+            order.append(key)
+        cells[key] = val
+        row_keys.setdefault((tbl, pk), set()).add(key)
+    if len(order) != len(cells):
+        # delete/re-insert chains left stale slots: keep the LAST
+        # occurrence of each surviving key, preserving relative order
+        seen: set = set()
+        fresh: List[Tuple[str, bytes, str]] = []
+        for key in reversed(order):
+            if key in cells and key not in seen:
+                seen.add(key)
+                fresh.append(key)
+        order = fresh[::-1]
+    return cells, order, deleted_rows
 
 
 def _clock_entry(ch: Change, col_version: int) -> tuple:
@@ -716,9 +799,218 @@ class CrdtStore:
 
     # -- local writes ------------------------------------------------------
 
-    def write_tx(self, ts: Timestamp) -> "WriteTx":
-        """Begin a local write transaction capturing CRDT changes."""
-        return WriteTx(self, ts)
+    def write_tx(self, ts: Timestamp, nested: bool = False) -> "WriteTx":
+        """Begin a local write transaction capturing CRDT changes.
+
+        ``nested=True`` begins a SAVEPOINT sub-transaction for use
+        inside a ``group_tx`` scope (r14 group commit): the sub-tx gets
+        per-writer rollback isolation while the leader's one
+        BEGIN/COMMIT (one fsync, one lock hold) covers the batch."""
+        return WriteTx(self, ts, nested=nested)
+
+    def finalize_group(self, items) -> List[Tuple[List[Change], int, int]]:
+        """Finalize one or more sub-transactions' pending logs in ONE
+        vectorized pass (r14): the dedupe → sentinel → col_version
+        decisions run purely in memory over a single bulk-read of the
+        current cl/clock state, and the final clock/rows state flushes
+        with one executemany per (table × statement shape) for the
+        WHOLE batch — the `_apply_batch` shape applied to local commits.
+
+        ``items`` is ``[(pending_rows, ts), ...]`` in commit order; the
+        caller holds the store lock and the open (group) transaction,
+        and every item's data-table effects are already applied (a
+        rolled-back sub-tx must not be passed here).  Items with
+        changes get consecutive db_versions.  Returns
+        ``[(changes, db_version, last_seq), ...]`` aligned to items
+        (db_version 0 = the item produced no changes).
+
+        Cross-item semantics are identical to committing the items as
+        separate sequential transactions (pinned in
+        tests/test_group_commit.py): later items see earlier items'
+        cl/col_version effects through the shared in-memory state the
+        way sequential commits see them through the database."""
+        conn = self._conn
+        site = self.site_id
+
+        deduped = [_dedupe_pending(pending) for pending, _ts in items]
+
+        # -- phase A: ONE bulk read over the union of touched keys ---------
+        probe_pks: Dict[str, set] = {}  # rows-table probe (all touched pks)
+        clock_pks: Dict[str, set] = {}  # clock probe (pks with col writes)
+        clock_need: set = set()  # (tbl, pk, cid) whose cv decides col_version
+        for cells, order, deleted_rows in deduped:
+            for (tbl, pk) in deleted_rows:
+                probe_pks.setdefault(tbl, set()).add(pk)
+            for (tbl, pk, cid) in order:
+                probe_pks.setdefault(tbl, set()).add(pk)
+                if cid != SENTINEL:
+                    clock_pks.setdefault(tbl, set()).add(pk)
+                    clock_need.add((tbl, pk, cid))
+        cur_cl: Dict[Tuple[str, bytes], int] = {}  # absent key = no row yet
+        # live col_version view per (tbl, pk): starts as the disk state,
+        # mutated by clears/puts so later items (and later cells) see
+        # exactly what a sequential re-read would have seen
+        cv_state: Dict[Tuple[str, bytes], Dict[str, int]] = {}
+        for tbl, pks in probe_pks.items():
+            rt = _rows_table(tbl)
+            for r in _iter_in_chunks(
+                conn,
+                f'SELECT pk, cl FROM "{rt}" WHERE pk IN ({{marks}})',
+                list(pks),
+            ):
+                cur_cl[(tbl, bytes(r["pk"]))] = r["cl"]
+        for tbl, pks in clock_pks.items():
+            ct = _clock_table(tbl)
+            for r in _iter_in_chunks(
+                conn,
+                f'SELECT pk, cid, col_version FROM "{ct}"'
+                f" WHERE pk IN ({{marks}})",
+                list(pks),
+            ):
+                key = (tbl, bytes(r["pk"]), r["cid"])
+                if key in clock_need:
+                    cv_state.setdefault(key[:2], {})[r["cid"]] = (
+                        r["col_version"]
+                    )
+
+        # -- phase B: per-item in-memory decisions, shared live state ------
+        rows_up: Dict[str, Dict[bytes, int]] = {}
+        clock_clear: Dict[str, Dict[bytes, None]] = {}  # ordered set
+        clock_put: Dict[str, Dict[bytes, Dict[str, tuple]]] = {}
+        out: List[List[Change]] = []
+        next_dv = self.db_version_for(site) + 1
+
+        for (cells, order, deleted_rows), (_pending, ts) in zip(
+            deduped, items
+        ):
+            db_version = next_dv
+            changes: List[Change] = []
+
+            def emit(tbl, pk, cid, val, col_version, cl):
+                changes.append(
+                    Change(
+                        table=tbl, pk=pk, cid=cid, val=val,
+                        col_version=col_version, db_version=db_version,
+                        seq=len(changes), site_id=site.bytes16, cl=cl,
+                        ts=ts,
+                    )
+                )
+
+            def clear_clocks(tbl, pk):
+                clock_clear.setdefault(tbl, {})[pk] = None
+                cv_state.pop((tbl, pk), None)
+                puts = clock_put.get(tbl, {}).get(pk)
+                if puts:
+                    for c in [c for c in puts if c != SENTINEL]:
+                        del puts[c]
+
+            # deletes first: sentinel change with bumped-even cl
+            for (tbl, pk) in deleted_rows:
+                cl = cur_cl.get((tbl, pk), 1) + 1
+                if cl % 2 == 1:
+                    cl += 1  # already deleted? keep even
+                cur_cl[(tbl, pk)] = cl
+                rows_up.setdefault(tbl, {})[pk] = cl
+                clear_clocks(tbl, pk)
+                emit(tbl, pk, SENTINEL, None, cl, cl)
+                clock_put.setdefault(tbl, {}).setdefault(pk, {})[
+                    SENTINEL
+                ] = _clock_entry(changes[-1], cl)
+
+            # creations/updates
+            for key in order:
+                tbl, pk, cid = key
+                k2 = (tbl, pk)
+                if cid == SENTINEL:
+                    # row creation (or resurrection)
+                    exists = k2 in cur_cl
+                    prev_cl = cur_cl.get(k2, 0)
+                    cl = prev_cl + 1 if prev_cl % 2 == 0 else prev_cl
+                    if not exists or prev_cl % 2 == 0:
+                        cur_cl[k2] = cl
+                        rows_up.setdefault(tbl, {})[pk] = cl
+                        if prev_cl % 2 == 0 and prev_cl > 0:
+                            # resurrection: reset column clocks
+                            clear_clocks(tbl, pk)
+                        emit(tbl, pk, SENTINEL, None, cl, cl)
+                        clock_put.setdefault(tbl, {}).setdefault(pk, {})[
+                            SENTINEL
+                        ] = _clock_entry(changes[-1], cl)
+                    continue
+                # column write on a (now) live row
+                cl = cur_cl.get(k2, 1)
+                col_version = cv_state.get(k2, {}).get(cid, 0) + 1
+                emit(tbl, pk, cid, cells[key], col_version, cl)
+                cv_state.setdefault(k2, {})[cid] = col_version
+                clock_put.setdefault(tbl, {}).setdefault(pk, {})[cid] = (
+                    _clock_entry(changes[-1], col_version)
+                )
+
+            if changes:
+                next_dv += 1
+            out.append(changes)
+
+        # -- phase C: ONE bulk flush for the whole batch -------------------
+        for tbl in {
+            t for d in (rows_up, clock_clear, clock_put) for t in d
+        }:
+            rt, ct = _rows_table(tbl), _clock_table(tbl)
+            if rows_up.get(tbl):
+                conn.executemany(
+                    f'INSERT INTO "{rt}" (pk, cl) VALUES (?, ?)'
+                    " ON CONFLICT (pk) DO UPDATE SET cl = excluded.cl",
+                    list(rows_up[tbl].items()),
+                )
+            if clock_clear.get(tbl):
+                conn.executemany(
+                    f'DELETE FROM "{ct}" WHERE pk = ? AND cid != ?',
+                    [(pk, SENTINEL) for pk in clock_clear[tbl]],
+                )
+            if clock_put.get(tbl):
+                conn.executemany(
+                    f'INSERT INTO "{ct}" (pk, cid, col_version, db_version,'
+                    " seq, site_id, ts) VALUES (?,?,?,?,?,?,?)"
+                    " ON CONFLICT (pk, cid) DO UPDATE SET"
+                    " col_version = excluded.col_version,"
+                    " db_version = excluded.db_version,"
+                    " seq = excluded.seq, site_id = excluded.site_id,"
+                    " ts = excluded.ts",
+                    [
+                        (pk, cid, cv, dbv, sq, st, ts)
+                        for pk, entries in clock_put[tbl].items()
+                        for cid, (cv, dbv, sq, st, ts) in entries.items()
+                    ],
+                )
+
+        if next_dv > self.db_version_for(site) + 1:
+            self._bump_db_version(site, next_dv - 1)
+        results: List[Tuple[List[Change], int, int]] = []
+        for changes in out:
+            if changes:
+                dv = changes[0].db_version
+                last_seq = changes[-1].seq
+                self.record_last_seq(site, dv, last_seq)
+                results.append((changes, dv, last_seq))
+            else:
+                results.append(([], 0, 0))
+        return results
+
+    @contextlib.contextmanager
+    def group_tx(self):
+        """Leader scope for a group commit: ONE store-lock hold and ONE
+        BEGIN IMMEDIATE..COMMIT shared by several `write_tx(nested=True)`
+        sub-transactions (the r14 write-path coalescer).  A failure of
+        the outer COMMIT itself rolls back every sub-tx in the batch;
+        individual writer failures are contained by their savepoints."""
+        with self._lock:
+            self._conn.execute("BEGIN IMMEDIATE")
+            self._conn.execute("DELETE FROM __crdt_pending")
+            try:
+                yield self
+                self._conn.execute("COMMIT")
+            except BaseException:
+                _safe_rollback(self._conn)
+                raise
 
     # -- serving changes (crsql_changes reads) ----------------------------
 
@@ -1823,51 +2115,135 @@ class WriteTx:
     make_broadcastable_changes + insert_local_changes,
     `api/public/mod.rs:57-258`, change.rs:188)."""
 
-    def __init__(self, store: CrdtStore, ts: Timestamp):
+    def __init__(
+        self, store: CrdtStore, ts: Timestamp, nested: bool = False
+    ):
         self.store = store
         self.ts = ts
         self._done = False
+        # nested=True: a sub-transaction of a group commit — the caller
+        # (CrdtStore.group_tx leader) holds the store lock and the outer
+        # BEGIN IMMEDIATE; this tx is a SAVEPOINT so a failed writer
+        # rolls back alone without aborting its batchmates
+        self._nested = nested
 
     def __enter__(self) -> "WriteTx":
         self.store._lock.acquire()
         self.conn = self.store._conn
-        self.conn.execute("BEGIN IMMEDIATE")
-        self.conn.execute("DELETE FROM __crdt_pending")
+        if self._nested:
+            # group_tx cleared __crdt_pending once for the whole batch,
+            # and a failed sub-tx's savepoint rollback restores the
+            # empty state — no per-writer defensive DELETE needed
+            self.conn.execute("SAVEPOINT __corro_wtx")
+        else:
+            self.conn.execute("BEGIN IMMEDIATE")
+            self.conn.execute("DELETE FROM __crdt_pending")
         return self
 
-    def execute(self, sql: str, params: Sequence[SqliteValue] = ()) -> int:
+    def execute(self, sql: str, params=()) -> int:
+        """Run one statement; returns its faithful rows_affected.
+
+        sqlite3 reports -1 for statement classes that have no row count
+        (DDL, SELECT) — report those as 0 rather than letting -1 leak
+        into summed ExecResult.rows_affected; genuine DML counts
+        (including a DELETE/UPDATE matching nothing → 0) pass through
+        untouched.  `params` may be a sequence or a dict (named
+        parameters), so the /v1/transactions named-param path shares
+        this trace/timing point."""
         from corrosion_tpu.runtime.trace import timed_query
 
         with timed_query(sql):
-            cur = self.conn.execute(sql, tuple(params))
-        return cur.rowcount if cur.rowcount > 0 else 0
+            cur = self.conn.execute(
+                sql, params if isinstance(params, dict) else tuple(params)
+            )
+        return cur.rowcount if cur.rowcount >= 0 else 0
+
+    def executemany(self, sql: str, rows: Sequence) -> int:
+        """Bulk DML: one prepared statement stepped over many parameter
+        rows (the write-side counterpart of the r10 matcher's
+        executemany flushes — bulk ingest writers should prefer this
+        over a Python loop of `execute`).  Returns total rows affected."""
+        from corrosion_tpu.runtime.trace import timed_query
+
+        with timed_query(sql):
+            cur = self.conn.executemany(sql, list(rows))
+        return cur.rowcount if cur.rowcount >= 0 else 0
 
     def commit(self) -> Tuple[List[Change], int, int]:
         """Finalize: assign db_version/seqs, write clocks, return
         (changes, db_version, last_seq). db_version == 0 → no changes."""
-        store = self.store
+        import time as _time
+
+        from corrosion_tpu.runtime.metrics import METRICS
+
         conn = self.conn
         try:
             pending = conn.execute(
                 "SELECT rowseq, tbl, pk, cid, val FROM __crdt_pending"
                 " ORDER BY rowseq"
             ).fetchall()
+            t0 = _time.monotonic()
             changes = self._finalize_pending(pending)
+            if pending:
+                METRICS.histogram("corro.write.finalize.seconds").observe(
+                    _time.monotonic() - t0
+                )
             conn.execute("DELETE FROM __crdt_pending")
-            conn.execute("COMMIT")
+            if self._nested:
+                conn.execute("RELEASE SAVEPOINT __corro_wtx")
+            else:
+                conn.execute("COMMIT")
             self._done = True
             if changes:
                 db_version = changes[0].db_version
                 return changes, db_version, changes[-1].seq
             return [], 0, 0
         except BaseException:
-            _safe_rollback(conn)
+            if self._nested:
+                self._rollback_nested()
+            else:
+                _safe_rollback(conn)
             self._done = True
             raise
 
+    def commit_deferred(self) -> list:
+        """Group-commit half-commit (nested mode only): capture + clear
+        this sub-tx's pending log and release the savepoint WITHOUT
+        finalizing — the leader finalizes the whole batch in one
+        vectorized pass (`CrdtStore.finalize_group`), so the batch pays
+        one probe/flush round instead of one per writer."""
+        conn = self.conn
+        try:
+            pending = conn.execute(
+                "SELECT rowseq, tbl, pk, cid, val FROM __crdt_pending"
+                " ORDER BY rowseq"
+            ).fetchall()
+            if pending:
+                conn.execute("DELETE FROM __crdt_pending")
+            conn.execute("RELEASE SAVEPOINT __corro_wtx")
+            self._done = True
+            return pending
+        except BaseException:
+            self._rollback_nested()
+            self._done = True
+            raise
+
+    def _rollback_nested(self) -> None:
+        """Undo this sub-transaction only; the outer group tx lives on.
+        If the OUTER transaction was already rolled back (interrupt),
+        the savepoint is gone with it — nothing left to undo."""
+        try:
+            self.conn.execute("ROLLBACK TO __corro_wtx")
+            self.conn.execute("RELEASE SAVEPOINT __corro_wtx")
+        except sqlite3.OperationalError as e:
+            log.debug("nested rollback raced outer rollback: %s", e)
+
     def rollback(self) -> None:
         if not self._done:
-            _safe_rollback(self.conn)
+            if self._nested:
+                self._rollback_nested()
+            else:
+                _safe_rollback(self.conn)
             self._done = True
 
     def __exit__(self, exc_type, exc, tb) -> bool:
@@ -1882,10 +2258,29 @@ class WriteTx:
         return False
 
     def _finalize_pending(self, pending) -> List[Change]:
-        store = self.store
-        conn = self.conn
         if not pending:
             return []
+        if _finalize_engine() == "percell":
+            return self._finalize_pending_percell(pending)
+        return self._finalize_pending_vector(pending)
+
+    def _finalize_pending_vector(self, pending) -> List[Change]:
+        """Vectorized finalize (r14): the `_apply_batch` shape on the
+        local-commit side — one item's worth of `finalize_group`.
+        Semantics are pinned byte/clock-identical to
+        `_finalize_pending_percell` by tests/test_finalize_batch.py
+        (randomized equivalence)."""
+        changes, _dv, _ls = self.store.finalize_group(
+            [(pending, self.ts)]
+        )[0]
+        return changes
+
+    def _finalize_pending_percell(self, pending) -> List[Change]:
+        """Per-cell reference finalize: one SELECT/upsert round-trip per
+        pending cell.  The semantic reference the vectorized path is
+        pinned against — do not optimize this loop."""
+        store = self.store
+        conn = self.conn
         site = store.site_id
         db_version = store.db_version_for(site) + 1
 

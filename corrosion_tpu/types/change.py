@@ -107,6 +107,15 @@ class ChangeV1:
     # eager broadcast path (sync already carries one in SyncStart).
     origin_ts: Optional[float] = field(default=None, compare=False)
     traceparent: Optional[str] = field(default=None, compare=False)
+    # r14 encode-once: the speedy-encoded `actor_id + changeset` body
+    # (types/codec.py `encode_change_v1_body`).  Stamped ONCE at local
+    # commit and on broadcast decode (the receiver already holds the
+    # bytes), then reused verbatim by every uni/sync encode instead of
+    # re-serializing the changeset per transmission/relay.  Pure cache:
+    # never part of identity, never required to be present.
+    wire_body: Optional[bytes] = field(
+        default=None, compare=False, repr=False
+    )
 
     @property
     def versions(self) -> Tuple[int, int]:

@@ -199,16 +199,21 @@ def read_value(r: Reader) -> SqliteValue:
 # -- Change (derive order: change.rs:19-29) --------------------------------
 
 
+_CHANGE_TAIL = struct.Struct("<qQQ")
+
+
 def write_change(w: Writer, c: Change) -> None:
+    # hot path (every broadcast/sync encode walks one of these per cell
+    # when no wire_body is cached): fixed-width tail fused into single
+    # packs — byte layout unchanged (golden tests in test_codec.py)
     w.string(c.table)
     w.vec_u8(c.pk)
     w.string(c.cid)
     write_value(w, c.val)
-    w.i64(c.col_version)
-    w.u64(c.db_version)
-    w.u64(c.seq)
-    w.raw(c.site_id)
-    w.i64(c.cl)
+    buf = w.buf
+    buf += _CHANGE_TAIL.pack(c.col_version, c.db_version, c.seq)
+    buf += c.site_id
+    buf += struct.pack("<q", c.cl)
 
 
 def read_change(r: Reader) -> Change:
@@ -340,16 +345,86 @@ def _read_envelope_ext(
 
 
 def _with_ext(
-    cv: ChangeV1, origin_ts: Optional[float], traceparent: Optional[str]
+    cv: ChangeV1,
+    origin_ts: Optional[float],
+    traceparent: Optional[str],
+    wire_body: Optional[bytes] = None,
 ) -> ChangeV1:
-    if origin_ts is None and traceparent is None:
+    if origin_ts is None and traceparent is None and wire_body is None:
         return cv
     from dataclasses import replace
 
-    return replace(cv, origin_ts=origin_ts, traceparent=traceparent)
+    return replace(
+        cv,
+        origin_ts=origin_ts,
+        traceparent=traceparent,
+        wire_body=wire_body if wire_body is not None else cv.wire_body,
+    )
 
 
 # -- UniPayload / BiPayload (derived, u32 tags) ----------------------------
+#
+# r14 encode-once: the `actor_id + changeset` body dominates every uni
+# payload's bytes and never changes between transmissions — so it is
+# serialized ONCE (at local commit, or captured from the received frame
+# on decode) and carried on `ChangeV1.wire_body`; `encode_uni_prefix`
+# splices the shared bytes instead of re-walking the changeset, and only
+# the cheap trailing envelope ext (origin stamp / traceparent / per-
+# transmission digest) is re-written per send.  `encode_uni_payload`
+# output is byte-identical either way (pinned in test_codec.py).
+
+
+def encode_change_v1_body(cv: ChangeV1) -> bytes:
+    """The shareable uni/sync body: actor_id + changeset, speedy layout."""
+    w = Writer()
+    write_change_v1(w, cv)
+    return w.bytes()
+
+
+def with_wire_body(cv: ChangeV1) -> ChangeV1:
+    """Return `cv` carrying its encoded body (encode-once stamp point)."""
+    if cv.wire_body is not None:
+        return cv
+    from dataclasses import replace
+
+    return replace(cv, wire_body=encode_change_v1_body(cv))
+
+
+def _write_body(w: Writer, cv: ChangeV1) -> None:
+    if cv.wire_body is not None:
+        from corrosion_tpu.runtime.metrics import METRICS
+
+        METRICS.counter("corro.codec.encode.shared.total").inc()
+        w.raw(cv.wire_body)
+    else:
+        write_change_v1(w, cv)
+
+
+def encode_uni_prefix(
+    cv: ChangeV1, cluster_id: ClusterId = ClusterId(0)
+) -> bytes:
+    """Everything up to (excluding) the envelope ext: variant header +
+    shared body + cluster id.  Reused across a payload's
+    re-transmissions, which only re-write the trailing ext."""
+    w = Writer()
+    w.u32(0)  # UniPayload::V1
+    w.u32(0)  # UniPayloadV1::Broadcast
+    w.u32(0)  # BroadcastV1::Change
+    _write_body(w, cv)
+    w.u16(cluster_id.value)
+    return w.bytes()
+
+
+def encode_uni_from_prefix(
+    prefix: bytes,
+    origin_ts: Optional[float],
+    traceparent: Optional[str],
+    digest: Optional[bytes] = None,
+) -> bytes:
+    w = Writer()
+    w.raw(prefix)
+    _write_envelope_ext(w, origin_ts, traceparent, digest)
+    return w.bytes()
 
 
 def encode_uni_payload(
@@ -360,14 +435,12 @@ def encode_uni_payload(
     """`digest` (r12): an encoded telemetry digest piggybacking the
     broadcast plane (agent/observatory.py) — rides the trailing envelope
     ext, never changes digest-free bytes."""
-    w = Writer()
-    w.u32(0)  # UniPayload::V1
-    w.u32(0)  # UniPayloadV1::Broadcast
-    w.u32(0)  # BroadcastV1::Change
-    write_change_v1(w, cv)
-    w.u16(cluster_id.value)
-    _write_envelope_ext(w, cv.origin_ts, cv.traceparent, digest)
-    return w.bytes()
+    return encode_uni_from_prefix(
+        encode_uni_prefix(cv, cluster_id),
+        cv.origin_ts,
+        cv.traceparent,
+        digest,
+    )
 
 
 def decode_uni_payload_ext(
@@ -378,10 +451,18 @@ def decode_uni_payload_ext(
     r = Reader(data)
     if r.u32() != 0 or r.u32() != 0 or r.u32() != 0:
         raise ValueError("unknown UniPayload variant")
+    body_start = r.pos
     cv = read_change_v1(r)
+    # encode-once (r14): the receiver already holds the encoded body —
+    # keep it so a relay wraps these bytes instead of re-serializing
+    body = bytes(r.data[body_start : r.pos])
     cluster_id = ClusterId(r.u16()) if not r.eof() else ClusterId(0)  # default_on_eof
     origin_ts, traceparent, digest = _read_envelope_ext(r)
-    return _with_ext(cv, origin_ts, traceparent), cluster_id, digest
+    return (
+        _with_ext(cv, origin_ts, traceparent, wire_body=body),
+        cluster_id,
+        digest,
+    )
 
 
 def decode_uni_payload(data: bytes) -> Tuple[ChangeV1, ClusterId]:
@@ -560,7 +641,7 @@ def encode_sync_msg(msg) -> bytes:
         _write_sync_state(w, msg)
     elif isinstance(msg, ChangeV1):
         w.u32(_SYNC_CHANGESET)
-        write_change_v1(w, msg)
+        _write_body(w, msg)  # encode-once: shared body bytes when stamped
         # next to the W3C traceparent that already rides SyncStart:
         # the origin wall stamp (freshness-gated by the sync server)
         _write_envelope_ext(w, msg.origin_ts, msg.traceparent)

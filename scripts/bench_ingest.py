@@ -1,14 +1,41 @@
-"""Measure CRDT ingestion throughput (changes/s): batched vs per-row.
+"""Ingest/write-path throughput trajectory → INGEST_BENCH.json (r14).
 
 The reference logs changes/s per sync round (`agent/handlers.rs:884-895`);
-this bench produces the comparable number for our store's remote-apply
-path, before/after the round-2 batching of `apply_changes`.
+this bench banks the comparable numbers for BOTH sides of our write
+plane, before/after the r14 write-path round (group-commit local
+transactions + vectorized `_finalize_pending` + encode-once broadcast):
 
-Usage: python scripts/bench_ingest.py [n_changes] [batch_size]
+  ingest-local-wN   rows/s through the REAL public write path
+                    (`make_broadcastable_changes` on a booted agent) at
+                    N ∈ {1, 4, 16} concurrent writers, plus per-commit
+                    p50/p99 latency (the solo-p50-unchanged guard).
+  ingest-remote     remote-apply rows/s (`CrdtStore.apply_changes`,
+                    uniform low-conflict stream).
+  ingest-conflict   merge-heavy remote apply: 3 sites racing
+                    overlapping pks through delete/re-create/value-tie
+                    transitions.
+  ingest-e2e        write→event latency through a live HTTP
+                    subscription, snapshot-diffed from the r11
+                    `corro.e2e.total` histograms and cross-checked
+                    against `GET /v1/slo`.
+
+`--ab` measures pre AND post in one run (pre = per-cell finalize via a
+SCOPED `CORRO_FINALIZE=percell` + `perf.group_commit = False` + the
+pre-r14 0.6 s `candidate_batch_wait`; nothing leaks into `os.environ`
+afterwards — the old bench's permanent `CORRO_NATIVE_BATCH` mutation is
+gone).  Records merge by rung into INGEST_BENCH.json, `code_sha`-stamped
+over the measured write-path files (bench.py replay-gate discipline).
+
+Usage:
+  python scripts/bench_ingest.py [--mode pre|post|ab] [--tag T]
 """
 
 from __future__ import annotations
 
+import asyncio
+import contextlib
+import hashlib
+import json
 import os
 import random
 import sys
@@ -17,20 +44,162 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from corrosion_tpu.runtime import jaxenv  # noqa: E402
+
+jaxenv.force_cpu_inprocess()
+
+from corrosion_tpu.net.mem import MemNetwork  # noqa: E402
+from corrosion_tpu.runtime.records import merge_records  # noqa: E402
 from corrosion_tpu.store.crdt import CrdtStore  # noqa: E402
 from corrosion_tpu.types.actor import ActorId  # noqa: E402
 from corrosion_tpu.types.base import Timestamp  # noqa: E402
-from corrosion_tpu.types.change import Change  # noqa: E402
+from corrosion_tpu.types.change import SENTINEL, Change  # noqa: E402
 from corrosion_tpu.types.pack import pack_columns  # noqa: E402
 
-SCHEMA = (
+_MEASURED_FILES = (
+    "corrosion_tpu/store/crdt.py",
+    "corrosion_tpu/agent/run.py",
+    "corrosion_tpu/agent/broadcast.py",
+    "corrosion_tpu/types/codec.py",
+    "scripts/bench_ingest.py",
+)
+
+# local-write workload: every writer commits TXS_TOTAL/N transactions of
+# ROWS_PER_TX rows each — the per-commit overhead (BEGIN/COMMIT, lock,
+# bookkeeping, fsync batching) is exactly what group commit amortizes
+TXS_TOTAL = 192
+ROWS_PER_TX = 10
+
+
+def _code_fingerprint() -> dict:
+    out = {}
+    for rel in _MEASURED_FILES:
+        try:
+            with open(os.path.join(REPO, rel), "rb") as f:
+                out[rel] = hashlib.sha256(f.read()).hexdigest()[:12]
+        except OSError:
+            out[rel] = "missing"
+    return out
+
+
+@contextlib.contextmanager
+def scoped_env(**kv):
+    """Set env vars for the block and RESTORE them after — the r13 bench
+    leaked CORRO_NATIVE_BATCH into os.environ permanently; nothing in
+    this bench may outlive its rung."""
+    old = {k: os.environ.get(k) for k in kv}
+    try:
+        for k, v in kv.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _pre_env(mode: str) -> dict:
+    return {"CORRO_FINALIZE": "percell"} if mode == "pre" else {}
+
+
+def _record(rung: str, mode: str, tag: str, **fields) -> dict:
+    rec = {
+        "rung": f"{rung}-{mode}" + (f"-{tag}" if tag else ""),
+        "mode": mode,
+        **fields,
+        "code_sha": _code_fingerprint(),
+        "measured_at": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
+    }
+    return rec
+
+
+# -- local write path (the tentpole rung) ----------------------------------
+
+
+async def _local_write(
+    n_writers: int, mode: str, tag: str, durable: bool = False
+) -> dict:
+    from tests.test_agent import boot, fast_config
+
+    from corrosion_tpu.agent.run import make_broadcastable_changes, shutdown
+
+    name = f"bench-ingest-w{n_writers}{'d' if durable else ''}"
+    net = MemNetwork(seed=11)
+    cfg = fast_config(name)
+    if mode == "pre":
+        cfg.perf.group_commit = False
+    agent = await boot(net, name, cfg=cfg)
+    if durable:
+        # the fsync-per-commit regime (PRAGMA synchronous=FULL on the
+        # write conn): every COMMIT syncs the WAL — the regime where
+        # group commit's one-fsync-per-batch amortization is the story.
+        # The default rungs keep the store's shipped NORMAL setting
+        # (WAL syncs at checkpoint, commits are cheap).
+        agent.store._conn.execute("PRAGMA synchronous = FULL")
+    txs_per_writer = TXS_TOTAL // n_writers
+    lat_ms: list = []
+
+    sql = "INSERT OR REPLACE INTO tests (id, text) VALUES (?, ?)"
+
+    def mk_fn(base: int):
+        rows = [(base + j, f"v{base + j}") for j in range(ROWS_PER_TX)]
+        if mode == "pre":
+            # the PR-start API: one execute per row (WriteTx had no bulk
+            # statement path before r14)
+            def fn(tx):
+                return [tx.execute(sql, r) for r in rows]
+        else:
+            def fn(tx):
+                return [tx.executemany(sql, rows)]
+        return fn
+
+    async def writer(w: int) -> None:
+        for t in range(txs_per_writer):
+            base = (w * txs_per_writer + t) * ROWS_PER_TX
+            t0 = time.monotonic()
+            await make_broadcastable_changes(agent, mk_fn(base))
+            lat_ms.append((time.monotonic() - t0) * 1e3)
+
+    try:
+        # warm the path (jit-free, but first commit pays schema caches)
+        await make_broadcastable_changes(agent, mk_fn(10_000_000))
+        t0 = time.monotonic()
+        await asyncio.gather(*(writer(w) for w in range(n_writers)))
+        wall = time.monotonic() - t0
+    finally:
+        await shutdown(agent)
+    rows = txs_per_writer * n_writers * ROWS_PER_TX
+    lat_ms.sort()
+    return _record(
+        f"ingest-local-w{n_writers}{'-durable' if durable else ''}",
+        mode, tag,
+        writers=n_writers,
+        durable=durable,
+        txs=txs_per_writer * n_writers,
+        rows_per_tx=ROWS_PER_TX,
+        rows=rows,
+        wall_s=round(wall, 3),
+        rows_per_s=round(rows / wall, 1),
+        commit_p50_ms=round(lat_ms[len(lat_ms) // 2], 2),
+        commit_p99_ms=round(lat_ms[int(len(lat_ms) * 0.99) - 1], 2),
+    )
+
+
+# -- remote apply ----------------------------------------------------------
+
+_SCHEMA = (
     "CREATE TABLE kv (id INTEGER NOT NULL PRIMARY KEY,"
     " a TEXT NOT NULL DEFAULT '', b INTEGER NOT NULL DEFAULT 0,"
     " c TEXT NOT NULL DEFAULT '');"
 )
 
 
-def gen(n: int, n_pks: int, seed=0) -> list:
+def _gen_uniform(n: int, n_pks: int, seed=0) -> list:
     rng = random.Random(seed)
     site = ActorId(bytes([1]) * 16).bytes16
     ts = Timestamp.from_unix(1)
@@ -49,51 +218,189 @@ def gen(n: int, n_pks: int, seed=0) -> list:
     return out
 
 
-def run(mode: str, changes, batch: int, tmp: str) -> float:
-    path = os.path.join(tmp, f"bench-{mode}.db")
+def _gen_conflict(n: int, seed=3) -> list:
+    """Merge-heavy mix: 3 sites race 200 pks through causal transitions
+    (delete/re-create sentinels) and equal-clock value ties."""
+    rng = random.Random(seed)
+    sites = [ActorId(bytes([i]) * 16).bytes16 for i in (1, 2, 3)]
+    ts = Timestamp.from_unix(2)
+    out = []
+    versions = {s: 0 for s in sites}
+    for i in range(n):
+        site = rng.choice(sites)
+        pk = pack_columns([rng.randint(1, 200)])
+        cl = rng.choice([1, 1, 1, 2, 3, 3, 4, 5])
+        if cl % 2 == 0 or rng.random() < 0.1:
+            cid, val = SENTINEL, None
+        else:
+            cid = rng.choice(["a", "b", "c"])
+            # small value space → frequent equal-(cl, cv) ties
+            val = rng.randint(0, 4) if cid == "b" else rng.choice(["x", "y"])
+        versions[site] += rng.choice([0, 1])
+        out.append(
+            Change(
+                table="kv", pk=pk, cid=cid, val=val,
+                col_version=rng.randint(1, 3),
+                db_version=max(1, versions[site]),
+                seq=rng.randint(0, 3), site_id=site, cl=cl, ts=ts,
+            )
+        )
+    return out
+
+
+def _apply_rung(rung: str, changes: list, batch: int, mode: str, tag: str,
+                tmp: str) -> dict:
+    path = os.path.join(tmp, f"bench-{rung}-{mode}.db")
     if os.path.exists(path):
         os.unlink(path)
     st = CrdtStore(path)
-    st.apply_schema_sql(SCHEMA)
+    st.apply_schema_sql(_SCHEMA)
     t0 = time.monotonic()
-    if mode in ("batched", "native"):
-        for i in range(0, len(changes), batch):
-            st.apply_changes(changes[i : i + batch])
-    else:
-        from tests.test_crdt_batch import apply_reference
-
-        for i in range(0, len(changes), batch):
-            apply_reference(st, changes[i : i + batch])
-    dt = time.monotonic() - t0
+    for i in range(0, len(changes), batch):
+        st.apply_changes(changes[i : i + batch])
+    wall = time.monotonic() - t0
     st.close()
-    return len(changes) / dt
+    return _record(
+        rung, mode, tag,
+        rows=len(changes), batch=batch, wall_s=round(wall, 3),
+        rows_per_s=round(len(changes) / wall, 1),
+    )
+
+
+# -- end-to-end write→event (the r11 SLO plane, snapshot-diffed) -----------
+
+
+async def _e2e(mode: str, tag: str) -> dict:
+    import aiohttp
+
+    from corrosion_tpu.agent.run import shutdown
+    from corrosion_tpu.api.http import ApiServer
+    from corrosion_tpu.client import CorrosionApiClient
+    from corrosion_tpu.runtime import latency as lat
+    from tests.test_agent import boot, fast_config
+
+    net = MemNetwork(seed=13)
+    cfg = fast_config("bench-ingest-e2e")
+    if mode == "pre":
+        cfg.perf.group_commit = False
+        cfg.pubsub.candidate_batch_wait = 0.6  # the pre-r14 default
+    agent = await boot(net, "bench-ingest-e2e", cfg=cfg)
+    api = ApiServer(agent)
+    agent.config.api.bind_addr = ["127.0.0.1:0"]
+    await api.start()
+    client = CorrosionApiClient(api.addrs[0])
+    n_writes = 30
+    got = asyncio.Event()
+    seen = [0]
+
+    async def subscriber() -> None:
+        async for line in client.subscribe(
+            "SELECT id, text FROM tests", skip_rows=True, raw=True
+        ):
+            if line.startswith('{"change":'):
+                seen[0] += 1
+                if seen[0] >= n_writes:
+                    got.set()
+                    return
+
+    sub_task = asyncio.ensure_future(subscriber())
+    try:
+        await asyncio.sleep(0.5)
+        before = lat.stage_hists(window_secs=None)["total"]
+        t0 = time.monotonic()
+        for i in range(n_writes):
+            await client.execute(
+                [["INSERT OR REPLACE INTO tests (id, text) VALUES (?, ?)",
+                  [i, f"e{i}"]]]
+            )
+            await asyncio.sleep(0.02)
+        await asyncio.wait_for(got.wait(), 120)
+        wall = time.monotonic() - t0
+        d = lat.stage_hists(window_secs=None)["total"].diff(before)
+        # cross-check: the live plane serves the same distribution
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://{api.addrs[0]}/v1/slo") as resp:
+                slo_body = await resp.json()
+    finally:
+        sub_task.cancel()
+        await client.close()
+        await api.stop()
+        await shutdown(agent)
+    return _record(
+        "ingest-e2e", mode, tag,
+        writes=n_writes,
+        events=seen[0],
+        wall_s=round(wall, 2),
+        total_p50_s=round(d.quantile(0.5), 4),
+        total_p99_s=round(d.quantile(0.99), 4),
+        candidate_batch_wait=cfg.pubsub.candidate_batch_wait,
+        slo_plane_total=slo_body["stages"].get("total", {}),
+    )
+
+
+# -- driver ----------------------------------------------------------------
+
+
+def run_mode(mode: str, tag: str) -> list:
+    import tempfile
+
+    recs = []
+    with scoped_env(**_pre_env(mode)) if _pre_env(mode) else contextlib.nullcontext():
+        for n in (1, 4, 16):
+            recs.append(asyncio.run(_local_write(n, mode, tag)))
+        for n in (1, 4, 16):
+            recs.append(asyncio.run(_local_write(n, mode, tag, durable=True)))
+        with tempfile.TemporaryDirectory() as tmp:
+            recs.append(_apply_rung(
+                "ingest-remote", _gen_uniform(20_000, 400), 500, mode, tag,
+                tmp,
+            ))
+            recs.append(_apply_rung(
+                "ingest-conflict", _gen_conflict(20_000), 500, mode, tag,
+                tmp,
+            ))
+        recs.append(asyncio.run(_e2e(mode, tag)))
+    return recs
 
 
 def main() -> None:
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
-    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 500
-    sys.path.insert(0, REPO)
-    import tempfile
+    args = sys.argv[1:]
+    mode = "post"
+    tag = ""
+    if "--tag" in args:
+        i = args.index("--tag")
+        tag = args[i + 1]
+        del args[i : i + 2]
+    if "--mode" in args:
+        i = args.index("--mode")
+        mode = args[i + 1]
+        del args[i : i + 2]
+    if "--ab" in args:
+        mode = "ab"
+    modes = ("pre", "post") if mode == "ab" else (mode,)
+    all_recs = []
+    for m in modes:
+        recs = run_mode(m, tag)
+        for r in recs:
+            print(json.dumps(r), flush=True)
+        all_recs.extend(recs)
+    merge_records(os.path.join(REPO, "INGEST_BENCH.json"), all_recs)
+    # headline: the banked acceptance ratios when both halves exist
+    with open(os.path.join(REPO, "INGEST_BENCH.json")) as f:
+        banked = {r["rung"]: r for r in json.load(f)}
 
-    changes = gen(n, n_pks=max(100, n // 50))
-    with tempfile.TemporaryDirectory() as tmp:
-        per_row = run("per_row", changes, batch, tmp)
-        os.environ["CORRO_NATIVE_BATCH"] = "0"
-        batched = run("batched", changes, batch, tmp)
-        os.environ["CORRO_NATIVE_BATCH"] = "1"
-        from corrosion_tpu import native as native_mod
+    def ratio(rung: str) -> str:
+        pre = banked.get(f"{rung}-pre")
+        post = banked.get(f"{rung}-post")
+        if not pre or not post:
+            return "n/a"
+        return f"{post['rows_per_s'] / pre['rows_per_s']:.2f}x"
 
-        native = (
-            run("native", changes, batch, tmp)
-            if native_mod.merge_batch_lib() is not None
-            else 0.0
-        )
     print(
-        f"ingest throughput n={n} batch={batch}: "
-        f"per_row={per_row:,.0f} changes/s  "
-        f"batched={batched:,.0f} changes/s  "
-        f"native={native:,.0f} changes/s  "
-        f"speedup={(native or batched) / per_row:.2f}x"
+        "speedup post/pre: "
+        f"w1={ratio('ingest-local-w1')} w4={ratio('ingest-local-w4')} "
+        f"w16={ratio('ingest-local-w16')} remote={ratio('ingest-remote')} "
+        f"conflict={ratio('ingest-conflict')}"
     )
 
 

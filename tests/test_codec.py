@@ -466,3 +466,78 @@ def test_swim_digest_ext_compat():
     for _ in range(r2.u16()):
         read_actor(r2), r2.u32(), r2.u8()
     assert r2.eof()
+
+
+def test_encode_once_wire_body_byte_identical():
+    """r14 encode-once: a ChangeV1 carrying its pre-serialized body
+    (`with_wire_body` at commit, or captured from the frame at decode)
+    encodes to EXACTLY the bytes of a fresh full encode — on the uni
+    plane (with and without stamps/digest) and on the sync plane."""
+    from corrosion_tpu.types.codec import (
+        decode_uni_payload_ext,
+        encode_change_v1_body,
+        with_wire_body,
+    )
+
+    cv = ChangeV1(
+        actor_id=ActorId(b"\x22" * 16),
+        changeset=ChangesetFull(
+            version=7,
+            changes=(mk_change(), mk_change(cid="num", val=42, seq=1)),
+            seqs=(0, 1),
+            last_seq=1,
+            ts=Timestamp(123456789),
+        ),
+        origin_ts=1723.5,
+        traceparent="00-" + "ab" * 16 + "-" + "cd" * 8 + "-01",
+    )
+    stamped = with_wire_body(cv)
+    assert stamped.wire_body == encode_change_v1_body(cv)
+    assert stamped == cv  # wire_body is a cache, never identity
+
+    for digest in (None, b"\x05digestbytes"):
+        fresh = encode_uni_payload(cv, ClusterId(3), digest=digest)
+        shared = encode_uni_payload(stamped, ClusterId(3), digest=digest)
+        assert shared == fresh
+
+    assert encode_sync_msg(stamped) == encode_sync_msg(cv)
+
+    # decode captures the received body so a RELAY also wraps, not
+    # re-encodes — and the captured bytes are the true body bytes
+    out, _cluster, _dig = decode_uni_payload_ext(
+        encode_uni_payload(cv, ClusterId(3))
+    )
+    assert out.wire_body == encode_change_v1_body(cv)
+    assert encode_uni_payload(out, ClusterId(3)) == encode_uni_payload(
+        cv, ClusterId(3)
+    )
+
+
+def test_encode_once_prefix_retransmission_digest():
+    """Re-transmissions share the prefix: appending a per-transmission
+    digest ext to the cached prefix equals a full encode with that
+    digest, and the digest-free payload is a strict prefix-equal reuse."""
+    from corrosion_tpu.types.codec import (
+        encode_uni_from_prefix,
+        encode_uni_prefix,
+        with_wire_body,
+    )
+
+    cv = with_wire_body(ChangeV1(
+        actor_id=ActorId(b"\x33" * 16),
+        changeset=ChangesetFull(
+            version=2,
+            changes=(mk_change(),),
+            seqs=(0, 0),
+            last_seq=0,
+            ts=Timestamp(5),
+        ),
+        origin_ts=99.25,
+    ))
+    prefix = encode_uni_prefix(cv, ClusterId(1))
+    base = encode_uni_from_prefix(prefix, cv.origin_ts, cv.traceparent)
+    assert base == encode_uni_payload(cv, ClusterId(1))
+    for digest in (b"d1", b"other-digest"):
+        assert encode_uni_from_prefix(
+            prefix, cv.origin_ts, cv.traceparent, digest
+        ) == encode_uni_payload(cv, ClusterId(1), digest=digest)

@@ -1,0 +1,289 @@
+"""The r14 local-commit group coalescer (agent/run.py GroupCommitter).
+
+Concurrent `make_broadcastable_changes` callers share one sqlite
+BEGIN IMMEDIATE..COMMIT: consecutive db_versions inside one transaction,
+one bookkeeping round for the group, per-writer SAVEPOINT rollback
+isolation, and an unchanged solo fast path (a lone writer's batch is
+size 1 and commits immediately).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import sqlite3
+
+from corrosion_tpu.agent.run import make_broadcastable_changes, shutdown
+from corrosion_tpu.net.mem import MemNetwork
+
+from tests.test_agent import boot, wait_until
+
+
+def _insert(i: int, text: str = "t"):
+    def fn(tx):
+        return [
+            tx.execute(
+                "INSERT INTO tests (id, text) VALUES (?, ?)", (i, text)
+            )
+        ]
+
+    return fn
+
+
+class _BeginCounter:
+    """Count transaction starts on the write connection via the sqlite
+    trace callback (BEGIN for solo/leader txs — savepoints don't BEGIN)."""
+
+    def __init__(self, store):
+        self.store = store
+        self.begins = 0
+        self.savepoints = 0
+
+    def __enter__(self):
+        def cb(stmt: str):
+            head = stmt.lstrip().upper()
+            if head.startswith("BEGIN"):
+                self.begins += 1
+            elif head.startswith("SAVEPOINT"):
+                self.savepoints += 1
+
+        self.store._conn.set_trace_callback(cb)
+        return self
+
+    def __exit__(self, *exc):
+        self.store._conn.set_trace_callback(None)
+        return False
+
+
+def test_concurrent_writers_coalesce_into_fewer_commits():
+    async def main():
+        net = MemNetwork(seed=41)
+        a = await boot(net, "agent-gc")
+        n = 24
+        try:
+            with _BeginCounter(a.store) as counter:
+                results = await asyncio.gather(
+                    *(make_broadcastable_changes(a, _insert(i))
+                      for i in range(n))
+                )
+            # every writer committed, with its own result + version
+            versions = sorted(r.version for r in results)
+            assert all(r.rows_affected == 1 for r in results)
+            # consecutive db_versions with no gaps
+            assert versions == list(range(versions[0], versions[0] + n))
+            # the whole burst shared a handful of transactions — not one
+            # BEGIN per writer (each writer still gets its own SAVEPOINT)
+            assert counter.begins < n / 2, (
+                f"{counter.begins} BEGINs for {n} writers"
+            )
+            assert counter.savepoints >= n - counter.begins
+            rows = a.store._conn.execute(
+                "SELECT count(*) AS n FROM tests"
+            ).fetchone()["n"]
+            assert rows == n
+        finally:
+            await shutdown(a)
+
+    asyncio.run(main())
+
+
+def test_failed_writer_rolls_back_alone():
+    async def main():
+        net = MemNetwork(seed=43)
+        a = await boot(net, "agent-gc-iso")
+        try:
+            def bad(tx):
+                tx.execute("INSERT INTO tests (id, text) VALUES (1, 'pre')")
+                tx.execute("INSERT INTO nope VALUES (1)")  # no such table
+                return []
+
+            good_futs = [
+                make_broadcastable_changes(a, _insert(i + 10))
+                for i in range(4)
+            ]
+            bad_fut = make_broadcastable_changes(a, bad)
+            results = await asyncio.gather(
+                *good_futs, bad_fut, return_exceptions=True
+            )
+            errors = [r for r in results if isinstance(r, BaseException)]
+            assert len(errors) == 1
+            assert isinstance(errors[0], sqlite3.Error)
+            # only the failed writer rolled back: its partial INSERT is
+            # gone, all four good writers' rows are durable
+            ids = [
+                r["id"]
+                for r in a.store._conn.execute(
+                    "SELECT id FROM tests ORDER BY id"
+                )
+            ]
+            assert ids == [10, 11, 12, 13]
+            # and the survivors' versions are gapless (the failed sub-tx
+            # consumed no db_version)
+            versions = sorted(
+                r.version for r in results
+                if not isinstance(r, BaseException)
+            )
+            assert versions == list(
+                range(versions[0], versions[0] + 4)
+            )
+        finally:
+            await shutdown(a)
+
+    asyncio.run(main())
+
+
+def test_solo_writer_fast_path_one_commit():
+    """A lone writer must not wait for company: exactly one BEGIN, and
+    the changes broadcast/apply end to end."""
+
+    async def main():
+        net = MemNetwork(seed=47)
+        a = await boot(net, "agent-gc-solo")
+        try:
+            with _BeginCounter(a.store) as counter:
+                res = await make_broadcastable_changes(a, _insert(1, "solo"))
+            assert res.version == 1
+            assert counter.begins == 1
+        finally:
+            await shutdown(a)
+
+    asyncio.run(main())
+
+
+def test_group_commit_disabled_falls_back_to_solo_path():
+    async def main():
+        net = MemNetwork(seed=53)
+        a = await boot(net, "agent-gc-off")
+        a.config.perf.group_commit = False
+        try:
+            with _BeginCounter(a.store) as counter:
+                results = await asyncio.gather(
+                    *(make_broadcastable_changes(a, _insert(i))
+                      for i in range(6))
+                )
+            assert sorted(r.version for r in results) == list(range(1, 7))
+            assert counter.begins == 6  # one tx per writer, no savepoints
+            assert counter.savepoints == 0
+        finally:
+            await shutdown(a)
+
+    asyncio.run(main())
+
+
+def test_grouped_writes_replicate_to_peer():
+    """Changes committed through a shared transaction still broadcast
+    per writer and converge on a gossiping peer."""
+
+    async def main():
+        net = MemNetwork(seed=59)
+        a = await boot(net, "agent-gc-a")
+        b = await boot(net, "agent-gc-b", bootstrap=["agent-gc-a"])
+        try:
+            await wait_until(lambda: len(a.members) >= 1, timeout=10)
+            await asyncio.gather(
+                *(make_broadcastable_changes(a, _insert(i))
+                  for i in range(8))
+            )
+
+            def applied():
+                row = b.store._conn.execute(
+                    "SELECT count(*) AS n FROM tests"
+                ).fetchone()
+                return row["n"] == 8
+
+            assert await wait_until(applied, timeout=20)
+        finally:
+            await shutdown(b)
+            await shutdown(a)
+
+    asyncio.run(main())
+
+
+def test_group_finalize_equivalent_to_sequential_commits():
+    """The store-level pin for the batched finalize: N sub-transactions
+    finalized through ONE `finalize_group` pass produce byte/clock-
+    identical changes, db_versions and table state vs the same
+    transactions committed sequentially (each its own solo tx) — across
+    cross-writer interactions: same-pk updates, delete then re-create
+    by a LATER writer, col_version continuation."""
+    import random
+
+    from corrosion_tpu.store.crdt import CrdtStore
+    from corrosion_tpu.types.actor import ActorId
+    from corrosion_tpu.types.base import Timestamp
+
+    from tests.test_finalize_batch import SCHEMA, dump_state
+
+    rng = random.Random(77)
+    site = ActorId(bytes([5]) * 16)
+
+    def random_tx_ops():
+        ops = []
+        for _ in range(rng.randint(1, 4)):
+            kv_id = rng.randint(1, 4)
+            roll = rng.random()
+            if roll < 0.45:
+                ops.append((
+                    "INSERT OR REPLACE INTO kv (id, a, b) VALUES (?, ?, ?)",
+                    (kv_id, rng.choice(["x", "y"]), rng.randint(0, 9)),
+                ))
+            elif roll < 0.75:
+                ops.append((
+                    "UPDATE kv SET b = b + 1 WHERE id = ?", (kv_id,)
+                ))
+            else:
+                ops.append(("DELETE FROM kv WHERE id = ?", (kv_id,)))
+        return ops
+
+    batches = [
+        [random_tx_ops() for _ in range(rng.randint(2, 6))]
+        for _ in range(8)
+    ]
+
+    def run_sequential():
+        st = CrdtStore(":memory:", site_id=site)
+        st.apply_schema_sql(SCHEMA)
+        all_changes = []
+        n = 0
+        for batch in batches:
+            for ops in batch:
+                n += 1
+                with st.write_tx(Timestamp.from_unix(n)) as tx:
+                    for sql, params in ops:
+                        tx.execute(sql, params)
+                    changes, _v, _ls = tx.commit()
+                all_changes.append([tuple(c.__dict__.values()) for c in []])
+                all_changes[-1] = [
+                    (c.table, c.pk, c.cid, c.val, c.col_version,
+                     c.db_version, c.seq, c.cl) for c in changes
+                ]
+        return all_changes, dump_state(st)
+
+    def run_grouped():
+        st = CrdtStore(":memory:", site_id=site)
+        st.apply_schema_sql(SCHEMA)
+        all_changes = []
+        n = 0
+        for batch in batches:
+            group = []
+            with st.group_tx():
+                for ops in batch:
+                    n += 1
+                    with st.write_tx(
+                        Timestamp.from_unix(n), nested=True
+                    ) as tx:
+                        for sql, params in ops:
+                            tx.execute(sql, params)
+                        group.append((tx.commit_deferred(), tx.ts))
+                finalized = st.finalize_group(group)
+            for changes, _dv, _ls in finalized:
+                all_changes.append([
+                    (c.table, c.pk, c.cid, c.val, c.col_version,
+                     c.db_version, c.seq, c.cl) for c in changes
+                ])
+        return all_changes, dump_state(st)
+
+    seq_changes, seq_dump = run_sequential()
+    grp_changes, grp_dump = run_grouped()
+    assert grp_changes == seq_changes
+    assert grp_dump == seq_dump

@@ -62,6 +62,25 @@ def test_transactions_and_queries_roundtrip():
             stats = await client.table_stats()
             assert stats["total_row_count"] == 2
             assert stats["invalid_tables"] == []
+
+            # faithful rows_affected (r14): multi-row DML reports its
+            # true count, no-match DML reports 0 (not an error, not a
+            # collapsed -1), and named-param statements go through the
+            # same counting path
+            res = await client.execute(
+                [
+                    ["UPDATE tests SET text = 'both'", []],
+                    ["UPDATE tests SET text = 'none' WHERE id = 99", []],
+                    ["DELETE FROM tests WHERE id = 99", []],
+                    [
+                        "INSERT INTO tests (id, text) VALUES (:i, :t)",
+                        {"i": 3, "t": "named"},
+                    ],
+                ]
+            )
+            assert [r["rows_affected"] for r in res["results"]] == [
+                2, 0, 0, 1,
+            ]
         finally:
             await client.close()
             await api_a.stop()

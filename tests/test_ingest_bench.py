@@ -1,0 +1,95 @@
+"""Banked-record guard for INGEST_BENCH.json (r14 write-path round).
+
+`scripts/bench_ingest.py --ab` banks the pre/post trajectory of the
+local-commit plane (group commit + vectorized finalize + encode-once)
+in one sha-stamped artifact.  This guard pins the artifact's shape and
+the round's headline margins so a silent regression — or a hand-edited
+number — fails tier-1 (test_bench_replay.py discipline: a banked
+number must be tied to real code and hold its acceptance floor).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+PATH = os.path.join(os.path.dirname(__file__), "..", "INGEST_BENCH.json")
+
+LOCAL_RUNGS = [
+    f"ingest-local-w{n}{d}"
+    for n in (1, 4, 16)
+    for d in ("", "-durable")
+]
+ALL_RUNGS = LOCAL_RUNGS + ["ingest-remote", "ingest-conflict", "ingest-e2e"]
+
+
+@pytest.fixture(scope="module")
+def banked() -> dict:
+    with open(PATH) as f:
+        return {r["rung"]: r for r in json.load(f)}
+
+
+def test_all_rungs_banked_pre_and_post(banked):
+    for rung in ALL_RUNGS:
+        for mode in ("pre", "post"):
+            assert f"{rung}-{mode}" in banked, f"missing {rung}-{mode}"
+
+
+def test_records_are_sha_stamped(banked):
+    for rung, rec in banked.items():
+        sha = rec.get("code_sha")
+        assert sha, f"{rung}: no code fingerprint"
+        assert all(v != "missing" for v in sha.values()), (rung, sha)
+        assert rec.get("measured_at"), f"{rung}: no measured_at"
+
+
+def test_sixteen_writer_rung_speedup_floor(banked):
+    """The headline coalescing margin: at 16 concurrent writers the
+    post write path must hold ≥1.5× banked rows/s (measured 1.7×
+    default / 2.1× durable on the 1-core bench host; the pre-r14 path
+    is flat across writer counts because every writer paid a full
+    serialized commit)."""
+    for suffix in ("", "-durable"):
+        pre = banked[f"ingest-local-w16{suffix}-pre"]["rows_per_s"]
+        post = banked[f"ingest-local-w16{suffix}-post"]["rows_per_s"]
+        assert post / pre >= 1.5, (suffix, pre, post)
+
+
+def test_sixteen_writer_commit_latency_halves(banked):
+    """Group commit's per-writer view: a 16-writer burst's p50 commit
+    latency drops (writers no longer queue behind 15 full commits)."""
+    for suffix in ("", "-durable"):
+        pre = banked[f"ingest-local-w16{suffix}-pre"]["commit_p50_ms"]
+        post = banked[f"ingest-local-w16{suffix}-post"]["commit_p50_ms"]
+        assert post <= pre * 0.75, (suffix, pre, post)
+
+
+def test_solo_writer_p50_unchanged(banked):
+    """The solo fast path: a lone writer's p50 commit latency must not
+    regress (first writer commits immediately when nobody is queued)."""
+    for suffix in ("", "-durable"):
+        pre = banked[f"ingest-local-w1{suffix}-pre"]["commit_p50_ms"]
+        post = banked[f"ingest-local-w1{suffix}-post"]["commit_p50_ms"]
+        assert post <= pre * 1.25, (suffix, pre, post)
+
+
+def test_write_event_p50_collapses(banked):
+    """The e2e satellite: candidate_batch_wait 0.6→0.1 s + encode-once
+    drop the write→event total p50 by ≥3× (banked 0.61 s → 0.11 s)."""
+    pre = banked["ingest-e2e-pre"]["total_p50_s"]
+    post = banked["ingest-e2e-post"]["total_p50_s"]
+    assert post <= pre / 3, (pre, post)
+    # and every banked e2e write produced its event (no missed deliveries)
+    for mode in ("pre", "post"):
+        rec = banked[f"ingest-e2e-{mode}"]
+        assert rec["events"] >= rec["writes"]
+
+
+def test_remote_apply_not_regressed(banked):
+    """The r2 batched remote-apply plane rode along untouched."""
+    for rung in ("ingest-remote", "ingest-conflict"):
+        pre = banked[f"{rung}-pre"]["rows_per_s"]
+        post = banked[f"{rung}-post"]["rows_per_s"]
+        assert post >= pre * 0.85, (rung, pre, post)

@@ -10,7 +10,7 @@ Three layers, mirroring what the suite promises:
    `# corro: noqa[rule]` comment suppresses (proving the whole
    driver-side filter chain, not just the checker).
 3. THE FOLD IS LOSSLESS: the metrics lint folded into the framework
-   still reports the same 175 literal series + 2 wildcard sites in both
+   still reports the same 179 literal series + 2 wildcard sites in both
    directions, and the `scripts/lint_metrics.py` shim keeps its API.
 
 All pure-AST: no jax tracing, no sqlite, no network — the gate must
@@ -583,7 +583,8 @@ def test_codec_ext_real_tree_covers_all_gates():
 
 
 def test_metrics_fold_reports_same_inventory():
-    """The lint_metrics fold is lossless: same 175 literal series, same
+    """The lint_metrics fold is lossless: same 179 literal series (175 + the 4 r14
+    write-path series), same
     2 wildcard sites, both directions clean, via BOTH the framework
     checker and the back-compat shim."""
     import lint_metrics
@@ -591,7 +592,7 @@ def test_metrics_fold_reports_same_inventory():
     assert MetricsDocChecker().run(AnalysisContext(REPO)) == []
     assert lint_metrics.lint() == []
     literals, wildcards = lint_metrics.scan_call_sites()
-    assert len(literals) == 175
+    assert len(literals) == 179
     assert len(wildcards) == 2
     names = lint_metrics.parse_components_table()
     assert len(names) == len(set(names))
