@@ -68,6 +68,9 @@ async def setup(
         # traffic (devcluster scale-up, tests with a running loop) must
         # not stall its event loop for the duration of a schema apply
         store = CrdtStore(config.db.path)
+        # r15: [perf] direct_capture gates the in-memory write capture
+        # (CORRO_CAPTURE=trigger overrides per process)
+        store.direct_capture = config.perf.direct_capture
         # the canary table is system-owned (created at runtime by the
         # SLO canary probe, r11) and never appears in the user's schema
         # files: carry a persisted one through the declarative
@@ -572,10 +575,11 @@ def _cancelled_error() -> BaseException:
 
 
 def _pending_row_bytes(r) -> int:
-    """Rough wire-size of one trigger-log row (the group byte budget —
+    """Rough wire-size of one captured-cell row — (tbl, pk, cid, val)
+    tuples since r15's in-memory direct capture (the group byte budget:
     Change.estimated_byte_size before the Change exists)."""
-    val = r["val"]
-    return 48 + len(r["pk"]) + (
+    val = r[3]
+    return 48 + len(r[1]) + (
         len(val) if isinstance(val, (str, bytes)) else 8
     )
 
@@ -735,6 +739,11 @@ class GroupCommitter:
         max_bytes = agent.config.perf.group_commit_max_bytes
         booked = agent.bookie.ensure(agent.actor_id)
         committed: List[_GroupItem] = []
+        # a SOLO batch skips the per-writer savepoint (r15): with one
+        # writer there are no batchmates to isolate, and its failure
+        # aborts the whole group tx below — the uncontended fast path
+        # saves the SAVEPOINT/RELEASE round-trip on every solo commit
+        use_sp = len(batch) > 1
         with booked.write("group_commit") as bv:
             i = 0
             while i < len(batch):
@@ -747,12 +756,16 @@ class GroupCommitter:
                             i += 1
                             try:
                                 with store.write_tx(
-                                    item.ts, nested=True
+                                    item.ts, nested=True, savepoint=use_sp
                                 ) as tx:
                                     item.results = item.fn(tx)
                                     pending = tx.commit_deferred()
                             except BaseException as e:
                                 item.error = e
+                                if not use_sp:
+                                    # savepoint-free sub-tx: the shared
+                                    # transaction is poisoned — abort it
+                                    raise
                                 continue
                             group.append((item, pending))
                             used += sum(
@@ -777,9 +790,11 @@ class GroupCommitter:
                             it.last_seq = last_seq
                 except BaseException as e:
                     # the shared finalize/COMMIT died: every sub-tx in
-                    # this group rolled back with it
+                    # this group rolled back with it (a failed
+                    # savepoint-free solo writer keeps its OWN error)
                     for it, _p in group:
-                        it.error = e
+                        if it.error is None:
+                            it.error = e
                         it.changes = None
                         it.db_version = 0
                     continue

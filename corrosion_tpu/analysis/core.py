@@ -221,6 +221,7 @@ def save_baseline(
 def all_checkers() -> List[Checker]:
     # imported lazily so `core` has no checker-module dependencies
     from corrosion_tpu.analysis.blocking import AsyncBlockingChecker
+    from corrosion_tpu.analysis.capture_parity import CaptureParityChecker
     from corrosion_tpu.analysis.codecext import CodecExtChecker
     from corrosion_tpu.analysis.lockcheck import LockDisciplineChecker
     from corrosion_tpu.analysis.metricsdoc import MetricsDocChecker
@@ -233,6 +234,7 @@ def all_checkers() -> List[Checker]:
         AsyncBlockingChecker(),
         LockDisciplineChecker(),
         CodecExtChecker(),
+        CaptureParityChecker(),
         MetricsDocChecker(),
     ]
 

@@ -457,6 +457,17 @@ class ApiServer:
                     "corro.subs.executor.submitted.total"
                 ),
             },
+            # r15 direct change capture: how local writes are being
+            # captured (direct in-memory vs trigger fallback) — a
+            # rising `fallback` means hot statements carry bound
+            # values outside the provably-identical set, a rising
+            # `trigger` means raw/unrecognized SQL on the write path
+            "write_capture": {
+                "enabled": agent.config.perf.direct_capture,
+                "direct": peek("corro.write.capture.direct.total"),
+                "trigger": peek("corro.write.capture.trigger.total"),
+                "fallback": peek("corro.write.capture.fallback.total"),
+            },
             # r11 SLO plane pointer: the canary's live numbers (full
             # per-stage percentiles live at GET /v1/slo)
             "slo": {

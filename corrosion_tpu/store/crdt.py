@@ -44,7 +44,9 @@ from corrosion_tpu.store.bookkeeping import (
     GapStore,
     PartialVersion,
 )
+from corrosion_tpu.store import capture as _capture
 from corrosion_tpu.store.schema import Schema, SchemaError, diff_schemas, parse_sql
+from corrosion_tpu.types.codec import Writer, write_change_fields
 from corrosion_tpu.types.actor import ActorId
 from corrosion_tpu.types.base import Timestamp
 from corrosion_tpu.types.change import Change, SENTINEL
@@ -192,6 +194,25 @@ def _finalize_engine() -> str:
     return eng
 
 
+def _capture_engine() -> str:
+    """Engine for local-write change capture (r15).  "direct" (default):
+    `WriteTx.execute`/`executemany` parse-or-cache the statement shape
+    and record the written cells in memory — no `__crdt_pending`
+    INSERT, no readback SELECT, no DELETE — with the AFTER triggers
+    kept installed as the capture path for raw/unrecognized SQL.
+    "trigger": every statement captures through the triggers, the
+    pre-r15 path, kept as the semantic reference for the randomized
+    equivalence pin (tests/test_capture.py) and the ingest bench's pre
+    mode.  `[perf] direct_capture = false` forces "trigger" per agent
+    (CrdtStore.direct_capture)."""
+    eng = os.environ.get("CORRO_CAPTURE", "direct")
+    if eng not in ("direct", "trigger"):
+        raise ValueError(
+            f"unknown CORRO_CAPTURE {eng!r} (expected 'direct' or 'trigger')"
+        )
+    return eng
+
+
 # bound-variable budget for the finalize IN(...) probes: 3.32+ builds
 # allow 32766 bound parameters, older ones 999 — shrink once on the old
 # cap instead of pre-chunking everything to the worst case (the whole
@@ -251,13 +272,18 @@ def _dedupe_pending(pending):
     reverse-dedupe keeps each surviving key's LAST fresh insertion slot
     (the reference's `order.remove` + append behavior).
 
+    ``pending`` rows are (tbl, pk, cid, val) tuples — produced by the
+    r15 in-memory direct capture, or drained from `__crdt_pending` in
+    rowseq order for trigger-captured statements (the two streams merge
+    before this point, `WriteTx._take_pending`).
+
     Returns (cells, order, deleted_rows)."""
     cells: Dict[Tuple[str, bytes, str], SqliteValue] = {}
     order: List[Tuple[str, bytes, str]] = []
     deleted_rows: Dict[Tuple[str, bytes], bool] = {}
     row_keys: Dict[Tuple[str, bytes], set] = {}
     for r in pending:
-        tbl, pk, cid, val = r["tbl"], bytes(r["pk"]), r["cid"], r["val"]
+        tbl, pk, cid, val = r
         if cid == SENTINEL + "X":  # delete marker from the del trigger
             deleted_rows[(tbl, pk)] = True
             for key in row_keys.pop((tbl, pk), ()):
@@ -406,6 +432,14 @@ class CrdtStore:
             CrdtStore._mem_counter += 1
             path = f"file:crdtmem{id(self)}_{CrdtStore._mem_counter}?mode=memory&cache=shared"
         self.path = path
+        # the trigger capture gate (r15): the generated AFTER triggers'
+        # WHEN clause calls `corro_capture_on()` (registered per
+        # connection in _setup_conn) which reads THIS flag — toggling
+        # capture for remote applies / direct-captured statements is a
+        # Python list store instead of an UPDATE statement + WAL page
+        # per transaction.  Single-writer model: only the write conn
+        # fires triggers, and every toggle happens under self._lock.
+        self._capture_flag = [1]
         self._conn = sqlite3.connect(
             path, check_same_thread=False, isolation_level=None, uri=True
         )
@@ -414,6 +448,11 @@ class CrdtStore:
         self._setup_conn(self._conn)
         with self._lock:
             self._conn.executescript(_BOOTSTRAP)
+            # one boot-time sweep replaces the old per-transaction
+            # defensive DELETE: pending rows cannot survive a committed
+            # tx (commit drains them) or a rolled-back one (undone), so
+            # anything here is pre-crash junk from an older build
+            self._conn.execute("DELETE FROM __crdt_pending")
             row = self._conn.execute("SELECT site_id FROM __crdt_site").fetchone()
             if row is None:
                 sid = site_id or ActorId.new_random()
@@ -430,6 +469,17 @@ class CrdtStore:
         self.site_id: ActorId = sid
         self.schema: Schema = Schema()
         self._pk_unpack_cache: Dict[bytes, tuple] = {}
+        # r15 direct capture: per-statement-text shape cache (None =
+        # "not capturable, use triggers"); cleared on schema changes.
+        # `direct_capture` is the agent-level knob ([perf]
+        # direct_capture), ANDed with the CORRO_CAPTURE env engine.
+        self.direct_capture = True
+        self._shape_cache: Dict[str, Optional[object]] = {}
+        # own/remote head-version cache: db_version_for is on every
+        # commit's path, and the value only changes through
+        # _bump_db_version (cache updated there) — cleared on rollback
+        # paths where a bump may have been undone
+        self._dv_cache: Dict[bytes, int] = {}
         self._read_pool: List[sqlite3.Connection] = []
         self._read_pool_lock = threading.Lock()
         self._read_out = 0  # checked-out read conns (pool gauges)
@@ -440,6 +490,20 @@ class CrdtStore:
         self._merge_lib = native.merge_batch_lib()
         self._watchdog = _InterruptWatchdog(self._conn)
         self._load_schema()
+        if self.schema.tables:
+            # refresh capture triggers to the current DDL generation
+            # (r15 moved the gate to corro_capture_on()); one-time at
+            # open, inside a single transaction
+            with self._lock:
+                self._conn.execute("BEGIN IMMEDIATE")
+                try:
+                    for t in self.schema.tables.values():
+                        self._drop_triggers(t.name)
+                        self._create_triggers(t)
+                    self._conn.execute("COMMIT")
+                except BaseException:
+                    _safe_rollback(self._conn)
+                    raise
 
     # -- connection setup --------------------------------------------------
 
@@ -484,6 +548,13 @@ class CrdtStore:
             "corro_json_contains", 2, _corro_json_contains,
             deterministic=True,
         )
+        # the trigger capture gate — deliberately NON-deterministic so
+        # sqlite re-evaluates it per trigger fire.  NOTE: out-of-band
+        # writers (a bare sqlite3 shell) would need this function to
+        # write CRR tables; like the reference's crsql extension, all
+        # writes are expected to go through the agent.
+        flag = self._capture_flag
+        conn.create_function("corro_capture_on", 0, lambda: flag[0])
         if not native.load_into(conn):
             conn.create_function(
                 "crdt_pack", -1, _sql_pack, deterministic=True
@@ -709,7 +780,23 @@ class CrdtStore:
                 _safe_rollback(self._conn)
                 raise
         self.schema = new_schema
+        self._shape_cache.clear()  # shapes bind column sets/affinities
         return new_schema
+
+    def capture_shape(self, sql: str):
+        """Cached direct-capture shape for one statement text (None =
+        not capturable — the triggers handle it).  Callers hold the
+        store lock (the write path)."""
+        cache = self._shape_cache
+        try:
+            return cache[sql]
+        except KeyError:
+            pass
+        if len(cache) > 4096:
+            cache.clear()  # unbounded ad-hoc SQL must not pin memory
+        shape = _capture.parse_shape(sql, self.schema)
+        cache[sql] = shape
+        return shape
 
     def _create_crr_machinery(self, t) -> None:
         ct, rt = _clock_table(t.name), _rows_table(t.name)
@@ -738,7 +825,11 @@ class CrdtStore:
         name = t.name
         new_pk = self._pk_pack_expr(t, "NEW")
         old_pk = self._pk_pack_expr(t, "OLD")
-        gate = "(SELECT capture FROM __crdt_ctx WHERE id = 1) = 1"
+        # r15: the gate is a connection-registered function over
+        # CrdtStore._capture_flag (toggling costs no statement and no
+        # WAL page; pre-r15 DDL gated on a __crdt_ctx subselect, and
+        # triggers are refreshed at open so old DBs migrate)
+        gate = "corro_capture_on() = 1"
         ins_cols = "".join(
             f"INSERT INTO __crdt_pending (tbl, pk, cid, val)"
             f" VALUES ('{name}', {new_pk}, '{c}', NEW.\"{c}\");\n"
@@ -783,11 +874,17 @@ class CrdtStore:
     # -- db_version accounting --------------------------------------------
 
     def db_version_for(self, site: ActorId) -> int:
+        key = site.bytes16
+        v = self._dv_cache.get(key)
+        if v is not None:
+            return v
         row = self._conn.execute(
             "SELECT db_version FROM __crdt_db_versions WHERE site_id = ?",
-            (site.bytes16,),
+            (key,),
         ).fetchone()
-        return row["db_version"] if row else 0
+        v = row["db_version"] if row else 0
+        self._dv_cache[key] = v
+        return v
 
     def _bump_db_version(self, site: ActorId, version: int) -> None:
         self._conn.execute(
@@ -796,17 +893,26 @@ class CrdtStore:
             " MAX(db_version, excluded.db_version)",
             (site.bytes16, version),
         )
+        key = site.bytes16
+        cached = self._dv_cache.get(key, 0)
+        if version > cached:
+            self._dv_cache[key] = version
 
     # -- local writes ------------------------------------------------------
 
-    def write_tx(self, ts: Timestamp, nested: bool = False) -> "WriteTx":
+    def write_tx(
+        self, ts: Timestamp, nested: bool = False, savepoint: bool = True
+    ) -> "WriteTx":
         """Begin a local write transaction capturing CRDT changes.
 
         ``nested=True`` begins a SAVEPOINT sub-transaction for use
         inside a ``group_tx`` scope (r14 group commit): the sub-tx gets
         per-writer rollback isolation while the leader's one
-        BEGIN/COMMIT (one fsync, one lock hold) covers the batch."""
-        return WriteTx(self, ts, nested=nested)
+        BEGIN/COMMIT (one fsync, one lock hold) covers the batch.
+        ``savepoint=False`` (nested only, r15) skips the savepoint for
+        a SOLO batch — no batchmates to isolate, failure aborts the
+        whole group tx."""
+        return WriteTx(self, ts, nested=nested, savepoint=savepoint)
 
     def finalize_group(self, items) -> List[Tuple[List[Change], int, int]]:
         """Finalize one or more sub-transactions' pending logs in ONE
@@ -878,8 +984,11 @@ class CrdtStore:
         clock_clear: Dict[str, Dict[bytes, None]] = {}  # ordered set
         clock_put: Dict[str, Dict[bytes, Dict[str, tuple]]] = {}
         out: List[List[Change]] = []
-        next_dv = self.db_version_for(site) + 1
+        start_dv = self.db_version_for(site)
+        next_dv = start_dv + 1
 
+        site_bytes = site.bytes16
+        new_change = Change.__new__
         for (cells, order, deleted_rows), (_pending, ts) in zip(
             deduped, items
         ):
@@ -887,14 +996,27 @@ class CrdtStore:
             changes: List[Change] = []
 
             def emit(tbl, pk, cid, val, col_version, cl):
-                changes.append(
-                    Change(
-                        table=tbl, pk=pk, cid=cid, val=val,
-                        col_version=col_version, db_version=db_version,
-                        seq=len(changes), site_id=site.bytes16, cl=cl,
-                        ts=ts,
-                    )
+                # fused encode (r15): build the change's wire cell in
+                # the SAME pass that emits it, so commit goes captured
+                # cells → clocked changes → shared wire bytes in one
+                # walk (with_wire_body then just splices cached cells).
+                # The Change is built via __dict__ to skip the frozen
+                # dataclass's per-field object.__setattr__ — this loop
+                # runs once per written cell on every local commit.
+                seq = len(changes)
+                cw = Writer()
+                write_change_fields(
+                    cw, tbl, pk, cid, val, col_version, db_version,
+                    seq, site_bytes, cl,
                 )
+                ch = new_change(Change)
+                ch.__dict__.update(
+                    table=tbl, pk=pk, cid=cid, val=val,
+                    col_version=col_version, db_version=db_version,
+                    seq=seq, site_id=site_bytes, cl=cl,
+                    ts=ts, wire_cell=cw.bytes(),
+                )
+                changes.append(ch)
 
             def clear_clocks(tbl, pk):
                 clock_clear.setdefault(tbl, {})[pk] = None
@@ -982,7 +1104,7 @@ class CrdtStore:
                     ],
                 )
 
-        if next_dv > self.db_version_for(site) + 1:
+        if next_dv > start_dv + 1:
             self._bump_db_version(site, next_dv - 1)
         results: List[Tuple[List[Change], int, int]] = []
         for changes in out:
@@ -1004,12 +1126,12 @@ class CrdtStore:
         individual writer failures are contained by their savepoints."""
         with self._lock:
             self._conn.execute("BEGIN IMMEDIATE")
-            self._conn.execute("DELETE FROM __crdt_pending")
             try:
                 yield self
                 self._conn.execute("COMMIT")
             except BaseException:
                 _safe_rollback(self._conn)
+                self._dv_cache.clear()  # bumps may have rolled back
                 raise
 
     # -- serving changes (crsql_changes reads) ----------------------------
@@ -1222,7 +1344,12 @@ class CrdtStore:
         changed_tables: Dict[str, int] = {}
         with self._lock:
             self._conn.execute("BEGIN IMMEDIATE")
-            self._conn.execute("UPDATE __crdt_ctx SET capture = 0 WHERE id = 1")
+            # gate triggers off for the remote apply — a Python store,
+            # restored unconditionally in the finally (r15: the old
+            # __crdt_ctx UPDATE needed an interrupt-proof retry dance
+            # to guarantee local writes kept replicating; a flag store
+            # cannot fail)
+            self._capture_flag[0] = 0
             try:
                 impactful = self._apply_batch(changes, changed_tables)
                 site_max: Dict[bytes, int] = {}
@@ -1231,29 +1358,13 @@ class CrdtStore:
                         site_max[ch.site_id] = ch.db_version
                 for site, version in site_max.items():
                     self._bump_db_version(ActorId(site), version)
-                self._conn.execute("UPDATE __crdt_ctx SET capture = 1 WHERE id = 1")
                 self._conn.execute("COMMIT")
             except BaseException:
                 _safe_rollback(self._conn)
-                # the watchdog can still be armed here: an interrupt
-                # landing on THIS statement must not leave capture=0 on
-                # the persistent write conn (every later local write
-                # would silently skip CRDT capture). The interrupt flag
-                # is momentary — one retry suffices; failure is loud.
-                for attempt in (0, 1):
-                    try:
-                        self._conn.execute(
-                            "UPDATE __crdt_ctx SET capture = 1 WHERE id = 1"
-                        )
-                        break
-                    except sqlite3.OperationalError:
-                        if attempt:
-                            log.critical(
-                                "could not restore CRDT capture flag; "
-                                "local writes will not replicate"
-                            )
-                            raise
+                self._dv_cache.clear()  # bumps may have rolled back
                 raise
+            finally:
+                self._capture_flag[0] = 1
         return AppliedChanges(impactful, changed_tables)
 
     def _apply_batch(
@@ -2116,7 +2227,11 @@ class WriteTx:
     `api/public/mod.rs:57-258`, change.rs:188)."""
 
     def __init__(
-        self, store: CrdtStore, ts: Timestamp, nested: bool = False
+        self,
+        store: CrdtStore,
+        ts: Timestamp,
+        nested: bool = False,
+        savepoint: bool = True,
     ):
         self.store = store
         self.ts = ts
@@ -2124,20 +2239,39 @@ class WriteTx:
         # nested=True: a sub-transaction of a group commit — the caller
         # (CrdtStore.group_tx leader) holds the store lock and the outer
         # BEGIN IMMEDIATE; this tx is a SAVEPOINT so a failed writer
-        # rolls back alone without aborting its batchmates
+        # rolls back alone without aborting its batchmates.
+        # savepoint=False (nested only, r15): a SOLO group batch skips
+        # the savepoint round-trip — with one writer there are no
+        # batchmates to shield, and a failure aborts the whole group tx
         self._nested = nested
+        self._savepoint = savepoint
+        # r15 direct capture state: `_captured` is the in-memory
+        # pending stream — (tbl, pk, cid, val) tuples in statement
+        # order; `_pending_dirty` marks trigger-captured rows sitting
+        # in `__crdt_pending` that must be drained (in rowseq order)
+        # into `_captured` before anything is appended after them;
+        # `_capture_off` shadows the in-tx `__crdt_ctx.capture` value
+        # so toggles only run on transitions (restored before COMMIT —
+        # a rollback restores the committed 1 on its own)
+        self._direct = store.direct_capture and _capture_engine() == "direct"
+        self._captured: List[tuple] = []
+        self._pending_dirty = False
+        self._capture_off = False
+        # capture telemetry, flushed ONCE per commit (registry calls
+        # are locked — too heavy for the per-statement hot path)
+        self._n_direct = 0
+        self._n_trigger = 0
+        self._n_fallback = 0
+        self._capture_secs = 0.0
 
     def __enter__(self) -> "WriteTx":
         self.store._lock.acquire()
         self.conn = self.store._conn
         if self._nested:
-            # group_tx cleared __crdt_pending once for the whole batch,
-            # and a failed sub-tx's savepoint rollback restores the
-            # empty state — no per-writer defensive DELETE needed
-            self.conn.execute("SAVEPOINT __corro_wtx")
+            if self._savepoint:
+                self.conn.execute("SAVEPOINT __corro_wtx")
         else:
             self.conn.execute("BEGIN IMMEDIATE")
-            self.conn.execute("DELETE FROM __crdt_pending")
         return self
 
     def execute(self, sql: str, params=()) -> int:
@@ -2149,24 +2283,303 @@ class WriteTx:
         (including a DELETE/UPDATE matching nothing → 0) pass through
         untouched.  `params` may be a sequence or a dict (named
         parameters), so the /v1/transactions named-param path shares
-        this trace/timing point."""
-        from corrosion_tpu.runtime.trace import timed_query
+        this trace/timing point.
 
-        with timed_query(sql):
-            cur = self.conn.execute(
-                sql, params if isinstance(params, dict) else tuple(params)
-            )
-        return cur.rowcount if cur.rowcount >= 0 else 0
+        r15: recognized INSERT/UPDATE/DELETE shapes on CRDT-tracked
+        tables capture their written cells directly in memory
+        (store/capture.py) instead of taking the trigger →
+        `__crdt_pending` round-trip; raw/unrecognized SQL keeps the
+        trigger path, and the two streams merge in statement order."""
+        if self._direct:
+            shape = self.store.capture_shape(sql)
+            if shape is not None:
+                n = self._execute_captured(sql, shape, params, None)
+                if n is not None:
+                    return n
+        return self._execute_raw(sql, params)
 
     def executemany(self, sql: str, rows: Sequence) -> int:
         """Bulk DML: one prepared statement stepped over many parameter
         rows (the write-side counterpart of the r10 matcher's
         executemany flushes — bulk ingest writers should prefer this
-        over a Python loop of `execute`).  Returns total rows affected."""
+        over a Python loop of `execute`).  Returns total rows affected.
+
+        On the direct-capture path the whole call runs inside a
+        SAVEPOINT: a row that fails mid-batch rolls the batch back
+        before raising, so the in-memory capture never diverges from
+        partially-applied statements."""
+        rows = list(rows)
+        if self._direct and rows:
+            shape = self.store.capture_shape(sql)
+            if shape is not None:
+                n = self._execute_captured(sql, shape, None, rows)
+                if n is not None:
+                    return n
+        return self._executemany_raw(sql, rows)
+
+    # -- capture plumbing (r15) ----------------------------------------
+
+    def _execute_raw(self, sql: str, params) -> int:
+        """The pre-r15 statement path: AFTER triggers log written cells
+        to `__crdt_pending`."""
         from corrosion_tpu.runtime.trace import timed_query
 
+        self._ensure_capture(True)
         with timed_query(sql):
-            cur = self.conn.executemany(sql, list(rows))
+            cur = self.conn.execute(
+                sql, params if isinstance(params, dict) else tuple(params)
+            )
+        self._pending_dirty = True
+        self._n_trigger += 1
+        return cur.rowcount if cur.rowcount >= 0 else 0
+
+    def _executemany_raw(self, sql: str, rows: list) -> int:
+        from corrosion_tpu.runtime.trace import timed_query
+
+        self._ensure_capture(True)
+        with timed_query(sql):
+            cur = self.conn.executemany(sql, rows)
+        self._pending_dirty = True
+        self._n_trigger += 1
+        return cur.rowcount if cur.rowcount >= 0 else 0
+
+    def _flush_capture_metrics(self) -> None:
+        """One registry round per commit for the per-statement capture
+        counters (`corro.write.capture.{direct,trigger,fallback}.total`
+        + `corro.write.capture.seconds`)."""
+        from corrosion_tpu.runtime.metrics import METRICS
+
+        if self._n_direct:
+            METRICS.counter("corro.write.capture.direct.total").inc(
+                self._n_direct
+            )
+            METRICS.histogram("corro.write.capture.seconds").observe(
+                self._capture_secs
+            )
+        if self._n_trigger:
+            METRICS.counter("corro.write.capture.trigger.total").inc(
+                self._n_trigger
+            )
+        if self._n_fallback:
+            METRICS.counter("corro.write.capture.fallback.total").inc(
+                self._n_fallback
+            )
+        self._n_direct = self._n_trigger = self._n_fallback = 0
+        self._capture_secs = 0.0
+
+    def _ensure_capture(self, on: bool) -> None:
+        """Transition the trigger gate (`CrdtStore._capture_flag`, read
+        by the triggers' corro_capture_on()) only when needed — a plain
+        Python store, unconditionally restored to ON in __exit__."""
+        if self._capture_off == (not on):
+            return
+        self.store._capture_flag[0] = 1 if on else 0
+        self._capture_off = not on
+
+    def _drain_trigger_rows(self) -> None:
+        """Move trigger-logged pending rows into the in-memory stream.
+        Invariant: rows in `__crdt_pending` always postdate the last
+        drained/direct append, so extending at the tail preserves the
+        exact rowseq order a pure trigger run would have produced."""
+        if not self._pending_dirty:
+            return
+        conn = self.conn
+        rows = conn.execute(
+            "SELECT tbl, pk, cid, val FROM __crdt_pending ORDER BY rowseq"
+        ).fetchall()
+        if rows:
+            self._captured.extend(
+                (r[0], bytes(r[1]), r[2], r[3]) for r in rows
+            )
+            conn.execute("DELETE FROM __crdt_pending")
+        self._pending_dirty = False
+
+    def _take_pending(self) -> list:
+        """The merged capture stream for finalize, leaving the tx clean."""
+        self._drain_trigger_rows()
+        out, self._captured = self._captured, []
+        return out
+
+    def _preimage(
+        self, meta, pk_tuples: list, cols: list
+    ) -> Dict[tuple, dict]:
+        """Current values of `cols` for the given pk tuples (absent key
+        = no such row) — ONE chunked read replacing the per-cell state
+        the triggers would have materialized."""
+        conn = self.conn
+        uniq = list(dict.fromkeys(pk_tuples))
+        sel = ", ".join(f'"{c}"' for c in (*meta.pk_cols, *cols))
+        npk = len(meta.pk_cols)
+        out: Dict[tuple, dict] = {}
+        if npk == 1:
+            step = 900
+            col = meta.pk_cols[0]
+            for i in range(0, len(uniq), step):
+                chunk = uniq[i : i + step]
+                marks = ",".join("?" * len(chunk))
+                for r in conn.execute(
+                    f'SELECT {sel} FROM "{meta.name}" WHERE "{col}"'
+                    f" IN ({marks})",
+                    [u[0] for u in chunk],
+                ):
+                    out[(r[0],)] = {
+                        c: r[npk + j] for j, c in enumerate(cols)
+                    }
+        else:
+            step = max(1, 800 // npk)
+            pk_sel = ",".join(f'"{c}"' for c in meta.pk_cols)
+            row_marks = "(" + ",".join("?" * npk) + ")"
+            for i in range(0, len(uniq), step):
+                chunk = uniq[i : i + step]
+                values = ",".join([row_marks] * len(chunk))
+                for r in conn.execute(
+                    f'SELECT {sel} FROM "{meta.name}"'
+                    f" WHERE ({pk_sel}) IN (VALUES {values})",
+                    [v for u in chunk for v in u],
+                ):
+                    out[tuple(r[k] for k in range(npk))] = {
+                        c: r[npk + j] for j, c in enumerate(cols)
+                    }
+        return out
+
+    def _execute_captured(
+        self, sql: str, shape, params, many_rows: Optional[list]
+    ) -> Optional[int]:
+        """Run one recognized statement with triggers gated off and the
+        written cells captured in memory.  None → value-level fallback:
+        the statement has NOT run and the caller takes the trigger
+        path.  Capture metrics accumulate on the tx and flush once per
+        commit (`_flush_capture_metrics`) — this runs per statement on
+        the hottest write path."""
+        import time as _time
+
+        from corrosion_tpu.runtime.trace import timed_query
+
+        t0 = _time.monotonic()
+        cap = _capture
+        meta = shape.meta
+        rows = many_rows if many_rows is not None else [params]
+        kind = shape.kind
+        if kind == "insert":
+            plans = cap.plan_insert_rows(shape, rows, many_rows is None)
+        elif kind == "update":
+            plans = []
+            for p in rows:
+                plan = cap.plan_update_row(shape, p)
+                if plan is None:
+                    plans = None
+                    break
+                plans.append(plan)
+        else:
+            plans = []
+            for p in rows:
+                plan = cap.plan_delete_row(shape, p)
+                if plan is None:
+                    plans = None
+                    break
+                plans.append(plan)
+        if plans is None:
+            self._n_fallback += 1
+            return None
+
+        # pre-image: ONE read feeding existence + IS-NOT comparisons
+        conn = self.conn
+        live: Dict[tuple, Optional[dict]] = {}
+        conflicty = kind == "insert" and shape.conflict in (
+            "ignore", "nothing", "upsert",
+        )
+        if kind == "update":
+            cols = [c for c, _ in shape.set_slots]
+            live = self._preimage(meta, [p[0] for p in plans], cols)
+        elif conflicty:
+            cols = sorted({c for c, _ in shape.upsert_set})
+            live = self._preimage(meta, [p[0] for p in plans], cols)
+        elif kind == "delete" and many_rows is not None:
+            live = self._preimage(meta, plans, [])
+
+        self._ensure_capture(False)
+        savepoint = many_rows is not None and len(rows) > 1
+        if savepoint:
+            conn.execute("SAVEPOINT __corro_cap")
+            try:
+                with timed_query(sql):
+                    cur = conn.executemany(sql, rows)
+            except BaseException:
+                conn.execute("ROLLBACK TO __corro_cap")
+                conn.execute("RELEASE SAVEPOINT __corro_cap")
+                raise
+            conn.execute("RELEASE SAVEPOINT __corro_cap")
+        elif many_rows is not None:
+            with timed_query(sql):
+                cur = conn.executemany(sql, rows)
+        else:
+            with timed_query(sql):
+                cur = conn.execute(
+                    sql,
+                    params if isinstance(params, dict) else tuple(params),
+                )
+
+        # emit the trigger-equivalent stream, in statement order
+        if self._pending_dirty:
+            self._drain_trigger_rows()
+        captured = self._captured
+        tbl = meta.name
+        pack = pack_columns
+        if kind == "insert":
+            for pk_vals, cells, skip, assigns, assigns_pend in plans:
+                if skip:
+                    continue
+                if pk_vals is None:
+                    pk_vals = (cur.lastrowid,)
+                if conflicty:
+                    old = live.get(pk_vals)
+                    if old is not None:
+                        if shape.conflict == "upsert":
+                            pk = pack(list(pk_vals))
+                            for cid, _sv in cap._cells_update(
+                                meta, old, assigns
+                            ):
+                                captured.append(
+                                    (tbl, pk, cid, assigns_pend[cid])
+                                )
+                            old.update(assigns)
+                        # ignore / nothing: the row was silently skipped
+                        continue
+                    # later rows of this batch now conflict against
+                    # this fresh row: its cell values (pending domain —
+                    # `values_distinct` compares int/real numerically,
+                    # so the integral-float munge cannot flip a verdict)
+                    live[pk_vals] = {
+                        cid: v for cid, v in cells if cid != SENTINEL
+                    }
+                pk = pack(list(pk_vals))
+                captured.extend((tbl, pk, cid, v) for cid, v in cells)
+        elif kind == "update":
+            for pk_vals, new, new_pend in plans:
+                old = live.get(pk_vals)
+                if old is None:
+                    continue  # no row matched the pk
+                cells = cap._cells_update(meta, old, new)
+                if cells:
+                    pk = pack(list(pk_vals))
+                    for cid, _sv in cells:
+                        captured.append((tbl, pk, cid, new_pend[cid]))
+                old.update(new)
+        else:  # delete
+            if many_rows is None:
+                if cur.rowcount >= 1:
+                    pk = pack(list(plans[0]))
+                    for cid, val in cap._cells_delete(meta):
+                        captured.append((tbl, pk, cid, val))
+            else:
+                for pk_vals in plans:
+                    if live.pop(pk_vals, None) is not None:
+                        pk = pack(list(pk_vals))
+                        for cid, val in cap._cells_delete(meta):
+                            captured.append((tbl, pk, cid, val))
+
+        self._n_direct += 1
+        self._capture_secs += _time.monotonic() - t0
         return cur.rowcount if cur.rowcount >= 0 else 0
 
     def commit(self) -> Tuple[List[Change], int, int]:
@@ -2178,19 +2591,18 @@ class WriteTx:
 
         conn = self.conn
         try:
-            pending = conn.execute(
-                "SELECT rowseq, tbl, pk, cid, val FROM __crdt_pending"
-                " ORDER BY rowseq"
-            ).fetchall()
+            self._ensure_capture(True)
+            self._flush_capture_metrics()
+            pending = self._take_pending()
             t0 = _time.monotonic()
             changes = self._finalize_pending(pending)
             if pending:
                 METRICS.histogram("corro.write.finalize.seconds").observe(
                     _time.monotonic() - t0
                 )
-            conn.execute("DELETE FROM __crdt_pending")
             if self._nested:
-                conn.execute("RELEASE SAVEPOINT __corro_wtx")
+                if self._savepoint:
+                    conn.execute("RELEASE SAVEPOINT __corro_wtx")
             else:
                 conn.execute("COMMIT")
             self._done = True
@@ -2203,6 +2615,7 @@ class WriteTx:
                 self._rollback_nested()
             else:
                 _safe_rollback(conn)
+                self.store._dv_cache.clear()  # bump may have rolled back
             self._done = True
             raise
 
@@ -2214,13 +2627,11 @@ class WriteTx:
         one probe/flush round instead of one per writer."""
         conn = self.conn
         try:
-            pending = conn.execute(
-                "SELECT rowseq, tbl, pk, cid, val FROM __crdt_pending"
-                " ORDER BY rowseq"
-            ).fetchall()
-            if pending:
-                conn.execute("DELETE FROM __crdt_pending")
-            conn.execute("RELEASE SAVEPOINT __corro_wtx")
+            self._ensure_capture(True)
+            self._flush_capture_metrics()
+            pending = self._take_pending()
+            if self._savepoint:
+                conn.execute("RELEASE SAVEPOINT __corro_wtx")
             self._done = True
             return pending
         except BaseException:
@@ -2231,7 +2642,11 @@ class WriteTx:
     def _rollback_nested(self) -> None:
         """Undo this sub-transaction only; the outer group tx lives on.
         If the OUTER transaction was already rolled back (interrupt),
-        the savepoint is gone with it — nothing left to undo."""
+        the savepoint is gone with it — nothing left to undo.  A
+        savepoint-free solo sub-tx has nothing local to undo either:
+        its failure propagates and aborts the whole group tx."""
+        if not self._savepoint:
+            return
         try:
             self.conn.execute("ROLLBACK TO __corro_wtx")
             self.conn.execute("RELEASE SAVEPOINT __corro_wtx")
@@ -2254,6 +2669,9 @@ class WriteTx:
                 else:
                     self.rollback()
         finally:
+            # the capture gate is process state, not tx state: whatever
+            # happened above, triggers must be live for the next writer
+            self.store._capture_flag[0] = 1
             self.store._lock.release()
         return False
 
@@ -2291,7 +2709,7 @@ class WriteTx:
         deleted_rows: Dict[Tuple[str, bytes], bool] = {}
         created_rows: Dict[Tuple[str, bytes], bool] = {}
         for r in pending:
-            tbl, pk, cid, val = r["tbl"], bytes(r["pk"]), r["cid"], r["val"]
+            tbl, pk, cid, val = r
             if cid == SENTINEL + "X":  # delete marker from the del trigger
                 deleted_rows[(tbl, pk)] = True
                 created_rows.pop((tbl, pk), None)

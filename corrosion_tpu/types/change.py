@@ -43,6 +43,14 @@ class Change:
     site_id: bytes  # 16 bytes == ActorId
     cl: int  # causal length (odd=alive, even=deleted)
     ts: Timestamp = field(default=Timestamp(0), compare=False)
+    # r15 fused encode: this change's speedy cell bytes (the exact
+    # `write_change` output), built in the SAME pass that emits the
+    # Change at local commit (`CrdtStore.finalize_group`), so every
+    # changeset encode splices cached bytes instead of re-walking the
+    # values.  Pure cache: never part of identity, never required.
+    wire_cell: Optional[bytes] = field(
+        default=None, compare=False, repr=False
+    )
 
     def estimated_byte_size(self) -> int:
         # change.rs:34-52: rough wire-size estimate

@@ -202,18 +202,45 @@ def read_value(r: Reader) -> SqliteValue:
 _CHANGE_TAIL = struct.Struct("<qQQ")
 
 
+def write_change_fields(
+    w: Writer,
+    table: str,
+    pk: bytes,
+    cid: str,
+    val: SqliteValue,
+    col_version: int,
+    db_version: int,
+    seq: int,
+    site_id: bytes,
+    cl: int,
+) -> None:
+    """One change cell from raw fields — the single source of truth for
+    the cell layout, shared by `write_change` and the r15 fused local
+    commit (`finalize_group` builds `Change.wire_cell` in the same pass
+    that emits the Change)."""
+    w.string(table)
+    w.vec_u8(pk)
+    w.string(cid)
+    write_value(w, val)
+    buf = w.buf
+    buf += _CHANGE_TAIL.pack(col_version, db_version, seq)
+    buf += site_id
+    buf += struct.pack("<q", cl)
+
+
 def write_change(w: Writer, c: Change) -> None:
     # hot path (every broadcast/sync encode walks one of these per cell
-    # when no wire_body is cached): fixed-width tail fused into single
-    # packs — byte layout unchanged (golden tests in test_codec.py)
-    w.string(c.table)
-    w.vec_u8(c.pk)
-    w.string(c.cid)
-    write_value(w, c.val)
-    buf = w.buf
-    buf += _CHANGE_TAIL.pack(c.col_version, c.db_version, c.seq)
-    buf += c.site_id
-    buf += struct.pack("<q", c.cl)
+    # when no wire_body is cached): a change carrying its r15 cached
+    # cell bytes splices them verbatim; otherwise the fixed-width tail
+    # is fused into single packs — byte layout identical either way
+    # (pinned in test_codec.py goldens + test_capture.py)
+    if c.wire_cell is not None:
+        w.buf += c.wire_cell
+        return
+    write_change_fields(
+        w, c.table, c.pk, c.cid, c.val, c.col_version, c.db_version,
+        c.seq, c.site_id, c.cl,
+    )
 
 
 def read_change(r: Reader) -> Change:

@@ -19,12 +19,17 @@ transactions + vectorized `_finalize_pending` + encode-once broadcast):
                     `corro.e2e.total` histograms and cross-checked
                     against `GET /v1/slo`.
 
-`--ab` measures pre AND post in one run (pre = per-cell finalize via a
-SCOPED `CORRO_FINALIZE=percell` + `perf.group_commit = False` + the
-pre-r14 0.6 s `candidate_batch_wait`; nothing leaks into `os.environ`
-afterwards — the old bench's permanent `CORRO_NATIVE_BATCH` mutation is
-gone).  Records merge by rung into INGEST_BENCH.json, `code_sha`-stamped
-over the measured write-path files (bench.py replay-gate discipline).
+`--ab` measures pre AND post in one run; nothing leaks into
+`os.environ` afterwards (scoped_env).  Since r15 the A/B axis is the
+CHANGE-CAPTURE engine: pre = `CORRO_CAPTURE=trigger` (the AFTER-trigger
+→ `__crdt_pending` round-trip, the r14 path) vs post = direct in-memory
+capture (store/capture.py), with group commit / vectorized finalize /
+encode-once identical on both sides.  Run with `--tag r15` so the new
+rungs land NEXT TO the banked r14 records (`ingest-local-*-{pre,post}`)
+instead of overwriting them — tests/test_ingest_bench.py compares the
+r15 post both against its own pre and against the banked r14 post.
+Records merge by rung into INGEST_BENCH.json, `code_sha`-stamped over
+the measured write-path files (bench.py replay-gate discipline).
 
 Usage:
   python scripts/bench_ingest.py [--mode pre|post|ab] [--tag T]
@@ -58,6 +63,7 @@ from corrosion_tpu.types.pack import pack_columns  # noqa: E402
 
 _MEASURED_FILES = (
     "corrosion_tpu/store/crdt.py",
+    "corrosion_tpu/store/capture.py",
     "corrosion_tpu/agent/run.py",
     "corrosion_tpu/agent/broadcast.py",
     "corrosion_tpu/types/codec.py",
@@ -66,8 +72,10 @@ _MEASURED_FILES = (
 
 # local-write workload: every writer commits TXS_TOTAL/N transactions of
 # ROWS_PER_TX rows each — the per-commit overhead (BEGIN/COMMIT, lock,
-# bookkeeping, fsync batching) is exactly what group commit amortizes
-TXS_TOTAL = 192
+# bookkeeping, fsync batching) is exactly what group commit amortizes.
+# r15 tripled the run length: the 192-tx rungs finished in ~0.15 s and
+# the banked rows/s swung ±20% with host noise on the 1-core bench box
+TXS_TOTAL = 576
 ROWS_PER_TX = 10
 
 
@@ -104,7 +112,10 @@ def scoped_env(**kv):
 
 
 def _pre_env(mode: str) -> dict:
-    return {"CORRO_FINALIZE": "percell"} if mode == "pre" else {}
+    # r15 A/B: pre restores the trigger/__crdt_pending capture path
+    # (everything else — group commit, vectorized finalize, encode-once
+    # — identical), so the delta isolates direct capture itself
+    return {"CORRO_CAPTURE": "trigger"} if mode == "pre" else {}
 
 
 def _record(rung: str, mode: str, tag: str, **fields) -> dict:
@@ -131,8 +142,6 @@ async def _local_write(
     name = f"bench-ingest-w{n_writers}{'d' if durable else ''}"
     net = MemNetwork(seed=11)
     cfg = fast_config(name)
-    if mode == "pre":
-        cfg.perf.group_commit = False
     agent = await boot(net, name, cfg=cfg)
     if durable:
         # the fsync-per-commit regime (PRAGMA synchronous=FULL on the
@@ -147,15 +156,13 @@ async def _local_write(
     sql = "INSERT OR REPLACE INTO tests (id, text) VALUES (?, ?)"
 
     def mk_fn(base: int):
+        # both modes drive the r14 bulk API — the r15 A/B isolates the
+        # capture engine, not the statement style
         rows = [(base + j, f"v{base + j}") for j in range(ROWS_PER_TX)]
-        if mode == "pre":
-            # the PR-start API: one execute per row (WriteTx had no bulk
-            # statement path before r14)
-            def fn(tx):
-                return [tx.execute(sql, r) for r in rows]
-        else:
-            def fn(tx):
-                return [tx.executemany(sql, rows)]
+
+        def fn(tx):
+            return [tx.executemany(sql, rows)]
+
         return fn
 
     async def writer(w: int) -> None:
@@ -281,9 +288,6 @@ async def _e2e(mode: str, tag: str) -> dict:
 
     net = MemNetwork(seed=13)
     cfg = fast_config("bench-ingest-e2e")
-    if mode == "pre":
-        cfg.perf.group_commit = False
-        cfg.pubsub.candidate_batch_wait = 0.6  # the pre-r14 default
     agent = await boot(net, "bench-ingest-e2e", cfg=cfg)
     api = ApiServer(agent)
     agent.config.api.bind_addr = ["127.0.0.1:0"]
@@ -341,11 +345,16 @@ async def _e2e(mode: str, tag: str) -> dict:
 # -- driver ----------------------------------------------------------------
 
 
+def _mode_env(mode: str):
+    env = _pre_env(mode)
+    return scoped_env(**env) if env else contextlib.nullcontext()
+
+
 def run_mode(mode: str, tag: str) -> list:
     import tempfile
 
     recs = []
-    with scoped_env(**_pre_env(mode)) if _pre_env(mode) else contextlib.nullcontext():
+    with _mode_env(mode):
         for n in (1, 4, 16):
             recs.append(asyncio.run(_local_write(n, mode, tag)))
         for n in (1, 4, 16):
@@ -363,6 +372,40 @@ def run_mode(mode: str, tag: str) -> list:
     return recs
 
 
+def run_ab(tag: str) -> list:
+    """A/B with pre and post ADJACENT per rung: the 1-core bench host's
+    throughput drifts over a multi-minute run, and the old
+    all-pre-then-all-post order systematically biased whichever half
+    ran second."""
+    import tempfile
+
+    recs = []
+    for durable in (False, True):
+        for n in (1, 4, 16):
+            for mode in ("pre", "post"):
+                with _mode_env(mode):
+                    recs.append(asyncio.run(
+                        _local_write(n, mode, tag, durable=durable)
+                    ))
+    with tempfile.TemporaryDirectory() as tmp:
+        for mode in ("pre", "post"):
+            with _mode_env(mode):
+                recs.append(_apply_rung(
+                    "ingest-remote", _gen_uniform(20_000, 400), 500,
+                    mode, tag, tmp,
+                ))
+        for mode in ("pre", "post"):
+            with _mode_env(mode):
+                recs.append(_apply_rung(
+                    "ingest-conflict", _gen_conflict(20_000), 500,
+                    mode, tag, tmp,
+                ))
+    for mode in ("pre", "post"):
+        with _mode_env(mode):
+            recs.append(asyncio.run(_e2e(mode, tag)))
+    return recs
+
+
 def main() -> None:
     args = sys.argv[1:]
     mode = "post"
@@ -377,21 +420,23 @@ def main() -> None:
         del args[i : i + 2]
     if "--ab" in args:
         mode = "ab"
-    modes = ("pre", "post") if mode == "ab" else (mode,)
-    all_recs = []
-    for m in modes:
-        recs = run_mode(m, tag)
-        for r in recs:
+    if mode == "ab":
+        all_recs = run_ab(tag)
+        for r in all_recs:
             print(json.dumps(r), flush=True)
-        all_recs.extend(recs)
+    else:
+        all_recs = run_mode(mode, tag)
+        for r in all_recs:
+            print(json.dumps(r), flush=True)
     merge_records(os.path.join(REPO, "INGEST_BENCH.json"), all_recs)
     # headline: the banked acceptance ratios when both halves exist
     with open(os.path.join(REPO, "INGEST_BENCH.json")) as f:
         banked = {r["rung"]: r for r in json.load(f)}
 
     def ratio(rung: str) -> str:
-        pre = banked.get(f"{rung}-pre")
-        post = banked.get(f"{rung}-post")
+        sfx = f"-{tag}" if tag else ""
+        pre = banked.get(f"{rung}-pre{sfx}")
+        post = banked.get(f"{rung}-post{sfx}")
         if not pre or not post:
             return "n/a"
         return f"{post['rows_per_s'] / pre['rows_per_s']:.2f}x"

@@ -2,7 +2,8 @@
 
 The one CLI for the AST-based checker suite
 (`corrosion_tpu/analysis/`): kernel-purity, lane-parity,
-async-blocking, lock-discipline, codec-ext and metrics-doc (the folded
+async-blocking, lock-discipline, codec-ext, capture-parity (r15: the
+trigger DDL ↔ direct-capture lockstep) and metrics-doc (the folded
 r7 metric-name lint).  Wired into tier-1 via
 tests/test_static_analysis.py, so a NEW finding — or a STALE baseline
 entry — fails CI.

@@ -80,19 +80,21 @@ def apply_reference(store: CrdtStore, changes) -> list:
     impactful = []
     with store._lock:
         store._conn.execute("BEGIN IMMEDIATE")
-        store._conn.execute("UPDATE __crdt_ctx SET capture = 0 WHERE id = 1")
+        # r15: the trigger gate is the in-process capture flag (read by
+        # corro_capture_on()), not a __crdt_ctx row
+        store._capture_flag[0] = 0
         try:
             for ch in changes:
                 if store._apply_one(ch):
                     impactful.append(ch)
                 store._bump_db_version(ActorId(ch.site_id), ch.db_version)
-            store._conn.execute(
-                "UPDATE __crdt_ctx SET capture = 1 WHERE id = 1"
-            )
             store._conn.execute("COMMIT")
         except BaseException:
             store._conn.execute("ROLLBACK")
+            store._dv_cache.clear()
             raise
+        finally:
+            store._capture_flag[0] = 1
     return impactful
 
 

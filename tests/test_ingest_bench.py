@@ -93,3 +93,52 @@ def test_remote_apply_not_regressed(banked):
         pre = banked[f"{rung}-pre"]["rows_per_s"]
         post = banked[f"{rung}-post"]["rows_per_s"]
         assert post >= pre * 0.85, (rung, pre, post)
+
+
+# -- r15: direct change capture A/B (tagged rungs, r14 records kept) --------
+#
+# The r15 `--ab --tag r15` axis isolates the CAPTURE ENGINE
+# (CORRO_CAPTURE=trigger vs direct) with group commit / vectorized
+# finalize / encode-once identical on both sides.  The bench host is a
+# contended 1-core VM whose throughput swings individual rungs ±30%
+# between back-to-back runs (pre/post run ADJACENT per rung to kill
+# drift bias), so these guards pin aggregates and absolutes; the
+# DETERMINISTIC capture win — zero `__crdt_pending` statements on a
+# fully-captured transaction, byte-identical change streams — is
+# pinned in tests/test_capture.py where noise cannot reach it.
+
+
+def test_r15_capture_ab_banked_and_stamped(banked):
+    for rung in ALL_RUNGS:
+        for mode in ("pre", "post"):
+            key = f"{rung}-{mode}-r15"
+            assert key in banked, f"missing {key}"
+            sha = banked[key].get("code_sha", {})
+            assert "corrosion_tpu/store/capture.py" in sha, key
+            assert all(v != "missing" for v in sha.values()), (key, sha)
+
+
+def test_r15_direct_capture_throughput_parity(banked):
+    """Direct capture must not cost local-write throughput: banked
+    aggregate across the six local rungs stays within host noise of
+    the trigger engine."""
+    pre = sum(banked[f"{r}-pre-r15"]["rows_per_s"] for r in LOCAL_RUNGS)
+    post = sum(banked[f"{r}-post-r15"]["rows_per_s"] for r in LOCAL_RUNGS)
+    assert post >= 0.70 * pre, (pre, post)
+
+
+def test_r15_solo_commit_latency_bounded(banked):
+    """The uncontended writer's p50 commit stays in the ~1 ms band the
+    r14 round established (0.89 ms on a quiet host; the banked bound
+    absorbs the bench VM's measured jitter)."""
+    for suffix in ("", "-durable"):
+        rec = banked[f"ingest-local-w1{suffix}-post-r15"]
+        assert rec["commit_p50_ms"] <= 2.5, rec
+
+
+def test_r15_e2e_write_event_p50_held(banked):
+    """The live write→event path holds the r14 ~0.1 s p50 under direct
+    capture, with every write delivered."""
+    rec = banked["ingest-e2e-post-r15"]
+    assert rec["total_p50_s"] <= 0.3, rec
+    assert rec["events"] >= rec["writes"]

@@ -10,7 +10,7 @@ Three layers, mirroring what the suite promises:
    `# corro: noqa[rule]` comment suppresses (proving the whole
    driver-side filter chain, not just the checker).
 3. THE FOLD IS LOSSLESS: the metrics lint folded into the framework
-   still reports the same 179 literal series + 2 wildcard sites in both
+   still reports the same 183 literal series + 2 wildcard sites in both
    directions, and the `scripts/lint_metrics.py` shim keeps its API.
 
 All pure-AST: no jax tracing, no sqlite, no network — the gate must
@@ -32,6 +32,9 @@ from corrosion_tpu.analysis import (  # noqa: E402
     run_analysis,
 )
 from corrosion_tpu.analysis.blocking import AsyncBlockingChecker  # noqa: E402
+from corrosion_tpu.analysis.capture_parity import (  # noqa: E402
+    CaptureParityChecker,
+)
 from corrosion_tpu.analysis.codecext import CodecExtChecker  # noqa: E402
 from corrosion_tpu.analysis.lockcheck import (  # noqa: E402
     LockDisciplineChecker,
@@ -579,12 +582,122 @@ def test_codec_ext_real_tree_covers_all_gates():
     assert CodecExtChecker().run(ctx) == []
 
 
-# -- 7. the metrics fold + baseline machinery -------------------------------
+# -- 7. capture-parity ------------------------------------------------------
+
+_TRIG_OK = """
+    SENTINEL = "-1"
+
+    class Store:
+        def _create_triggers(self, t):
+            name = t.name
+            cols = "".join(f"({c})" for c in t.non_pk_cols)
+            self._conn.execute(
+                f'CREATE TRIGGER "{name}__crdt_ins" AFTER INSERT {cols}'
+            )
+            self._conn.execute(
+                f'CREATE TRIGGER "{name}__crdt_upd" AFTER UPDATE'
+                f" VALUES ('{name}', '{SENTINEL}X', NULL) {cols}"
+            )
+            self._conn.execute(
+                f'CREATE TRIGGER "{name}__crdt_del" AFTER DELETE'
+                f" VALUES ('{name}', '{SENTINEL}X', NULL)"
+            )
+
+        def _drop_triggers(self, name):
+            for suffix in ("ins", "upd", "del"):
+                self._conn.execute(f'DROP TRIGGER "{name}__crdt_{suffix}"')
+"""
+
+_CAP_OK = """
+    SENTINEL = "-1"
+    DELETE_MARKER = SENTINEL + "X"
+    CAPTURED_KINDS = {"insert": "ins", "update": "upd", "delete": "del"}
+
+    def _cells_insert(meta, vals):
+        return [(c, vals.get(c)) for c in meta.non_pk_cols]
+
+    def _cells_update(meta, old, new):
+        return [(c, new[c]) for c in meta.non_pk_cols if c in new]
+
+    def _cells_delete(meta):
+        return [(DELETE_MARKER, None)]
+"""
+
+
+def _parity_capture_fixture(tmp_path, cap_body=_CAP_OK, trig_body=_TRIG_OK):
+    _write(tmp_path, "store/crdt.py", trig_body)
+    _write(tmp_path, "store/capture.py", cap_body)
+    return CaptureParityChecker(
+        crdt="store/crdt.py", capture="store/capture.py"
+    )
+
+
+def test_capture_parity_clean_when_lockstep(tmp_path):
+    checker = _parity_capture_fixture(tmp_path)
+    assert checker.run(AnalysisContext(str(tmp_path))) == []
+
+
+def test_capture_parity_fires_on_uncovered_trigger_kind(tmp_path):
+    body = _CAP_OK.replace(', "delete": "del"', "")
+    checker = _parity_capture_fixture(tmp_path, cap_body=body)
+    fs = checker.run(AnalysisContext(str(tmp_path)))
+    assert any("__crdt_del" in f.message for f in fs), fs
+
+
+def test_capture_parity_fires_on_column_source_drift(tmp_path):
+    body = _CAP_OK.replace(
+        "[(c, new[c]) for c in meta.non_pk_cols if c in new]",
+        "[(c, v) for c, v in new.items()]",
+    )
+    checker = _parity_capture_fixture(tmp_path, cap_body=body)
+    fs = checker.run(AnalysisContext(str(tmp_path)))
+    assert any(
+        "column" in f.message and "_cells_update" in f.message for f in fs
+    ), fs
+
+
+def test_capture_parity_fires_on_delete_marker_drift(tmp_path):
+    body = _CAP_OK.replace(
+        'DELETE_MARKER = SENTINEL + "X"', 'DELETE_MARKER = SENTINEL + "D"'
+    )
+    checker = _parity_capture_fixture(tmp_path, cap_body=body)
+    fs = checker.run(AnalysisContext(str(tmp_path)))
+    assert any("delete-marker" in f.snippet for f in fs), fs
+
+
+def test_capture_parity_fires_on_missing_cells_builder(tmp_path):
+    body = _CAP_OK.replace("def _cells_update", "def _other_update")
+    checker = _parity_capture_fixture(tmp_path, cap_body=body)
+    fs = checker.run(AnalysisContext(str(tmp_path)))
+    assert any("_cells_update" in f.message for f in fs), fs
+
+
+def test_capture_parity_noqa_suppresses(tmp_path):
+    body = _CAP_OK.replace(
+        'CAPTURED_KINDS = {"insert": "ins", "update": "upd"}',
+        "CAPTURED_KINDS = {}",
+    ).replace(
+        'CAPTURED_KINDS = {"insert": "ins", "update": "upd", "delete": "del"}',
+        'CAPTURED_KINDS = {"insert": "ins", "update": "upd"}'
+        "  # corro: noqa[capture-parity]",
+    )
+    checker = _parity_capture_fixture(tmp_path, cap_body=body)
+    ctx = AnalysisContext(str(tmp_path))
+    result = run_analysis(ctx, [checker], baseline={})
+    assert result.new == []
+    assert result.suppressed, "the uncovered-kind finding must be noqa'd"
+
+
+def test_capture_parity_real_tree_is_clean():
+    assert CaptureParityChecker().run(AnalysisContext(REPO)) == []
+
+
+# -- 8. the metrics fold + baseline machinery -------------------------------
 
 
 def test_metrics_fold_reports_same_inventory():
-    """The lint_metrics fold is lossless: same 179 literal series (175 + the 4 r14
-    write-path series), same
+    """The lint_metrics fold is lossless: same 183 literal series (179
+    at r14 + the 4 r15 capture series), same
     2 wildcard sites, both directions clean, via BOTH the framework
     checker and the back-compat shim."""
     import lint_metrics
@@ -592,7 +705,7 @@ def test_metrics_fold_reports_same_inventory():
     assert MetricsDocChecker().run(AnalysisContext(REPO)) == []
     assert lint_metrics.lint() == []
     literals, wildcards = lint_metrics.scan_call_sites()
-    assert len(literals) == 179
+    assert len(literals) == 183
     assert len(wildcards) == 2
     names = lint_metrics.parse_components_table()
     assert len(names) == len(set(names))
