@@ -24,7 +24,9 @@ from corrosion_tpu.net.transport import TransportError
 from corrosion_tpu.runtime.channels import ChannelClosed
 from corrosion_tpu.runtime.metrics import METRICS
 from corrosion_tpu.types.actor import Actor
+from corrosion_tpu.types.change import ChangesetFull
 from corrosion_tpu.types.codec import (
+    chunked_change_v1,
     encode_uni_from_prefix,
     encode_uni_prefix,
 )
@@ -99,30 +101,39 @@ async def broadcast_loop(agent: Agent) -> None:
 
         now = time.monotonic()
         for item in batch:
-            cv = item.change
-            # encode-once: the body bytes were stamped at commit (local)
-            # or captured at decode (relay) — this wraps, not re-walks
-            prefix = encode_uni_prefix(cv, agent.cluster_id)
-            seq += 1
-            heapq.heappush(
-                pending,
-                _Pending(
-                    due=now,
-                    seq=seq,
-                    payload=encode_uni_from_prefix(
-                        prefix, cv.origin_ts, cv.traceparent
+            # r16 broadcast chunking: an oversize changeset (one that
+            # could never pass the token bucket and was previously
+            # DROPPED whole) is split into bucket-sized partials whose
+            # bodies splice the cached wire_cell bytes — receivers
+            # buffer the seq sub-ranges and apply when they close; the
+            # common small payload takes the unchanged single-frame path
+            for cv in _fit_to_bucket(item.change, bucket.capacity):
+                # encode-once: the body bytes were stamped at commit
+                # (local), captured at decode (relay), or spliced by the
+                # chunker — this wraps, never re-walks
+                prefix = encode_uni_prefix(cv, agent.cluster_id)
+                seq += 1
+                heapq.heappush(
+                    pending,
+                    _Pending(
+                        due=now,
+                        seq=seq,
+                        payload=encode_uni_from_prefix(
+                            prefix, cv.origin_ts, cv.traceparent
+                        ),
+                        prefix=prefix,
+                        origin=cv.actor_id.bytes16,
+                        send_count=0,
+                        # only the ORIGIN node's own fresh changes stamp
+                        # the commit→wire hop; relayed changes already
+                        # counted theirs at their origin
+                        origin_wall=(
+                            cv.origin_ts if item.is_local else None
+                        ),
+                        ext_origin_ts=cv.origin_ts,
+                        ext_traceparent=cv.traceparent,
                     ),
-                    prefix=prefix,
-                    origin=cv.actor_id.bytes16,
-                    send_count=0,
-                    # only the ORIGIN node's own fresh changes stamp the
-                    # commit→wire hop; relayed changes already counted
-                    # theirs at their origin
-                    origin_wall=(cv.origin_ts if item.is_local else None),
-                    ext_origin_ts=cv.origin_ts,
-                    ext_traceparent=cv.traceparent,
-                ),
-            )
+                )
 
         # transmit everything due
         max_tx = agent.membership.config.max_transmissions(
@@ -154,6 +165,36 @@ async def broadcast_loop(agent: Agent) -> None:
             del pending[perf.max_inflight_broadcasts :]
             heapq.heapify(pending)
             METRICS.counter("corro.broadcast.dropped").inc(dropped)
+
+
+def _fit_to_bucket(cv, capacity: float):
+    """Split a ChangeV1 whose body can never pass the egress token
+    bucket into partial-changeset chunks (spliced from cached cell
+    bytes, types/codec.py `chunked_change_v1`).  Anything that fits —
+    or is irreducible (not a multi-change full set) — passes through
+    unchanged and keeps the byte-identical r14 path."""
+    cs = cv.changeset
+    body_len = (
+        len(cv.wire_body)
+        if cv.wire_body is not None
+        else sum(
+            c.estimated_byte_size() for c in getattr(cs, "changes", ())
+        )
+    )
+    # ~14 bytes of uni header/cluster-id plus the envelope ext ride on
+    # top of the body; half-capacity chunks leave slack for both and
+    # for the estimator's undershoot
+    if body_len + 64 <= capacity or not isinstance(cs, ChangesetFull):
+        return (cv,)
+    if len(cs.changes) < 2:
+        return (cv,)  # irreducible: the oversized-drop counter handles it
+    chunks = chunked_change_v1(
+        cv.actor_id, cs.version, cs.changes, cs.last_seq, cs.ts,
+        origin_ts=cv.origin_ts, traceparent=cv.traceparent,
+        max_bytes=max(1, int(capacity) // 2), seq_range=cs.seqs,
+    )
+    METRICS.counter("corro.broadcast.chunked.total").inc(len(chunks))
+    return chunks
 
 
 async def _transmit(agent: Agent, bucket: TokenBucket, p: _Pending) -> bool:

@@ -50,8 +50,7 @@ from corrosion_tpu.store.bookkeeping import Bookie
 from corrosion_tpu.store.crdt import CrdtStore
 from corrosion_tpu.types.actor import Actor, ClusterId
 from corrosion_tpu.types.base import HLClock, Timestamp
-from corrosion_tpu.types.change import ChangeV1, ChangesetFull, chunk_changes
-from corrosion_tpu.types.codec import decode_uni_payload_ext, with_wire_body
+from corrosion_tpu.types.codec import chunked_change_v1, decode_uni_payload_ext
 from corrosion_tpu.types.rangeset import RangeSet
 
 
@@ -224,6 +223,7 @@ async def setup(
         store,
         config.db.subscriptions_path,
         batch_wait=config.pubsub.candidate_batch_wait,
+        cfg=config.subs,
     )
     agent.updates = UpdatesManager(store)
 
@@ -882,22 +882,16 @@ async def _make_broadcastable_changes_inner(
         # corro.e2e.* stage downstream measures against this instant
         origin_wall = _time.time()
         agent.notify_change_hooks(changes, origin_wall)
-        for chunk, seqs in chunk_changes(changes, last_seq):
-            # encode-once (r14): serialize the changeset body HERE, at
-            # commit — broadcast (and every re-transmission/relay) wraps
-            # the shared bytes instead of re-walking the changes
-            cv = with_wire_body(ChangeV1(
-                actor_id=agent.actor_id,
-                changeset=ChangesetFull(
-                    version=db_version,
-                    changes=tuple(chunk),
-                    seqs=seqs,
-                    last_seq=last_seq,
-                    ts=ts,
-                ),
-                origin_ts=origin_wall,
-                traceparent=traceparent,
-            ))
+        # encode-once, spliced (r16): each chunk's body is assembled
+        # from the wire_cell bytes finalize_group already stamped — one
+        # header/tail pack + a join per chunk, no per-value re-walk
+        # (byte-identity with the r14 with_wire_body path pinned in
+        # test_codec.py); broadcast and every re-transmission/relay
+        # wrap the shared bytes
+        for cv in chunked_change_v1(
+            agent.actor_id, db_version, changes, last_seq, ts,
+            origin_ts=origin_wall, traceparent=traceparent,
+        ):
             await agent.tx_bcast.send(BroadcastInput(change=cv, is_local=True))
     rows = sum(r for r in _int_results(results))
     return ExecResult(rows_affected=rows, results=results, version=db_version)

@@ -443,11 +443,22 @@ class ApiServer:
                 # knob the SLO plane named as the match-stage p50 floor
                 "candidate_batch_wait": agent.config.pubsub.candidate_batch_wait,
                 "count": len(self.subs.handles()) if self.subs else 0,
-                "streams": sum(
-                    h.subscriber_count for h in self.subs.handles()
-                )
-                if self.subs
-                else 0,
+                "streams": self.subs.stream_count() if self.subs else 0,
+                # r16 serving-plane asymptote census: admission ceiling,
+                # laggard sheds, dedupe pressure and the shared writer's
+                # coalescing behavior — the numbers that say whether the
+                # node is at its stream ceiling and who is paying for it
+                "max_streams": agent.config.subs.max_streams,
+                "admission_rejected": peek(
+                    "corro.subs.admission.rejected.total"
+                ),
+                "shed": peek("corro.subs.shed.total"),
+                "dedupe_hits": peek("corro.subs.dedupe.hits.total"),
+                "writer_writes": peek("corro.subs.writer.writes.total"),
+                "writer_coalesced_batches": peek(
+                    "corro.subs.writer.coalesced.batches.total"
+                ),
+                "writer_clogged": peek("corro.subs.writer.clogged"),
                 "router_tables": peek("corro.subs.router.tables"),
                 "router_changes": peek("corro.subs.router.changes.total"),
                 "router_matched": peek("corro.subs.router.matched.total"),

@@ -95,6 +95,18 @@ def ev_error(err: str) -> str:
     return json.dumps({"error": err}, separators=(",", ":"))
 
 
+def ev_lagging(lag_bytes: int, lag_batches: int) -> str:
+    """Typed terminal frame for a SHED laggard stream (r16 admission
+    control): the subscription itself is healthy — the client's socket
+    fell `lag_bytes`/`lag_batches` behind the live fan-out and the node
+    dropped the stream rather than stall its siblings.  Clients resume
+    from their last observed change id (client.py reconnects on it)."""
+    return json.dumps(
+        {"lagging": {"lag_bytes": lag_bytes, "lag_batches": lag_batches}},
+        separators=(",", ":"),
+    )
+
+
 def ev_notify(kind: str, pk_values: List[SqliteValue]) -> str:
     return json.dumps(
         {"notify": [kind, [dump_value(v) for v in pk_values]]},

@@ -264,6 +264,19 @@ class ClientError(Exception):
         self.body = body
 
 
+class SubShedError(Exception):
+    """The server SHED this stream as a laggard (`{"lagging": ...}`
+    terminal frame, r16 admission control): the subscription is healthy
+    but our socket fell behind the live fan-out.  `SubscriptionStream`
+    treats it as a retryable disconnect and resumes from the last
+    observed change id — the matcher's changes log replays what the
+    shed dropped."""
+
+    def __init__(self, lag: Any):
+        super().__init__(f"stream shed as laggard: {lag}")
+        self.lag = lag
+
+
 class SubscriptionStream:
     """Auto-resubscribing NDJSON event stream (client/src/sub.rs:328-388).
 
@@ -291,6 +304,17 @@ class SubscriptionStream:
                     retries = 0
                     yield ev
                 return  # server ended the stream cleanly
+            except SubShedError:
+                # shed as a laggard: resume from last_change_id — the
+                # server replays the gap from the matcher's changes log
+                # (a pruned-away id surfaces as the documented
+                # resubscribe-anew error).  Retry-capped like any other
+                # disconnect so a chronically slow consumer surfaces
+                # the error instead of thrashing subscribe/shed cycles.
+                retries += 1
+                if self.query_id is None or retries > self._max_retries:
+                    raise
+                await asyncio.sleep(min(2.0, 0.1 * 2**retries))
             except (aiohttp.ClientError, asyncio.TimeoutError, ClientError,
                     StreamReset, ConnectionError):
                 retries += 1
@@ -325,6 +349,13 @@ class SubscriptionStream:
             qid = resp.headers.get("corro-query-id")
             if qid:
                 self.query_id = qid
+            # a server ending the stream ALWAYS writes a terminal frame
+            # first ({"error": ...} or {"lagging": ...}); a bare EOF
+            # means the transport died mid-stream (or a shed laggard's
+            # terminal frame could not be delivered through its clogged
+            # socket) — treated as a retryable disconnect below, the
+            # reference client's hangup-reconnect behavior (sub.rs)
+            terminal = False
             async for line in _lines(resp):
                 if self.raw:
                     # change lines end `...,<change_id>]}`: track the id
@@ -336,6 +367,10 @@ class SubscriptionStream:
                             )
                         except (ValueError, IndexError):
                             pass
+                    elif line.startswith('{"lagging":'):
+                        raise SubShedError(line)
+                    elif line.startswith('{"error":'):
+                        terminal = True
                     yield line
                     continue
                 ev = json.loads(line)
@@ -343,7 +378,15 @@ class SubscriptionStream:
                     self.last_change_id = ev["change"][3]
                 elif "eoq" in ev and ev["eoq"].get("change_id") is not None:
                     self.last_change_id = ev["eoq"]["change_id"]
+                elif "lagging" in ev:
+                    raise SubShedError(ev["lagging"])
+                elif "error" in ev:
+                    terminal = True
                 yield ev
+            if not terminal and self.query_id is not None:
+                raise ConnectionResetError(
+                    "subscription stream ended without a terminal frame"
+                )
 
 
 async def _lines(resp) -> AsyncIterator[str]:

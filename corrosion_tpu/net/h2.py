@@ -143,6 +143,10 @@ class H2Connection:
         self.window_evt = asyncio.Event()
         self.peer_max_frame = MAX_FRAME_SIZE_DEFAULT
         self.peer_initial_window = DEFAULT_WINDOW
+        # what WE advertise as the per-stream initial window (a serving
+        # bench draining 100k streams raises it so the server's fan-out
+        # writer isn't throttled to 64 KiB per round trip)
+        self.recv_window = DEFAULT_WINDOW
         self.recv_credit = 0  # connection-level bytes to acknowledge
         self._write_lock = asyncio.Lock()
         self._hpack_lock = asyncio.Lock()
@@ -168,7 +172,7 @@ class H2Connection:
             payload = struct.pack(
                 ">HIHI",
                 SETTINGS_MAX_CONCURRENT_STREAMS, 256,
-                SETTINGS_INITIAL_WINDOW_SIZE, DEFAULT_WINDOW,
+                SETTINGS_INITIAL_WINDOW_SIZE, self.recv_window,
             )
         await self._send(_frame(SETTINGS, 0, 0, payload))
 
@@ -231,6 +235,37 @@ class H2Connection:
             )
             if last:
                 return
+
+    def send_data_nowait(self, sid: int, data: bytes) -> int:
+        """Best-effort SYNCHRONOUS data write for the subscription
+        fan-out plane (r16): consume whatever the connection + stream
+        send windows currently allow, frame it, and append it to the
+        transport WITHOUT awaiting drain or WINDOW_UPDATEs.  Returns
+        the number of payload bytes accepted (0 when a window is
+        closed) — the caller keeps the remainder and retries when
+        credit returns.  Never sends END_STREAM.  Frame-atomic
+        interleaving with `_send` is safe: every writer call appends
+        whole frames."""
+        stream = self.streams.get(sid)
+        if self.closed:
+            raise StreamReset("connection closed")
+        if stream is not None and stream.reset_code is not None:
+            raise StreamReset(f"stream {sid} reset: {stream.reset_code}")
+        view = memoryview(data)
+        sent = 0
+        while sent < len(data):
+            avail = min(len(data) - sent, self.send_window, self.peer_max_frame)
+            if stream is not None:
+                avail = min(avail, stream.send_window)
+            if avail <= 0:
+                break
+            chunk = bytes(view[sent : sent + avail])
+            self.send_window -= avail
+            if stream is not None:
+                stream.send_window -= avail
+            self.writer.write(_frame(DATA, 0, sid, chunk))
+            sent += avail
+        return sent
 
     async def send_rst(self, sid: int, code: int) -> None:
         try:
@@ -695,11 +730,21 @@ class H2Client:
     def __init__(
         self, host: str, port: int, keepalive_s: float = 10.0,
         connect_timeout: float = 3.0,
+        recv_window: int = DEFAULT_WINDOW,
+        conn_recv_window: int = DEFAULT_WINDOW,
     ):
         self.host = host
         self.port = port
         self.keepalive_s = keepalive_s
         self.connect_timeout = connect_timeout
+        # receive-window sizing (r16): `recv_window` is advertised as
+        # the per-stream initial window, `conn_recv_window` grows the
+        # connection window past the RFC-fixed 65535 start via an
+        # immediate WINDOW_UPDATE — a client multiplexing thousands of
+        # live subscription streams over one connection needs both or
+        # the server stalls on 64 KiB of unacked data per round trip
+        self.recv_window = max(DEFAULT_WINDOW, recv_window)
+        self.conn_recv_window = max(DEFAULT_WINDOW, conn_recv_window)
         self._conn: Optional[H2Connection] = None
         self._next_sid = 1
         self._reader_task: Optional[asyncio.Task] = None
@@ -715,8 +760,14 @@ class H2Client:
                 self.connect_timeout,
             )
             conn = H2Connection(reader, writer, is_server=False)
+            conn.recv_window = self.recv_window
             writer.write(PREFACE)
             await conn.send_settings(initial=True)
+            extra = self.conn_recv_window - DEFAULT_WINDOW
+            if extra > 0:
+                await conn._send(
+                    _frame(WINDOW_UPDATE, 0, 0, struct.pack(">I", extra))
+                )
             self._conn = conn
             self._next_sid = 1
             self._reader_task = asyncio.ensure_future(self._read_loop(conn))

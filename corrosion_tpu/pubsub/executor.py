@@ -42,10 +42,19 @@ class DiffExecutor:
     not correctness)."""
 
     def __init__(self, max_workers: int = DEFAULT_DIFF_WORKERS):
+        # sized by [subs] diff_workers since r16 (SubsManager passes it)
         self.max_workers = max_workers
         self._pool: Optional[ThreadPoolExecutor] = None
         self._lock = threading.Lock()
         self._depth = 0
+        # instrument handles resolved once: at 10k-100k streams the
+        # per-diff registry lookups (name+label hashing) were three
+        # avoidable dict probes per submission on the event loop
+        self._g_depth = METRICS.gauge("corro.subs.executor.depth")
+        self._c_submitted = METRICS.counter(
+            "corro.subs.executor.submitted.total"
+        )
+        self._h_wait = METRICS.histogram("corro.subs.executor.wait.seconds")
 
     def _ensure(self) -> ThreadPoolExecutor:
         with self._lock:
@@ -68,15 +77,13 @@ class DiffExecutor:
         with self._lock:
             self._depth += 1
             depth = self._depth
-        METRICS.gauge("corro.subs.executor.depth").set(depth)
-        METRICS.counter("corro.subs.executor.submitted.total").inc()
+        self._g_depth.set(depth)
+        self._c_submitted.inc()
 
         def job():
             # time spent queued behind other matchers' diffs — the
             # backpressure signal a sub-count overload raises first
-            METRICS.histogram("corro.subs.executor.wait.seconds").observe(
-                time.monotonic() - submitted
-            )
+            self._h_wait.observe(time.monotonic() - submitted)
             return fn(*args)
 
         try:
@@ -85,7 +92,7 @@ class DiffExecutor:
             with self._lock:
                 self._depth -= 1
                 depth = self._depth
-            METRICS.gauge("corro.subs.executor.depth").set(depth)
+            self._g_depth.set(depth)
 
     def shutdown(self) -> None:
         """Stop the pool (running jobs finish; a later `run` restarts)."""

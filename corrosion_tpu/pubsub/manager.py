@@ -19,6 +19,17 @@ routes to a matcher iff the matcher's parsed column deps contain its
 (table, cid) — or it is a sentinel (row create/delete), which reaches
 every matcher on the table — exactly `Matcher.filter_candidates`'s
 predicate, amortized across matchers.
+
+Serving-plane lifecycle (r16): matchers are REFCOUNTED.  Subscribing
+streams dedupe onto one matcher per distinct query — keyed by the exact
+SQL hash (the wire-parity `corro-query-hash`) AND by a canonical
+token-normalized form, so whitespace/comment variants of the same query
+share a matcher — and the last stream's detach arms a linger timer
+(`[subs] matcher_linger_secs`); a reconnect inside the window re-uses
+the warm matcher + changes log, after it the matcher and its sub db are
+reaped.  `admission_reject` bounds total live streams per node
+(`[subs] max_streams`), and `fanout` is the shared coalescing writer
+every HTTP stream sink is served by (pubsub/fanout.py).
 """
 
 from __future__ import annotations
@@ -32,6 +43,7 @@ from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from corrosion_tpu.pubsub.executor import DiffExecutor
+from corrosion_tpu.pubsub.fanout import FanoutWriter
 from corrosion_tpu.pubsub.matcher import (
     Matcher,
     MatcherError,
@@ -46,6 +58,20 @@ from corrosion_tpu.types.change import SENTINEL, Change
 Router = Dict[str, Dict[str, Tuple[MatcherHandle, ...]]]
 
 
+def canonical_sql(sql: str) -> str:
+    """Token-normalized query text: whitespace and comments collapse so
+    textual variants of one query hash alike.  Keywords keep their case
+    (identifier semantics stay untouched); unparseable text falls back
+    to a stripped literal (it will fail parse_select downstream with
+    its own error)."""
+    from corrosion_tpu.pubsub.parse import _join_tokens, tokenize
+
+    try:
+        return _join_tokens(tokenize(sql))
+    except ParseError:
+        return sql.strip()
+
+
 class SubsManager:
     """Registry of running matchers, keyed by id and by SQL hash."""
 
@@ -54,20 +80,27 @@ class SubsManager:
         store,
         subs_path: Optional[str] = None,
         batch_wait: Optional[float] = None,
+        cfg=None,
     ):
+        from corrosion_tpu.runtime.config import SubsConfig
+
         self.store = store
         self.subs_path = subs_path
         # matcher candidate-batching window ([pubsub] candidate_batch_wait,
         # r12); None keeps the per-matcher pubsub.rs-parity default
         self.batch_wait = batch_wait
+        # [subs] serving-plane knobs (admission, lag bounds, linger)
+        self.cfg = cfg if cfg is not None else SubsConfig()
         self._by_id: Dict[str, MatcherHandle] = {}
-        self._by_hash: Dict[str, str] = {}  # sql hash -> id
+        self._by_hash: Dict[str, str] = {}  # exact sql hash -> id
+        self._by_canon: Dict[str, str] = {}  # canonical sql hash -> id
         self._lock = asyncio.Lock()
         # immutable snapshot, swapped whole on (un)subscribe: worker
         # threads read it lock-free mid-rebuild and see old or new,
         # never a half-built index
         self._router: Router = {}
-        self.executor = DiffExecutor()
+        self.executor = DiffExecutor(self.cfg.diff_workers)
+        self.fanout = FanoutWriter(self.cfg.writer_tick_secs)
 
     def _rebuild_router(self) -> None:
         idx: Dict[str, Dict[str, Set[MatcherHandle]]] = {}
@@ -89,19 +122,91 @@ class SubsManager:
 
     def get_by_sql(self, sql: str) -> Optional[MatcherHandle]:
         sid = self._by_hash.get(sql_hash(sql))
+        if sid is None:
+            sid = self._by_canon.get(sql_hash(canonical_sql(sql)))
         return self._by_id.get(sid) if sid else None
 
     def handles(self) -> List[MatcherHandle]:
         return list(self._by_id.values())
 
-    async def get_or_insert(self, sql: str) -> Tuple[MatcherHandle, bool]:
+    # -- serving-plane census / admission (r16) ----------------------------
+
+    def stream_count(self) -> int:
+        """Live streams across every matcher (HTTP sinks + in-process
+        queue subscribers).  O(matchers) — matchers are the deduped
+        axis, k distinct queries, not the 100k stream axis."""
+        return sum(h.subscriber_count for h in self._by_id.values())
+
+    def admission_reject(self) -> Optional[str]:
+        """None = admit; otherwise the typed rejection reason.  Counted
+        so a fleet hitting its admission ceiling is visible."""
+        mx = self.cfg.max_streams
+        if mx and self.stream_count() >= mx:
+            METRICS.counter("corro.subs.admission.rejected.total").inc()
+            return (
+                f"stream limit reached ({mx} live streams;"
+                " [subs] max_streams)"
+            )
+        return None
+
+    def make_sink(self):
+        """A base StreamSink bounded by this manager's lag config —
+        HTTP flavors subclass in api/pubsub_http.py; tests attach these
+        directly."""
+        from corrosion_tpu.pubsub.fanout import StreamSink
+
+        return StreamSink(self.cfg.max_lag_bytes, self.cfg.max_lag_batches)
+
+    # -- refcounted matcher lifecycle (r16) --------------------------------
+
+    def _note_active(self, handle: MatcherHandle) -> None:
+        t = getattr(handle, "_linger_timer", None)
+        if t is not None:
+            t.cancel()
+            handle._linger_timer = None
+        METRICS.gauge("corro.subs.streams").set(self.stream_count())
+
+    def _note_idle(self, handle: MatcherHandle) -> None:
+        """Last ref detached: arm the linger reaper.  A reconnect (or a
+        new subscriber deduping onto this matcher) inside the window
+        cancels it and reuses the warm matcher + changes log."""
+        self._note_active(handle)  # reset any armed timer first
+        loop = asyncio.get_event_loop()
+        handle._linger_timer = loop.call_later(
+            max(0.0, self.cfg.matcher_linger_secs),
+            lambda: asyncio.ensure_future(self._reap(handle)),
+        )
+
+    async def _reap(self, handle: MatcherHandle) -> None:
+        async with self._lock:
+            if (
+                self._by_id.get(handle.id) is not handle
+                or handle.active_refs > 0
+            ):
+                return
+            await self._remove_locked(handle.id, purge=True)
+
+    def _adopt(self, handle: MatcherHandle) -> None:
+        handle.on_active = self._note_active
+        handle.on_idle = self._note_idle
+
+    async def get_or_insert(
+        self, sql: str, lease: bool = False
+    ) -> Tuple[MatcherHandle, bool]:
         """Return (handle, created). When created, the initial query has
         materialized into the sub db; subscribers read rows through
-        `handle.matcher.snapshot()` (attach-then-snapshot protocol)."""
+        `handle.matcher.snapshot()` (attach-then-snapshot protocol).
+        `lease=True` pins the handle against the linger reaper until the
+        caller attaches (release with `handle.release_lease()`)."""
         async with self._lock:
             existing = self.get_by_sql(sql)
             if existing is not None:
                 if existing.error is None:
+                    METRICS.counter("corro.subs.dedupe.hits.total").inc()
+                    if lease:
+                        existing.lease()
+                    else:
+                        self._note_active(existing)
                     return existing, False
                 # dead matcher: tear it down fully before replacing
                 await self._remove_locked(existing.id, purge=True)
@@ -122,13 +227,21 @@ class SubsManager:
                 raise ParseError(str(e)) from e
             handle = MatcherHandle(
                 matcher, loop, executor=self.executor,
-                batch_wait=self.batch_wait,
+                batch_wait=self.batch_wait, fanout=self.fanout,
             )
+            self._adopt(handle)
             handle.start()
             self._by_id[sub_id] = handle
             self._by_hash[sql_hash(sql)] = sub_id
+            self._by_canon[sql_hash(canonical_sql(sql))] = sub_id
             self._rebuild_router()
             METRICS.gauge("corro.subs.count").set(len(self._by_id))
+            if lease:
+                handle.lease()
+            else:
+                # an unleased, never-attached matcher must not live
+                # forever: the linger clock starts at creation
+                self._note_idle(handle)
             return handle, True
 
     async def restore(self) -> int:
@@ -160,12 +273,17 @@ class SubsManager:
                 continue
             handle = MatcherHandle(
                 matcher, asyncio.get_running_loop(), executor=self.executor,
-                batch_wait=self.batch_wait,
+                batch_wait=self.batch_wait, fanout=self.fanout,
             )
+            self._adopt(handle)
             handle.start()
             self._by_id[d.name] = handle
             self._by_hash[sql_hash(sql)] = d.name
+            self._by_canon[sql_hash(canonical_sql(sql))] = d.name
             await asyncio.to_thread(self._resync, handle)
+            # restored matchers start with zero attached streams: the
+            # linger clock decides whether anyone still wants them
+            self._note_idle(handle)
             n += 1
         self._rebuild_router()
         METRICS.gauge("corro.subs.count").set(len(self._by_id))
@@ -259,7 +377,15 @@ class SubsManager:
         handle = self._by_id.pop(sub_id, None)
         if handle is None:
             return
+        t = getattr(handle, "_linger_timer", None)
+        if t is not None:
+            t.cancel()
+            handle._linger_timer = None
+        handle.on_active = handle.on_idle = None
         self._by_hash.pop(sql_hash(handle.sql), None)
+        canon = sql_hash(canonical_sql(handle.sql))
+        if self._by_canon.get(canon) == sub_id:
+            self._by_canon.pop(canon, None)
         self._rebuild_router()
         await handle.stop()
         if purge:
@@ -273,4 +399,5 @@ class SubsManager:
     async def stop_all(self) -> None:
         for sid in list(self._by_id):
             await self.remove(sid)
+        self.fanout.stop()
         self.executor.shutdown()

@@ -848,6 +848,7 @@ class MatcherHandle:
         loop: asyncio.AbstractEventLoop,
         executor=None,
         batch_wait: Optional[float] = None,
+        fanout=None,
     ):
         self.matcher = matcher
         self.loop = loop
@@ -856,6 +857,10 @@ class MatcherHandle:
         # shared bounded DiffExecutor (pubsub/executor.py) when owned by
         # a SubsManager; None falls back to asyncio.to_thread
         self._executor = executor
+        # shared coalescing FanoutWriter (pubsub/fanout.py, r16): HTTP
+        # stream sinks are served by its single writer task; the queue
+        # subscriber path below stays for in-process consumers
+        self._fanout = fanout
         # candidate-batching window: config [pubsub] candidate_batch_wait
         # (r12 — the knob the r11 SLO plane named as the ~600 ms p50
         # `match` culprit); None keeps the pubsub.rs-parity default
@@ -864,12 +869,21 @@ class MatcherHandle:
         )
         self._queue: asyncio.Queue = asyncio.Queue()
         self._subscribers: List[asyncio.Queue] = []
+        self._sinks: tuple = ()  # StreamSinks; copy-on-write snapshot
         self._sub_lock = threading.Lock()
         self._task: Optional[asyncio.Task] = None
         self._done = asyncio.Event()
         self.error: Optional[str] = None
         self.created_at = time.time()
         self.processed = 0
+        # r16 refcounted lifecycle: `leases` bridges the gap between a
+        # handler obtaining the handle and its stream attaching, so the
+        # manager's linger reaper can't tear the matcher down in
+        # between; on_active/on_idle are set by the owning SubsManager
+        # (loop-thread callbacks)
+        self.leases = 0
+        self.on_active = None
+        self.on_idle = None
 
     @property
     def hash(self) -> str:
@@ -999,15 +1013,23 @@ class MatcherHandle:
             e2e_observe("match", batch.event_wall - stamp.applied)
         with self._sub_lock:
             subs = list(self._subscribers)
+            sinks = self._sinks
         for q in subs:
             q.put_nowait(batch)
+        if sinks and self._fanout is not None:
+            # ONE submit per diff batch regardless of stream count: the
+            # shared writer task walks the sinks (pubsub/fanout.py)
+            self._fanout.submit(sinks, batch)
 
     def _fan_out_terminal(self, sentinel) -> None:
         """End-of-stream: a bare None (clean stop) or SubDead frame."""
         with self._sub_lock:
             subs = list(self._subscribers)
+            sinks = self._sinks
         for q in subs:
             q.put_nowait(sentinel)
+        if sinks and self._fanout is not None:
+            self._fanout.submit(sinks, sentinel)
 
     def attach(self) -> asyncio.Queue:
         """Subscribe to live events.  Queue items are LISTS of SubEvent
@@ -1016,17 +1038,57 @@ class MatcherHandle:
         q: asyncio.Queue = asyncio.Queue()
         with self._sub_lock:
             self._subscribers.append(q)
+        if self.on_active is not None:
+            self.on_active(self)
         return q
 
     def detach(self, q: asyncio.Queue) -> None:
         with self._sub_lock:
             with contextlib.suppress(ValueError):
                 self._subscribers.remove(q)
+        self._maybe_idle()
+
+    def attach_sink(self, sink) -> None:
+        """Register a fan-out StreamSink (HTTP serving plane, r16).
+        Same attach-before-snapshot protocol as `attach`: the sink
+        starts in HOLD mode and is released after the snapshot/replay
+        phase established its replay boundary."""
+        with self._sub_lock:
+            self._sinks = self._sinks + (sink,)
+        if self.on_active is not None:
+            self.on_active(self)
+
+    def detach_sink(self, sink) -> None:
+        sink.mark_closed()
+        with self._sub_lock:
+            self._sinks = tuple(
+                s for s in self._sinks if s is not sink
+            )
+        self._maybe_idle()
+
+    def lease(self) -> None:
+        """Pin the handle between lookup and attach (loop thread)."""
+        self.leases += 1
+        if self.on_active is not None:
+            self.on_active(self)
+
+    def release_lease(self) -> None:
+        self.leases = max(0, self.leases - 1)
+        self._maybe_idle()
+
+    def _maybe_idle(self) -> None:
+        if self.active_refs == 0 and self.on_idle is not None:
+            self.on_idle(self)
+
+    @property
+    def active_refs(self) -> int:
+        with self._sub_lock:
+            return len(self._subscribers) + len(self._sinks) + self.leases
 
     @property
     def subscriber_count(self) -> int:
         with self._sub_lock:
-            return len(self._subscribers)
+            return len(self._subscribers) + len(self._sinks)
 
     async def stop(self) -> None:
         self._queue.put_nowait(None)

@@ -174,6 +174,37 @@ class PubsubConfig:
 
 
 @dataclass
+class SubsConfig:
+    """[subs] — serving-plane admission control + stream backpressure
+    (r16).  One node is expected to host 10k–100k concurrent
+    subscription streams: `max_streams` bounds how many the HTTP plane
+    admits (excess subscribes get a typed 503, never a half-served
+    stream), and the per-stream lag bounds govern the coalesced fan-out
+    writer — a stream whose socket stops draining accumulates pending
+    batch payloads until `max_lag_bytes`/`max_lag_batches`, then is
+    SHED with a terminal `{"lagging": ...}` frame (Prime CCL
+    discipline: a slow consumer degrades, it never stalls the
+    DiffExecutor or its sibling streams).  `matcher_linger_secs` is the
+    teardown grace after a deduped matcher's LAST stream detaches: a
+    reconnect inside the window re-uses the warm matcher + changes log
+    (the client resumes by change id), after it the sub db is reaped.
+    `writer_tick_secs` paces retry flushes of clogged sinks;
+    `diff_workers` sizes the shared DiffExecutor pool."""
+
+    max_streams: int = 100_000
+    max_lag_bytes: int = 4 * 1024 * 1024
+    max_lag_batches: int = 1024
+    matcher_linger_secs: float = 30.0
+    writer_tick_secs: float = 0.05
+    diff_workers: int = 4
+    # "writer" = the r16 shared coalescing fan-out writer (sinks, lag
+    # shedding); "queue" = the r10 per-stream drain-loop reference path
+    # (one task + one queue per stream, no shedding) — the bench's A/B
+    # axis and the rollback lever (env: CORRO_SUBS__FANOUT=queue)
+    fanout: str = "writer"
+
+
+@dataclass
 class ClusterObsConfig:
     """[cluster] — the r12 cluster observatory (agent/observatory.py).
     Each node builds a telemetry digest every `digest_interval_secs`
@@ -248,6 +279,7 @@ class Config:
     log: LogConfig = field(default_factory=LogConfig)
     slo: SloConfig = field(default_factory=SloConfig)
     pubsub: PubsubConfig = field(default_factory=PubsubConfig)
+    subs: SubsConfig = field(default_factory=SubsConfig)
     cluster: ClusterObsConfig = field(default_factory=ClusterObsConfig)
 
 

@@ -140,6 +140,7 @@ def chunk_changes(
     last_seq: int,
     max_bytes: int = MAX_CHANGES_BYTE_SIZE,
     max_bytes_fn=None,
+    range_start: int = 0,
 ) -> Iterator[Tuple[List[Change], Tuple[int, int]]]:
     """Group ordered same-version changes into chunks of ≤ max_bytes,
     preserving contiguous seq coverage across gaps (change.rs:65-177):
@@ -151,11 +152,16 @@ def chunk_changes(
     peer/mod.rs:808-869) shrinks or grows the target between chunks of
     the same version.
 
+    `range_start` (r16): where the FIRST chunk's claimed seq coverage
+    begins — 0 for a complete version (the default), or the source
+    changeset's own `seqs[0]` when re-chunking an already-partial
+    changeset (broadcast oversize splitting): a partial must never claim
+    coverage of seqs it does not carry.
+
     Yields (chunk, (seq_start, seq_end)).
     """
     buf: List[Change] = []
     size = 0
-    range_start = 0
     last_emitted_end: Optional[int] = None
     it = iter(changes)
     for ch in it:
@@ -173,4 +179,4 @@ def chunk_changes(
         yield [], (range_start, last_seq)
     elif last_emitted_end is None:
         # no changes at all: single empty full range
-        yield [], (0, last_seq)
+        yield [], (range_start, last_seq)

@@ -417,6 +417,84 @@ def with_wire_body(cv: ChangeV1) -> ChangeV1:
     return replace(cv, wire_body=encode_change_v1_body(cv))
 
 
+# r16 write-path round-3 opener: chunked uni bodies are SPLICED from
+# cached per-cell bytes, never re-walked.  A ChangesetFull body is
+#   actor16 · u8(1) · u64(version) · u32(n) · cells… ·
+#   u64 seqs0 · u64 seqs1 · u64 last_seq · u64 ts
+# so given each change's `wire_cell` (stamped by finalize_group at local
+# commit, or built here once for decoded relays) every chunk body is a
+# header pack + a join of cached cells + a tail pack — byte-identical to
+# `encode_change_v1_body` over the equivalent ChangesetFull (pinned in
+# test_codec.py).
+
+_CHUNK_HEAD = struct.Struct("<BQI")
+_CHUNK_TAIL = struct.Struct("<QQQQ")
+
+
+def _cell_bytes(c: Change) -> bytes:
+    cell = c.wire_cell
+    if cell is None:
+        w = Writer()
+        write_change_fields(
+            w, c.table, c.pk, c.cid, c.val, c.col_version, c.db_version,
+            c.seq, c.site_id, c.cl,
+        )
+        cell = w.bytes()
+        # backfill the cache (compare=False field on a frozen
+        # dataclass): a relayed change re-chunked once never rebuilds
+        # its cell for later transmissions
+        object.__setattr__(c, "wire_cell", cell)
+    return cell
+
+
+def chunked_change_v1(
+    actor_id: ActorId,
+    version: int,
+    changes,
+    last_seq: int,
+    ts,
+    origin_ts: Optional[float] = None,
+    traceparent: Optional[str] = None,
+    max_bytes: int = 8 * 1024,  # MAX_CHANGES_BYTE_SIZE (change.rs:179)
+    seq_range: Optional[Tuple[int, int]] = None,
+) -> List[ChangeV1]:
+    """Split one version's ordered changes into broadcast-sized
+    ChangeV1 chunks, each carrying its spliced `wire_body`.  Grouping is
+    `chunk_changes` verbatim (same estimator, same seq-coverage rules),
+    so receivers buffer the partials and apply when the range closes.
+    `seq_range` is the SOURCE changeset's claimed coverage — pass it
+    when re-chunking an already-partial changeset so no chunk claims
+    seqs it does not carry; default (0, last_seq) = a complete local
+    commit."""
+    from corrosion_tpu.types.change import ChangesetFull, chunk_changes
+
+    lo, hi = seq_range if seq_range is not None else (0, last_seq)
+    actor16 = actor_id.bytes16
+    out: List[ChangeV1] = []
+    for chunk, seqs in chunk_changes(
+        changes, hi, max_bytes=max_bytes, range_start=lo
+    ):
+        parts = [actor16, _CHUNK_HEAD.pack(1, version, len(chunk))]
+        parts.extend(_cell_bytes(c) for c in chunk)
+        parts.append(_CHUNK_TAIL.pack(seqs[0], seqs[1], last_seq, ts.ntp64))
+        out.append(
+            ChangeV1(
+                actor_id=actor_id,
+                changeset=ChangesetFull(
+                    version=version,
+                    changes=tuple(chunk),
+                    seqs=seqs,
+                    last_seq=last_seq,
+                    ts=ts,
+                ),
+                origin_ts=origin_ts,
+                traceparent=traceparent,
+                wire_body=b"".join(parts),
+            )
+        )
+    return out
+
+
 def _write_body(w: Writer, cv: ChangeV1) -> None:
     if cv.wire_body is not None:
         from corrosion_tpu.runtime.metrics import METRICS

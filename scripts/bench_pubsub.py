@@ -25,6 +25,27 @@ Usage:
       subs axis  5k × {1, 16, 128} subs   (sub-count scaling)
   --tag suffixes every rung name (e.g. `-pre`/`-post` for an A/B banked
   into the same file).
+
+r16 stream-count ladder (banked into SUBS_SCALE.json):
+  python scripts/bench_pubsub.py --streams N [--queries K] [--rows R]
+      one rung: N concurrent NDJSON streams over K distinct queries
+      (dedupe ratio N/K), events counted to completion on every stream,
+      p99 deliver latency read from the server's corro.e2e.deliver
+      histogram — the serving-plane headline.
+  python scripts/bench_pubsub.py --scale [--tag T]
+      the SUBS_SCALE ladder: 1k/10k/100k streams × shared(k=10) plus a
+      1k distinct-queries rung (the matcher-count axis; capped at 1k —
+      every distinct matcher is its own sqlite db + connection, and 10k
+      of those would blow the container's fd budget: the cap is logged
+      in the record, not silent).  The 100k rung runs under admission
+      control and probes one over-limit subscribe for the typed 503.
+  python scripts/bench_pubsub.py --scale --ab [--tag T]
+      A/B: every rung ≤10k runs twice ADJACENT — fanout="queue" (the
+      r10 per-stream drain loops, tag -pre) then fanout="writer" (the
+      r16 coalesced writer, tag -post) — same-host noise discipline as
+      bench_ingest; the 100k rung runs writer-only (100k drain-loop
+      tasks is the pathology the round removes, not a baseline worth
+      hours of wall).
 """
 
 from __future__ import annotations
@@ -53,6 +74,7 @@ _MEASURED_FILES = (
     "corrosion_tpu/pubsub/matcher.py",
     "corrosion_tpu/pubsub/manager.py",
     "corrosion_tpu/pubsub/executor.py",
+    "corrosion_tpu/pubsub/fanout.py",
     "corrosion_tpu/api/pubsub_http.py",
     "scripts/bench_pubsub.py",
 )
@@ -159,6 +181,236 @@ async def main(
         await shutdown(agent)
 
 
+# -- r16 stream-count ladder ------------------------------------------------
+
+
+def _reg_peek(snap, name, labels=None):
+    total = 0.0
+    for _k, sname, slabels, value in snap:
+        if sname == name and (labels is None or slabels == labels):
+            total += value
+    return total
+
+
+async def streams_rung(
+    n_streams: int,
+    n_queries: int,
+    n_rows: int,
+    tag: str = "",
+    distinct: bool = False,
+    fanout: str = "writer",
+) -> dict:
+    """One SUBS_SCALE rung: N live NDJSON streams over K distinct
+    queries on one node, all events delivered to every stream, raw
+    h2 clients with widened receive windows so flow control measures
+    the SERVER's fan-out path, not the harness's 64 KiB default."""
+    import math
+
+    from corrosion_tpu.net.h2 import H2Client
+    from corrosion_tpu.runtime.latency import snapshot_stages
+    from corrosion_tpu.runtime.metrics import METRICS
+
+    if distinct:
+        n_queries = n_streams
+    net = MemNetwork(seed=9)
+    agent, api, client = await boot_with_api(net, "agent-subs-scale")
+    agent.config.subs.fanout = fanout
+    agent.config.subs.max_streams = max(n_streams, 1)
+    host, port = api.addrs[0].rsplit(":", 1)
+    # ~250 streams per multiplexed conn (the server advertises h2
+    # MAX_CONCURRENT_STREAMS=256); big windows so 100k streams aren't
+    # throttled to 64 KiB per round trip
+    n_conns = max(1, math.ceil(n_streams / 250))
+    h2s = [
+        H2Client(
+            host, int(port),
+            recv_window=1 << 20, conn_recv_window=64 << 20,
+        )
+        for _ in range(n_conns)
+    ]
+    queries = [
+        f"SELECT id, text FROM tests WHERE id >= -{q + 1}"
+        for q in range(n_queries)
+    ]
+    want = n_rows + 2  # columns + eoq + n_rows change lines
+    counts = [0] * n_streams
+    done_evt = asyncio.Event()
+    remaining = [n_streams]
+
+    async def consume(resp, k: int) -> None:
+        async for chunk in resp.body():
+            counts[k] += chunk.count(b"\n")
+            if counts[k] >= want:
+                break
+        remaining[0] -= 1
+        if remaining[0] == 0:
+            done_evt.set()
+
+    async def subscribe_one(k: int):
+        body = json.dumps(queries[k % n_queries]).encode()
+        resp = await h2s[k % n_conns].request(
+            "POST", "/v1/subscriptions?skip_rows=true",
+            headers={"content-type": "application/json"}, body=body,
+        )
+        assert resp.status == 200, (k, resp.status, await resp.read())
+        return asyncio.ensure_future(consume(resp, k))
+
+    t_setup = time.monotonic()
+    tasks = []
+    # bounded-concurrency establishment: 256 subscribes in flight
+    for base in range(0, n_streams, 256):
+        tasks.extend(
+            await asyncio.gather(
+                *(
+                    subscribe_one(k)
+                    for k in range(base, min(base + 256, n_streams))
+                )
+            )
+        )
+        if base % 10240 == 0 and base:
+            print(f"  ... {base} streams attached", flush=True)
+    setup_wall = time.monotonic() - t_setup
+    matchers = len(api.subs.handles())
+
+    # admission probe: one stream past the ceiling must get a typed 503
+    probe = await h2s[0].request(
+        "POST", "/v1/subscriptions?skip_rows=true",
+        headers={"content-type": "application/json"},
+        body=json.dumps(queries[0]).encode(),
+    )
+    probe_body = await probe.read()
+    admission_rejected = (
+        probe.status == 503 and b"subs_admission" in probe_body
+    )
+
+    pre = snapshot_stages()
+    snap0 = METRICS.snapshot()
+    t0 = time.monotonic()
+    batch = 50
+    for start in range(0, n_rows, batch):
+        stmts = [
+            [
+                "INSERT INTO tests (id, text) VALUES (?, ?) "
+                "ON CONFLICT (id) DO UPDATE SET text = excluded.text",
+                [i, f"v{i}"],
+            ]
+            for i in range(start, min(start + batch, n_rows))
+        ]
+        await client.execute(stmts)
+    write_wall = time.monotonic() - t0
+    try:
+        await asyncio.wait_for(done_evt.wait(), 900)
+    except asyncio.TimeoutError:
+        pass  # recorded honestly below via events_delivered
+    total_wall = time.monotonic() - t0
+
+    deliver = snapshot_stages()["deliver"].diff(pre["deliver"])
+    snap1 = METRICS.snapshot()
+
+    def delta(name):
+        return _reg_peek(snap1, name) - _reg_peek(snap0, name)
+
+    delivered = sum(min(max(0, c - 2), n_rows) for c in counts)
+    expected = n_streams * n_rows
+    matcher_s = delta("corro.subs.process.time.seconds_sum")
+    writer_s = delta("corro.subs.writer.round.seconds_sum")
+    rec = {
+        "rung": f"subs-{n_streams}x{n_queries}{'d' if distinct else ''}"
+        + (f"-{tag}" if tag else ""),
+        "fanout": fanout,
+        "streams": n_streams,
+        "queries": n_queries,
+        "matchers": matchers,
+        "dedupe_ratio": round(n_streams / max(1, matchers), 1),
+        "distinct_cap_note": (
+            "distinct axis capped at 1k streams: one sqlite db+conn per"
+            " matcher; 10k+ would exhaust the 20k-fd container budget"
+            if distinct
+            else ""
+        ),
+        "n_rows": n_rows,
+        "events_expected": expected,
+        "events_delivered": delivered,
+        "streams_complete": sum(1 for c in counts if c >= want),
+        "admission": {
+            "max_streams": agent.config.subs.max_streams,
+            "over_limit_probe_rejected": admission_rejected,
+        },
+        "shed": delta("corro.subs.shed.total"),
+        "deliver_p50_s": deliver.quantile(0.50),
+        "deliver_p99_s": deliver.quantile(0.99),
+        "deliver_observed": deliver.count,
+        "matcher_seconds": round(matcher_s, 3),
+        "writer_walk_seconds": round(writer_s, 3),
+        "per_event_server_us": round(
+            (matcher_s + writer_s) / max(1, delivered) * 1e6, 3
+        ),
+        "writer_writes": delta("corro.subs.writer.writes.total"),
+        "writer_coalesced_batches": delta(
+            "corro.subs.writer.coalesced.batches.total"
+        ),
+        "setup_wall_s": round(setup_wall, 2),
+        "write_wall_s": round(write_wall, 2),
+        "total_wall_s": round(total_wall, 2),
+        "event_rate_per_s": round(delivered / max(1e-9, total_wall), 1),
+        "code_sha": _code_fingerprint(),
+        "measured_at": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
+    }
+    for t in tasks:
+        t.cancel()
+    for h in h2s:
+        try:
+            await h.close()
+        except Exception:
+            pass
+    await client.close()
+    await api.stop()
+    from corrosion_tpu.agent.run import shutdown
+
+    await shutdown(agent)
+    return rec
+
+
+# (streams, queries, n_rows, distinct); the 100k rung keeps event
+# volume small — 100k streams × 20 events = 2M deliveries on one core
+SCALE_RUNGS = (
+    (1_000, 10, 200, False),
+    (1_000, 0, 50, True),  # distinct: queries = streams
+    (10_000, 10, 100, False),
+    (100_000, 10, 20, False),
+)
+
+
+def _run_scale(tag: str, ab: bool) -> None:
+    recs = []
+    for n_streams, n_queries, n_rows, distinct in SCALE_RUNGS:
+        if ab and n_streams <= 10_000:
+            # adjacent A/B per rung: the r10 drain-loop path first
+            for mode, mtag in (("queue", "pre"), ("writer", "post")):
+                t = f"{mtag}{('-' + tag) if tag else ''}"
+                rec = asyncio.run(
+                    streams_rung(
+                        n_streams, n_queries, n_rows, t, distinct, mode
+                    )
+                )
+                print(json.dumps(rec), flush=True)
+                recs.append(rec)
+        else:
+            t = (
+                f"post{('-' + tag) if tag else ''}"
+                if ab
+                else tag
+            )
+            rec = asyncio.run(
+                streams_rung(
+                    n_streams, n_queries, n_rows, t, distinct, "writer"
+                )
+            )
+            print(json.dumps(rec), flush=True)
+            recs.append(rec)
+    merge_records(os.path.join(REPO, "SUBS_SCALE.json"), recs)
+
+
 # the banked grid: rows axis at 1 sub, subs axis at 5k rows (shared
 # matcher via dedupe), plus one distinct-matcher rung for the
 # matcher-count scaling trajectory
@@ -188,9 +440,35 @@ if __name__ == "__main__":
         i = args.index("--tag")
         tag = args[i + 1]
         del args[i : i + 2]
+    ab = "--ab" in args
+    if ab:
+        args.remove("--ab")
     distinct = "--distinct" in args
     if distinct:
         args.remove("--distinct")
+    if "--scale" in args:
+        _run_scale(tag, ab)
+        sys.exit(0)
+    if "--streams" in args:
+        i = args.index("--streams")
+        n_streams = int(args[i + 1])
+        del args[i : i + 2]
+        n_queries = 10
+        if "--queries" in args:
+            i = args.index("--queries")
+            n_queries = int(args[i + 1])
+            del args[i : i + 2]
+        n_rows = 100
+        if "--rows" in args:
+            i = args.index("--rows")
+            n_rows = int(args[i + 1])
+            del args[i : i + 2]
+        rec = asyncio.run(
+            streams_rung(n_streams, n_queries, n_rows, tag, distinct)
+        )
+        print(json.dumps(rec), flush=True)
+        merge_records(os.path.join(REPO, "SUBS_SCALE.json"), [rec])
+        sys.exit(0)
     if "--all" in args:
         _run_and_merge(ALL_RUNGS, tag)
         sys.exit(0)

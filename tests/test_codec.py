@@ -541,3 +541,103 @@ def test_encode_once_prefix_retransmission_digest():
         assert encode_uni_from_prefix(
             prefix, cv.origin_ts, cv.traceparent, digest
         ) == encode_uni_payload(cv, ClusterId(1), digest=digest)
+
+
+def test_chunked_change_v1_bodies_byte_identical():
+    """r16 broadcast chunking: `chunked_change_v1` splices each chunk's
+    body from cached `wire_cell` bytes (header pack + cell join + tail
+    pack) — the bytes must be IDENTICAL to a full `encode_change_v1_body`
+    walk over the equivalent ChangesetFull, whether or not the input
+    changes carry wire_cell caches, and the chunk seq ranges must tile
+    0..last_seq exactly like `chunk_changes`."""
+    from corrosion_tpu.types.change import chunk_changes
+    from corrosion_tpu.types.codec import (
+        Writer,
+        chunked_change_v1,
+        encode_change_v1_body,
+        write_change_fields,
+    )
+
+    actor = ActorId(b"\x33" * 16)
+    ts = Timestamp(987654321)
+    changes = tuple(
+        mk_change(
+            cid=f"c{i % 5}",
+            val=("x" * (200 * (i % 7))) if i % 3 else i,
+            seq=i,
+        )
+        for i in range(40)
+    )
+
+    def with_cells(chs):
+        out = []
+        for c in chs:
+            w = Writer()
+            write_change_fields(
+                w, c.table, c.pk, c.cid, c.val, c.col_version,
+                c.db_version, c.seq, c.site_id, c.cl,
+            )
+            out.append(Change(**{**c.__dict__, "wire_cell": w.bytes()}))
+        return tuple(out)
+
+    for variant in (changes, with_cells(changes)):
+        chunks = chunked_change_v1(
+            actor, 7, variant, 39, ts,
+            origin_ts=17.5, traceparent=None, max_bytes=2048,
+        )
+        assert len(chunks) > 1  # the shape actually chunked
+        expect = [
+            (tuple(chunk), seqs)
+            for chunk, seqs in chunk_changes(variant, 39, max_bytes=2048)
+        ]
+        assert [
+            (cv.changeset.changes, cv.changeset.seqs) for cv in chunks
+        ] == expect
+        # contiguous coverage 0..last_seq
+        assert chunks[0].changeset.seqs[0] == 0
+        assert chunks[-1].changeset.seqs[1] == 39
+        for a, b in zip(chunks, chunks[1:]):
+            assert b.changeset.seqs[0] == a.changeset.seqs[1] + 1
+        for cv in chunks:
+            ref = ChangeV1(actor_id=actor, changeset=cv.changeset)
+            assert cv.wire_body == encode_change_v1_body(ref)
+            # and the whole uni payload splices to the fresh encode
+            assert encode_uni_payload(cv, ClusterId(2)) == (
+                encode_uni_payload(
+                    ChangeV1(
+                        actor_id=actor, changeset=cv.changeset,
+                        origin_ts=cv.origin_ts,
+                        traceparent=cv.traceparent,
+                    ),
+                    ClusterId(2),
+                )
+            )
+
+
+def test_chunked_change_v1_partial_source_keeps_seq_claim():
+    """Re-chunking an already-partial changeset (broadcast oversize
+    splitting of a relayed frame) must never claim seq coverage outside
+    the source's own range: chunk ranges tile seqs[0]..seqs[1], while
+    last_seq stays the full version's."""
+    from corrosion_tpu.types.codec import chunked_change_v1
+
+    actor = ActorId(b"\x44" * 16)
+    ts = Timestamp(5)
+    # a partial carrying seqs 100..139 of a version whose last_seq=500
+    changes = tuple(
+        mk_change(cid="text", val="y" * 300, seq=100 + i) for i in range(40)
+    )
+    chunks = chunked_change_v1(
+        actor, 9, changes, 500, ts, max_bytes=2048, seq_range=(100, 139),
+    )
+    assert len(chunks) > 1
+    assert chunks[0].changeset.seqs[0] == 100
+    assert chunks[-1].changeset.seqs[1] == 139
+    for a, b in zip(chunks, chunks[1:]):
+        assert b.changeset.seqs[0] == a.changeset.seqs[1] + 1
+    for cv in chunks:
+        assert cv.changeset.last_seq == 500
+        lo, hi = cv.changeset.seqs
+        assert {c.seq for c in cv.changeset.changes} == set(
+            range(lo, hi + 1)
+        )

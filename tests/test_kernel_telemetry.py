@@ -92,7 +92,11 @@ def test_suspicion_lifecycle_events_fire():
     transition visibility Lifeguard-style work needs)."""
     params = swim.SwimParams(n=32, suspicion_ticks=3)
     state = swim.init_state(params, jax.random.PRNGKey(0))
-    state = _run(params, state, 10)
+    # 20 boot ticks (was 10): every _run in this phase now shares ONE
+    # scan-length specialization instead of compiling 10- and 20-tick
+    # variants of the same program (r16 budget audit; scan length is a
+    # static arg, so each distinct value is a full XLA compile)
+    state = _run(params, state, 20)
     state = swim.set_alive(state, 5, False)
     state = _run(params, state, 20, seed=11)
     ev = np.asarray(state.events)

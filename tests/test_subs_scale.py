@@ -1,0 +1,353 @@
+"""Serving-plane asymptote tests (r16): subscribe-time query dedupe with
+refcounted matcher lifecycle, coalesced fan-out writes, laggard-shedding
+backpressure, and stream admission control.
+
+The failure discipline under test is Prime CCL (arXiv:2505.14065): a
+slow consumer must DEGRADE — be shed with a typed terminal frame —
+never stall the DiffExecutor or its sibling streams.  The banked
+SUBS_SCALE.json ladder (scripts/bench_pubsub.py --scale) is guarded in
+tests/test_subs_bank.py; everything here is tiny-shape and live.
+"""
+
+import asyncio
+
+import pytest
+
+from corrosion_tpu.net.mem import MemNetwork
+from corrosion_tpu.pubsub.fanout import StreamSink, SubLagging
+
+from tests.test_agent import insert, wait_until
+from tests.test_http_api import boot_with_api
+from tests.test_pubsub_http import next_of
+
+
+async def _shutdown(agent, api, *clients):
+    for c in clients:
+        await c.close()
+    await api.stop()
+    from corrosion_tpu.agent.run import shutdown
+
+    await shutdown(agent)
+
+
+class _RecordingSink(StreamSink):
+    """Always-writable in-process sink: records delivered bytes."""
+
+    def __init__(self, max_lag_bytes=1 << 20, max_lag_batches=1024):
+        super().__init__(max_lag_bytes, max_lag_batches)
+        self.received = bytearray()
+
+    def writable(self):
+        return True
+
+    def write_some(self, data):
+        self.received += data
+        return len(data)
+
+    def lines(self):
+        return [l for l in bytes(self.received).split(b"\n") if l]
+
+
+class _StalledSink(_RecordingSink):
+    """Never-writable sink: the deterministic laggard."""
+
+    def writable(self):
+        return False
+
+
+def _peek(name):
+    from corrosion_tpu.runtime.metrics import METRICS
+
+    for _kind, sname, _labels, value in METRICS.snapshot():
+        if sname == name and not _labels:
+            return value
+    return 0.0
+
+
+# -- dedupe + refcounted lifecycle ----------------------------------------
+
+
+def test_dedupe_canonical_hash_shares_one_matcher():
+    """Streams subscribing textual variants of one query (whitespace,
+    comments) share ONE matcher: the canonical token-normalized hash
+    dedupes at subscribe time, so k distinct queries — not N streams —
+    bound the matcher count."""
+
+    async def main():
+        net = MemNetwork(seed=61)
+        a, api, client = await boot_with_api(net, "agent-dedupe")
+        try:
+            await insert(a, 1, "pre")
+            variants = [
+                "SELECT id, text FROM tests",
+                "SELECT id,  text   FROM tests",
+                "SELECT id, text /* same */ FROM tests",
+            ]
+            its = []
+            for v in variants:
+                it = client.subscribe(v, skip_rows=True).__aiter__()
+                await next_of(it, "eoq")
+                its.append(it)
+            assert len(api.subs.handles()) == 1, (
+                "textual variants must dedupe onto one matcher"
+            )
+            assert _peek("corro.subs.dedupe.hits.total") >= 2
+            assert api.subs.stream_count() == 3
+            await insert(a, 2, "live")
+            for it in its:
+                ev = await next_of(it, "change")
+                assert ev["change"][2] == [2, "live"]
+        finally:
+            await _shutdown(a, api, client)
+
+    asyncio.run(main())
+
+
+def test_matcher_linger_teardown_on_last_detach(tmp_path):
+    """Refcounted lifecycle: the last stream's detach arms the linger
+    timer; past the window the matcher and its sub db are reaped.  A
+    re-subscribe INSIDE the window cancels the reaper and reuses the
+    warm matcher (same query id)."""
+
+    async def main():
+        net = MemNetwork(seed=62)
+        a, api, client = await boot_with_api(net, "agent-linger")
+        # generous window for the reuse phase (a loaded 1-core host must
+        # not reap before the quick re-subscribe lands); shrunk before
+        # the teardown phase below
+        a.config.subs.matcher_linger_secs = 5.0  # manager shares the object
+        try:
+            s1 = client.subscribe("SELECT text FROM tests", skip_rows=True)
+            it = s1.__aiter__()
+            await next_of(it, "eoq")
+            qid = s1.query_id
+            assert len(api.subs.handles()) == 1
+
+            # re-subscribe inside the window keeps the matcher: close
+            # the first stream, reattach before the linger fires
+            await it.aclose()
+            s2 = client.subscribe("SELECT text FROM tests", skip_rows=True)
+            it2 = s2.__aiter__()
+            await next_of(it2, "eoq")
+            assert s2.query_id == qid, "warm matcher must be reused"
+
+            # now drop the last stream and outwait a SHORT linger
+            a.config.subs.matcher_linger_secs = 0.3
+            await it2.aclose()
+            assert await wait_until(
+                lambda: len(api.subs.handles()) == 0, timeout=15.0
+            ), "last detach must reap the matcher after the linger window"
+
+            # a later subscribe builds a FRESH matcher
+            s3 = client.subscribe("SELECT text FROM tests", skip_rows=True)
+            it3 = s3.__aiter__()
+            await next_of(it3, "eoq")
+            assert s3.query_id != qid
+        finally:
+            await _shutdown(a, api, client)
+
+    asyncio.run(main())
+
+
+# -- admission control ----------------------------------------------------
+
+
+def test_admission_rejects_past_max_streams():
+    """[subs] max_streams: the N+1th stream gets a typed 503 (code
+    subs_admission) and the rejection is counted; detaching a stream
+    frees the slot."""
+
+    async def main():
+        net = MemNetwork(seed=63)
+        a, api, client = await boot_with_api(net, "agent-admit")
+        a.config.subs.max_streams = 2
+        try:
+            from corrosion_tpu.client import ClientError
+
+            its = []
+            for _ in range(2):
+                it = client.subscribe(
+                    "SELECT id, text FROM tests", skip_rows=True
+                ).__aiter__()
+                await next_of(it, "eoq")
+                its.append(it)
+            assert api.subs.stream_count() == 2
+
+            rejected = _peek("corro.subs.admission.rejected.total")
+            with pytest.raises(ClientError) as exc:
+                it3 = client.subscribe(
+                    "SELECT id, text FROM tests", skip_rows=True
+                ).__aiter__()
+                await next_of(it3, "eoq")
+            assert exc.value.status == 503
+            assert "subs_admission" in str(exc.value.body)
+            assert _peek("corro.subs.admission.rejected.total") > rejected
+
+            # freeing a slot re-admits
+            await its.pop().aclose()
+            assert await wait_until(
+                lambda: api.subs.stream_count() == 1
+            )
+            it4 = client.subscribe(
+                "SELECT id, text FROM tests", skip_rows=True
+            ).__aiter__()
+            await next_of(it4, "eoq")
+        finally:
+            await _shutdown(a, api, client)
+
+    asyncio.run(main())
+
+
+# -- laggard shedding ------------------------------------------------------
+
+
+def test_stalled_sink_is_shed_siblings_and_executor_unaffected():
+    """THE laggard-shed pin: one stream whose transport never drains is
+    shed with a SubLagging terminal once past its lag bounds, while (a)
+    a sibling sink on the SAME matcher keeps receiving every event and
+    (b) the DiffExecutor keeps producing diffs — events written AFTER
+    the shed still reach the sibling.  Deterministic: the laggard is an
+    in-process sink whose writable() is False, so no TCP buffering can
+    blur the bound."""
+
+    async def main():
+        net = MemNetwork(seed=64)
+        a, api, client = await boot_with_api(net, "agent-shed")
+        try:
+            handle, _ = await api.subs.get_or_insert(
+                "SELECT id, text FROM tests"
+            )
+            healthy = _RecordingSink()
+            stalled = _StalledSink(max_lag_bytes=2048, max_lag_batches=4)
+            handle.attach_sink(healthy)
+            handle.attach_sink(stalled)
+            healthy.release(0)
+            stalled.release(0)
+
+            shed_before = _peek("corro.subs.shed.total")
+            # enough event bytes to blow the 2 KiB lag bound
+            for i in range(12):
+                await insert(a, i, "x" * 400)
+
+            assert await wait_until(
+                lambda: stalled.done.done(), timeout=20.0
+            ), "stalled sink was never shed"
+            outcome = stalled.done.result()
+            assert isinstance(outcome, SubLagging), outcome
+            assert outcome.lag_bytes > 2048 or outcome.lag_batches > 4
+            assert _peek("corro.subs.shed.total") > shed_before
+            assert stalled.received == b"", (
+                "a stalled transport must receive nothing"
+            )
+
+            # the DiffExecutor and the sibling keep delivering: rows
+            # written AFTER the shed still arrive
+            await insert(a, 100, "after-shed")
+            assert await wait_until(
+                lambda: b"after-shed" in bytes(healthy.received),
+                timeout=20.0,
+            ), "sibling stream stalled behind a shed laggard"
+            assert not healthy.done.done(), "sibling must stay attached"
+        finally:
+            await _shutdown(a, api, client)
+
+    asyncio.run(main())
+
+
+def test_stalled_h2_client_is_shed_end_to_end():
+    """The same shed through the REAL serving stack: a native-h2
+    subscriber that stops reading its socket exhausts its flow-control
+    windows; the fan-out writer clogs its sink, the lag bound trips,
+    the server sheds — and a sibling subscriber on its own connection
+    receives every event meanwhile."""
+
+    async def main():
+        net = MemNetwork(seed=65)
+        a, api, client = await boot_with_api(net, "agent-shed-h2")
+        a.config.subs.max_lag_bytes = 16 * 1024
+        a.config.subs.max_lag_batches = 64
+        from corrosion_tpu.client import CorrosionApiClient
+
+        sib_client = CorrosionApiClient(api.addrs[0])
+        lag_client = CorrosionApiClient(api.addrs[0])
+        n_rows = 120
+        got = []
+
+        async def sibling():
+            async for line in sib_client.subscribe(
+                "SELECT id, text FROM tests", skip_rows=True, raw=True
+            ):
+                if line.startswith('{"change":'):
+                    got.append(line)
+                    if len(got) >= n_rows:
+                        return
+
+        try:
+            sib_task = asyncio.ensure_future(sibling())
+            lag_it = lag_client.subscribe(
+                "SELECT id, text FROM tests", skip_rows=True
+            ).__aiter__()
+            await next_of(lag_it, "eoq")
+            await asyncio.sleep(0.3)  # sibling subscribed too
+
+            # stall the laggard: kill its frame pump so the socket is
+            # never read again — windows stop being credited
+            lag_client._session.h2._reader_task.cancel()
+
+            shed_before = _peek("corro.subs.shed.total")
+            for i in range(n_rows):
+                await insert(a, i, "y" * 900)
+
+            assert await wait_until(
+                lambda: _peek("corro.subs.shed.total") > shed_before,
+                timeout=30.0,
+            ), "stalled h2 consumer was never shed"
+            # sibling still drains the full event stream
+            await asyncio.wait_for(sib_task, 60)
+            assert len(got) >= n_rows
+        finally:
+            await _shutdown(a, api, client, sib_client, lag_client)
+
+    asyncio.run(main())
+
+
+def test_client_resumes_from_lagging_frame():
+    """client.py handles the typed shed: on a `{"lagging": ...}`
+    terminal the SubscriptionStream reconnects BY QUERY ID from its
+    last change id — the matcher's changes log replays the gap and live
+    events continue on the resumed stream."""
+
+    async def main():
+        net = MemNetwork(seed=66)
+        a, api, client = await boot_with_api(net, "agent-resume")
+        try:
+            stream = client.subscribe(
+                "SELECT id, text FROM tests", skip_rows=True
+            )
+            it = stream.__aiter__()
+            await next_of(it, "eoq")
+            await insert(a, 1, "one")
+            ev = await next_of(it, "change")
+            assert ev["change"][2] == [1, "one"]
+
+            # inject a shed exactly as the fan-out writer would issue it
+            handle = api.subs.get(stream.query_id)
+            assert handle is not None
+            sink = handle._sinks[0]
+            handle.loop.call_soon(
+                sink._resolve, SubLagging(lag_bytes=9999, lag_batches=9)
+            )
+
+            # rows written around the shed must ALL arrive exactly once:
+            # the log replay covers the reconnect gap
+            await insert(a, 2, "two")
+            await insert(a, 3, "three")
+            seen = []
+            while len(seen) < 2:
+                ev = await next_of(it, "change", timeout=20.0)
+                seen.append(ev["change"][2])
+            assert seen == [[2, "two"], [3, "three"]]
+        finally:
+            await _shutdown(a, api, client)
+
+    asyncio.run(main())
