@@ -86,6 +86,25 @@ class Agent:
     # r14 write-path group commit (agent/run.py GroupCommitter):
     # concurrent local writers coalesce into shared sqlite transactions
     commit_group: Optional[object] = None
+    # r17 catch-up plane (agent/catchup.py): serve-side cached snapshot
+    # (store/snapshot.py SnapshotCache) + its async build lock/permits,
+    # per-peer sync circuit state, and the bootstrap census /v1/status
+    # serves
+    snapshots: Optional[object] = None  # SnapshotCache
+    snapshot_build_lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+    snapshot_serve_sem: asyncio.Semaphore = field(
+        default_factory=lambda: asyncio.Semaphore(2)
+    )
+    # ActorId -> PeerCircuit (agent/syncer.py): consecutive-failure
+    # breaker consulted by peer choice and the resumable sync waves
+    sync_circuits: dict = field(default_factory=dict)
+    # bootstrap census: {"state": idle|fetching|installed|failed, ...}
+    catchup_census: dict = field(default_factory=dict)
+    # bumped by a snapshot install: the ingest seen-cache must drop
+    # everything it remembers, because "seen" changes applied BEFORE
+    # the database swap were discarded by it — a stale entry would
+    # shadow the re-served version forever (agent/ingest.py)
+    ingest_epoch: int = 0
     # instrumented-lock registry (agent.rs:707-1066), admin `locks` command
     lock_registry: LockRegistry = field(default_factory=LockRegistry)
 

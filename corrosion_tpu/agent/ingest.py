@@ -131,6 +131,7 @@ async def handle_changes(agent: Agent) -> None:
         t.add_done_callback(jobs.discard)
 
     deadline: Optional[float] = None
+    epoch = agent.ingest_epoch
     while not agent.tripwire.tripped:
         timeout = None
         if deadline is not None:
@@ -145,6 +146,15 @@ async def handle_changes(agent: Agent) -> None:
         except ChannelClosed:
             break
 
+        if agent.ingest_epoch != epoch:
+            # r17 snapshot install swapped the database: every "seen"
+            # verdict predates the swap and may describe data the swap
+            # dropped — a stale entry would make this loop skip the
+            # re-served version forever (the catch-up plane's re-pull
+            # would grind against it each round).  Checked AFTER the
+            # recv so the verdict for THIS item is never the stale one.
+            epoch = agent.ingest_epoch
+            seen.clear()
         if item is not None:
             cv, source = item
             METRICS.counter("corro.agent.changes.recv").inc()

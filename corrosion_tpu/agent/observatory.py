@@ -130,12 +130,14 @@ class Observatory:
         suspect = sum(1 for m in members if m.state == MemberState.SUSPECT)
 
         backlog: Dict[bytes, int] = {}
+        heads_total = 0  # r17: versions held, the catch-up freshness ad
         for aid, booked in self.agent.bookie.items().items():
             with booked.read() as bv:
                 need = sum(e - s + 1 for s, e in bv.needed)
                 need += sum(
                     1 for p in bv.partials.values() if not p.is_complete()
                 )
+                heads_total += (bv.last() or 0) - need
             if need:
                 backlog[aid.bytes16] = need
 
@@ -164,9 +166,21 @@ class Observatory:
             lhm=mship.lhm,
             loop_lag=loop_lag,
             sync_backlog=backlog,
+            heads_total=max(0, heads_total),
             events=events,
             stages=lat.stage_hists(window_secs=None),
         )
+
+    def advertised_heads(self) -> Dict[bytes, int]:
+        """actor id -> that node's digest-advertised `heads_total` —
+        the r17 catch-up plane's freshness map (peer-choice bias +
+        snapshot-bootstrap gap estimate).  Lock: vs the worker-thread
+        builder."""
+        with self._lock:
+            return {
+                aid: held.digest.heads_total
+                for aid, held in self._store.items()
+            }
 
     def build_and_store(self) -> NodeDigest:
         """Refresh the local digest and queue it for dissemination with

@@ -26,6 +26,7 @@ from __future__ import annotations
 import asyncio
 import random
 import time
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from corrosion_tpu.agent.handle import Agent, ChangeSource
@@ -53,7 +54,6 @@ from corrosion_tpu.types.codec import (
     SyncRejection,
     SyncState,
     SyncTraceContext,
-    decode_bi_payload,
     decode_sync_msg,
     encode_bi_payload_sync_start,
     encode_sync_msg,
@@ -118,12 +118,24 @@ class AdaptiveChunkSize:
 
 
 async def serve_sync(agent: Agent, stream: BiStream) -> None:
-    """Handle one inbound sync session."""
+    """Handle one inbound bi-stream: a SyncStart session, or (r17) a
+    SnapshotReq from a cold node bootstrapping (agent/catchup.py).  The
+    dispatch is the version gate: a pre-r17 build raises ValueError on
+    the snapshot variant and lands in the counted-failure close below,
+    which the requester reads as EOF → pure-delta fallback."""
+    from corrosion_tpu.types.codec import decode_bi_payload_any
+
     try:
         first = await asyncio.wait_for(stream.recv(), RECV_TIMEOUT)
         if first is None:
             return
-        peer_actor_id, trace, cluster_id = decode_bi_payload(first)
+        kind, payload = decode_bi_payload_any(first)
+        if kind == "snapshot":
+            from corrosion_tpu.agent.catchup import serve_snapshot
+
+            await serve_snapshot(agent, stream, payload)
+            return
+        peer_actor_id, trace, cluster_id = payload
         if cluster_id != agent.cluster_id:
             await stream.send(encode_sync_msg(SyncRejection(reason=1)))
             await stream.finish()
@@ -400,44 +412,273 @@ def _empty_versions(
 # -- client ----------------------------------------------------------------
 
 
+@dataclass
+class PeerCircuit:
+    """Per-peer circuit state (r17): `circuit_failures` consecutive
+    failed sessions open the breaker for `circuit_reset_secs` — the
+    peer is skipped by peer choice and the resume waves until it
+    half-opens, instead of burning a slot of every round on a node
+    that is down or wedged."""
+
+    failures: int = 0
+    open_until: float = 0.0
+
+    def allows(self, now: float) -> bool:
+        return now >= self.open_until
+
+
+def _circuit_allows(agent: Agent, actor_id: ActorId, now: float) -> bool:
+    c = agent.sync_circuits.get(actor_id)
+    return c is None or c.allows(now)
+
+
+def _record_failure(agent: Agent, actor_id: ActorId) -> None:
+    cfg = agent.config.sync
+    c = agent.sync_circuits.setdefault(actor_id, PeerCircuit())
+    c.failures += 1
+    if c.failures >= cfg.circuit_failures:
+        c.failures = 0
+        # auto mode tracks the sync cadence: the breaker horizon is a
+        # handful of rounds whatever the deployment's interval is
+        reset = cfg.circuit_reset_secs or (
+            4.0 * agent.config.perf.sync_interval_max_secs
+        )
+        c.open_until = time.monotonic() + reset
+        METRICS.counter("corro.sync.circuit.opened.total").inc()
+
+
+def _record_success(agent: Agent, actor_id: ActorId) -> None:
+    c = agent.sync_circuits.get(actor_id)
+    if c is not None:
+        c.failures = 0
+        c.open_until = 0.0
+
+
+class _Outstanding:
+    """One session's claimed-but-not-yet-received ranges.  Shrinks as
+    changesets arrive (stream order guarantees a version whose final
+    chunk landed arrived whole); whatever remains at session death is
+    handed back to the ledger for a sibling to re-claim."""
+
+    __slots__ = ("full", "partials")
+
+    def __init__(self):
+        self.full: Dict[ActorId, RangeSet] = {}
+        self.partials: Dict[Tuple[ActorId, int], RangeSet] = {}
+
+    def observe(self, cv: ChangeV1) -> None:
+        cs = cv.changeset
+        if isinstance(cs, ChangesetFull):
+            if cs.seqs[1] >= cs.last_seq:  # final chunk of the version
+                rs = self.full.get(cv.actor_id)
+                if rs is not None:
+                    rs.remove(cs.version, cs.version)
+                self.partials.pop((cv.actor_id, cs.version), None)
+            else:
+                prs = self.partials.get((cv.actor_id, cs.version))
+                if prs is not None:
+                    prs.remove(cs.seqs[0], cs.seqs[1])
+        elif isinstance(cs, ChangesetEmptySet):
+            rs = self.full.get(cv.actor_id)
+            if rs is not None:
+                for s, e in cs.versions:
+                    rs.remove(s, e)
+
+
+class _ClaimLedger:
+    """Cross-peer dedupe of requested ranges (peer/mod.rs:1274-1351)
+    plus the r17 resume half: a failed session RELEASES its unserved
+    claims so a surviving peer's next wave re-claims them — a dropout
+    mid-stream costs the un-received tail, never a restart."""
+
+    def __init__(self):
+        self.full: Dict[ActorId, RangeSet] = {}
+        self.partials: Dict[Tuple[ActorId, int], RangeSet] = {}
+        self.lock = asyncio.Lock()
+
+    async def claim(
+        self, needs: Dict[ActorId, List[object]], out: _Outstanding
+    ) -> List[Tuple[ActorId, List[object]]]:
+        request: List[Tuple[ActorId, List[object]]] = []
+        async with self.lock:
+            for actor_id, need_list in needs.items():
+                claimed: List[object] = []
+                for need in need_list:
+                    if isinstance(need, NeedFull):
+                        got = self.full.setdefault(actor_id, RangeSet())
+                        s, e = need.versions
+                        fresh = RangeSet([(s, e)])
+                        for gs, ge in got.overlapping(s, e):
+                            fresh.remove(gs, ge)
+                        for fs, fe in list(fresh):
+                            got.insert(fs, fe)
+                            out.full.setdefault(
+                                actor_id, RangeSet()
+                            ).insert(fs, fe)
+                            for cs_, ce in chunk_range(
+                                fs, fe, VERSIONS_PER_CHUNK
+                            ):
+                                claimed.append(NeedFull((cs_, ce)))
+                    elif isinstance(need, NeedPartial):
+                        key = (actor_id, need.version)
+                        got = self.partials.setdefault(key, RangeSet())
+                        fresh_seqs = []
+                        for s, e in need.seqs:
+                            seg = RangeSet([(s, e)])
+                            for gs, ge in got.overlapping(s, e):
+                                seg.remove(gs, ge)
+                            for fs, fe in seg:
+                                got.insert(fs, fe)
+                                out.partials.setdefault(
+                                    key, RangeSet()
+                                ).insert(fs, fe)
+                                fresh_seqs.append((fs, fe))
+                        if fresh_seqs:
+                            claimed.append(
+                                NeedPartial(need.version, tuple(fresh_seqs))
+                            )
+                if claimed:
+                    request.append((actor_id, claimed))
+        return request
+
+    async def release(self, out: _Outstanding) -> int:
+        """Un-claim a dead session's outstanding ranges; returns the
+        version count handed back."""
+        released = 0
+        async with self.lock:
+            for actor_id, rs in out.full.items():
+                got = self.full.get(actor_id)
+                for s, e in rs:
+                    released += e - s + 1
+                    if got is not None:
+                        got.remove(s, e)
+            for key, rs in out.partials.items():
+                got = self.partials.get(key)
+                if got is None:
+                    continue
+                for s, e in rs:
+                    got.remove(s, e)
+                released += 1 if len(list(rs)) else 0
+        out.full.clear()
+        out.partials.clear()
+        return released
+
+
 async def parallel_sync(
     agent: Agent, peers: List[Actor], ours: Optional[SyncState] = None
 ) -> int:
-    """Sync with several peers concurrently; returns changes received."""
-    if ours is None:
-        ours = generate_sync(agent.bookie, agent.actor_id)
-    # cross-peer dedupe of requested ranges (peer/mod.rs:1274-1351)
-    req_full: Dict[ActorId, RangeSet] = {}
-    req_partials: Dict[Tuple[ActorId, int], RangeSet] = {}
-    lock = asyncio.Lock()
-    results = await asyncio.gather(
-        *(
-            _sync_one_peer(agent, peer, ours, req_full, req_partials, lock)
-            for peer in peers
-        ),
-        return_exceptions=True,
-    )
+    """Sync with several peers concurrently; returns changes received.
+
+    r17 resumable: runs up to `[sync] max_waves` waves inside ONE call —
+    a wave's failed sessions release their unserved ranges and the next
+    wave (fresh `generate_sync` against the surviving peers, paced by
+    `runtime/backoff.py`) re-claims them, so a peer dropping mid-stream
+    degrades the transfer instead of restarting it."""
+    cfg = agent.config.sync
+    if not peers:
+        return 0
+    ledger = _ClaimLedger()
+    from corrosion_tpu.runtime.backoff import Backoff
+
+    pacing = Backoff(
+        min_interval=cfg.resume_backoff_min_secs,
+        max_interval=cfg.resume_backoff_max_secs,
+    ).iter()
     total = 0
-    for peer, res in zip(peers, results):
-        if isinstance(res, BaseException):
-            METRICS.counter("corro.sync.client.failed").inc()
-        else:
-            total += res
+    wave = 0
+    while peers:
+        wave += 1
+        if ours is None:
+            ours = generate_sync(agent.bookie, agent.actor_id)
+        results = await asyncio.gather(
+            *(_sync_one_peer(agent, peer, ours, ledger) for peer in peers),
+            return_exceptions=True,
+        )
+        survivors: List[Actor] = []
+        released = 0
+        for peer, res in zip(peers, results):
+            if isinstance(res, BaseException):
+                # unexpected (session code failed before its own error
+                # envelope): counted, no resume info to salvage
+                METRICS.counter("corro.sync.client.failed").inc()
+                _record_failure(agent, peer.id)
+                continue
+            received, ok, freed = res
+            total += received
+            if not ok:
+                METRICS.counter("corro.sync.client.failed").inc()
+                _record_failure(agent, peer.id)
+                released += freed
+                continue
+            _record_success(agent, peer.id)
+            survivors.append(peer)
             info = agent.members.get(peer.id)
             if info is not None:
                 info.last_sync_ts = agent.clock.new_timestamp().ntp64
+        if released == 0 or wave >= cfg.max_waves or not survivors:
+            break
+        METRICS.counter("corro.sync.resume.waves.total").inc()
+        METRICS.counter("corro.sync.resume.versions.total").inc(released)
+        await asyncio.sleep(next(pacing))
+        peers = survivors
+        ours = None  # regenerate: the bookie advanced under wave N
     return total
+
+
+async def fetch_peer_state(
+    agent: Agent, peer: Actor, timeout: float = RECV_TIMEOUT
+) -> Optional[SyncState]:
+    """One state-only handshake: SyncStart + clock, read the peer's
+    summary, half-close without requesting anything.  The cold-boot gap
+    probe (`agent/catchup.py`) — cheap enough to run before the first
+    digest arrives."""
+    import contextlib
+
+    try:
+        stream = await agent.transport.open_bi(peer.addr)
+    except (TransportError, OSError):
+        return None
+    try:
+        await stream.send(
+            encode_bi_payload_sync_start(
+                agent.actor_id, cluster_id=agent.cluster_id
+            )
+        )
+        await stream.send(encode_sync_msg(agent.clock.new_timestamp()))
+        while True:
+            frame = await asyncio.wait_for(stream.recv(), timeout)
+            if frame is None:
+                return None
+            msg = decode_sync_msg(frame)
+            if isinstance(msg, Timestamp):
+                agent.clock.update_with_timestamp(msg)
+            elif isinstance(msg, SyncRejection):
+                return None
+            elif isinstance(msg, SyncState):
+                return msg
+    except (asyncio.TimeoutError, TransportError, ValueError):
+        return None
+    finally:
+        with contextlib.suppress(Exception):
+            await stream.finish()
+        stream.close()
 
 
 async def _sync_one_peer(
     agent: Agent,
     peer: Actor,
     ours: SyncState,
-    req_full: Dict[ActorId, RangeSet],
-    req_partials: Dict[Tuple[ActorId, int], RangeSet],
-    lock: asyncio.Lock,
-) -> int:
-    stream = await agent.transport.open_bi(peer.addr)
+    ledger: _ClaimLedger,
+) -> Tuple[int, bool, int]:
+    """One client session.  Returns (changes received, clean, versions
+    released back to the ledger on failure) — expected transport/decode
+    faults are turned into a resume record here, never raised."""
+    out = _Outstanding()
+    received = 0
+    try:
+        stream = await agent.transport.open_bi(peer.addr)
+    except (TransportError, OSError, asyncio.TimeoutError):
+        return 0, False, 0
     # the whole client session is one span; its W3C context rides the
     # SyncStart frame (peer/mod.rs:1098-1101 inject)
     sp = span("sync.client", peer=peer.addr)
@@ -456,52 +697,19 @@ async def _sync_one_peer(
         while theirs is None:
             frame = await asyncio.wait_for(stream.recv(), RECV_TIMEOUT)
             if frame is None:
-                return 0
+                return 0, True, 0
             msg = decode_sync_msg(frame)
             if isinstance(msg, Timestamp):
                 agent.clock.update_with_timestamp(msg)
             elif isinstance(msg, SyncRejection):
                 METRICS.counter("corro.sync.client.rejected").inc()
-                return 0
+                return 0, True, 0
             elif isinstance(msg, SyncState):
                 theirs = msg
 
         needs = compute_available_needs(ours, theirs)
         # claim ranges not already requested from another peer
-        request: List[Tuple[ActorId, List[object]]] = []
-        async with lock:
-            for actor_id, need_list in needs.items():
-                claimed: List[object] = []
-                for need in need_list:
-                    if isinstance(need, NeedFull):
-                        got = req_full.setdefault(actor_id, RangeSet())
-                        s, e = need.versions
-                        fresh = RangeSet([(s, e)])
-                        for gs, ge in got.overlapping(s, e):
-                            fresh.remove(gs, ge)
-                        for fs, fe in list(fresh):
-                            got.insert(fs, fe)
-                            for cs_, ce in chunk_range(
-                                fs, fe, VERSIONS_PER_CHUNK
-                            ):
-                                claimed.append(NeedFull((cs_, ce)))
-                    elif isinstance(need, NeedPartial):
-                        key = (actor_id, need.version)
-                        got = req_partials.setdefault(key, RangeSet())
-                        fresh_seqs = []
-                        for s, e in need.seqs:
-                            seg = RangeSet([(s, e)])
-                            for gs, ge in got.overlapping(s, e):
-                                seg.remove(gs, ge)
-                            for fs, fe in seg:
-                                got.insert(fs, fe)
-                                fresh_seqs.append((fs, fe))
-                        if fresh_seqs:
-                            claimed.append(
-                                NeedPartial(need.version, tuple(fresh_seqs))
-                            )
-                if claimed:
-                    request.append((actor_id, claimed))
+        request = await ledger.claim(needs, out)
 
         # round-robin the claimed needs in ≤MAX_NEEDS_PER_TURN batches
         flat: List[Tuple[ActorId, object]] = [
@@ -515,7 +723,6 @@ async def _sync_one_peer(
             await stream.send(encode_sync_msg(list(grouped.items())))
         await stream.finish()
 
-        received = 0
         while True:
             frame = await asyncio.wait_for(stream.recv(), RECV_TIMEOUT)
             if frame is None:
@@ -532,10 +739,15 @@ async def _sync_one_peer(
                 ):
                     continue
                 await agent.tx_changes.send((msg, ChangeSource.SYNC))
+                out.observe(msg)
                 cs = msg.changeset
                 received += len(getattr(cs, "changes", ()))
         METRICS.counter("corro.sync.client.changes.received").inc(received)
-        return received
+        return received, True, 0
+    except (asyncio.TimeoutError, TransportError, ValueError, OSError):
+        released = await ledger.release(out)
+        METRICS.counter("corro.sync.client.changes.received").inc(received)
+        return received, False, released
     finally:
         sp.__exit__(None, None, None)
         stream.close()
@@ -546,8 +758,22 @@ async def _sync_one_peer(
 
 def choose_sync_peers(agent: Agent, rng: random.Random) -> List[Actor]:
     """clamp(members/100, min, max) peers, sampled 2×, sorted by
-    (most-needed, oldest-last-sync, lowest RTT ring) (handlers.rs:811-866)."""
+    (freshest-advertised-heads, oldest-last-sync, lowest RTT ring)
+    (handlers.rs:811-866).
+
+    r17: the uniform-random pick was why a repair could take ~n rounds
+    in a mostly-can't-serve population (the r12 test_bridge note —
+    virtual kernel peers close bi-streams): peers whose observatory
+    digest advertises the most held versions (`heads_total`) sort
+    first, so the node most likely to HAVE what we need is asked first.
+    With no digests known the sort degrades to the old random-sample
+    ordering.  Circuit-open peers are DEPRIORITIZED, never excluded:
+    in a small cluster every candidate still gets picked (anti-entropy
+    must keep probing through a flap — the repair race against the
+    broadcast plane is tight), while at scale an open breaker stops
+    burning one of the few want-slots on a dead node."""
     perf = agent.config.perf
+    now = time.monotonic()
     candidates = [
         info
         for aid, info in agent.members.states.items()
@@ -560,13 +786,31 @@ def choose_sync_peers(agent: Agent, rng: random.Random) -> List[Actor]:
         min(perf.sync_peers_max, len(candidates) // 100),
     )
     sample = rng.sample(candidates, min(len(candidates), want * 2))
-    sample.sort(
-        key=lambda info: (
-            info.last_sync_ts or 0,
-            info.ring if info.ring is not None else 99,
+    heads: Dict[bytes, int] = {}
+    if agent.observatory is not None:
+        heads = agent.observatory.advertised_heads()
+    if heads:
+        sample.sort(
+            key=lambda info: (
+                0 if _circuit_allows(agent, info.actor.id, now) else 1,
+                -heads.get(info.actor.id.bytes16, -1),
+                info.last_sync_ts or 0,
+                info.ring if info.ring is not None else 99,
+            )
         )
-    )
-    return [info.actor for info in sample[:want]]
+    else:
+        sample.sort(
+            key=lambda info: (
+                0 if _circuit_allows(agent, info.actor.id, now) else 1,
+                info.last_sync_ts or 0,
+                info.ring if info.ring is not None else 99,
+            )
+        )
+    chosen = sample[:want]
+    for info in sample[want:]:
+        if not _circuit_allows(agent, info.actor.id, now):
+            METRICS.counter("corro.sync.circuit.skipped.total").inc()
+    return [info.actor for info in chosen]
 
 
 async def sync_loop(agent: Agent, rng: Optional[random.Random] = None) -> None:
@@ -583,6 +827,12 @@ async def sync_loop(agent: Agent, rng: Optional[random.Random] = None) -> None:
         if not peers:
             interval = min(interval * 2, perf.sync_interval_max_secs)
             continue
+        # r17 cold-gap check: a node far enough behind bootstraps from a
+        # peer snapshot FIRST, then the same round's delta sync tops up
+        # from the watermark (agent/catchup.py; never raises)
+        from corrosion_tpu.agent.catchup import maybe_snapshot_bootstrap
+
+        await maybe_snapshot_bootstrap(agent, peers)
         start = time.monotonic()
         try:
             received = await asyncio.wait_for(parallel_sync(agent, peers), 300)

@@ -47,6 +47,14 @@ from corrosion_tpu.runtime.metrics import (
 from corrosion_tpu.store.schema import SchemaError
 
 
+def _held_versions(agent: Agent) -> int:
+    """Versions this node holds (the catch-up census's local half) —
+    host-state reads only, same contract as the rest of /v1/status."""
+    from corrosion_tpu.sync import held_total
+
+    return held_total(agent.bookie)
+
+
 class _Limit:
     """Load-shedding concurrency limit: full ⇒ 503 (util.rs:181-328)."""
 
@@ -507,6 +515,38 @@ class ApiServer:
                 "server_permits_available": getattr(
                     agent.sync_serve_sem, "_value", 0
                 ),
+                # r17 catch-up plane census: is this node (or anyone
+                # pulling from it) catching up, how, and is the
+                # fault-tolerance machinery engaging — the one block an
+                # operator reads during a cold-node join or post-
+                # partition repair
+                "catchup": {
+                    "snapshot_enabled": agent.config.sync.snapshot,
+                    "bootstrap": dict(agent.catchup_census),
+                    "held_versions": _held_versions(agent),
+                    "resume_waves": peek("corro.sync.resume.waves.total"),
+                    "resume_versions": peek(
+                        "corro.sync.resume.versions.total"
+                    ),
+                    "circuits_open": sum(
+                        1
+                        for c in agent.sync_circuits.values()
+                        if not c.allows(time.monotonic())
+                    ),
+                    "snapshot_installs": peek("corro.snapshot.install.total"),
+                    "snapshot_serves": peek("corro.snapshot.serve.total"),
+                    "snapshot_cache_age_secs": (
+                        round(agent.snapshots.age(), 3)
+                        if agent.snapshots is not None
+                        and agent.snapshots.age() is not None
+                        else None
+                    ),
+                    "snapshot_cache_bytes": (
+                        agent.snapshots.compressed_bytes
+                        if agent.snapshots is not None
+                        else 0
+                    ),
+                },
             },
         }
         return web.json_response(status)

@@ -2,9 +2,13 @@
 
 Counterpart of `klukai/src/main.rs:569-826`'s command tree:
 
-  agent                      run the agent with a config file
+  agent [--from-snapshot P]  run the agent (optionally cold-bootstrap
+                             the database from a snapshot file first)
   backup PATH                VACUUM INTO + scrub per-node state
   restore PATH               swap the db file under full SQLite locks
+  snapshot dump|install PATH r17 catch-up plane: serve-side compressed
+                             snapshot dump / cold-side install (schema-
+                             sha-gated, keeps the local site id)
   cluster rejoin|members|membership-states|set-id
   consul sync                bidirectional Consul <-> store replication
   query SQL                  one-shot query through the HTTP API
@@ -46,7 +50,10 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--admin-path", default=None)
     sub = p.add_subparsers(dest="command", required=True)
 
-    sub.add_parser("agent", help="run the agent")
+    ag = sub.add_parser("agent", help="run the agent")
+    # cold-side bootstrap flag (r17): install a snapshot file over the
+    # configured db (schema-sha-gated) before the agent boots from it
+    ag.add_argument("--from-snapshot", default=None, metavar="PATH")
 
     b = sub.add_parser("backup", help="back up the database")
     b.add_argument("path")
@@ -54,6 +61,15 @@ def _build_parser() -> argparse.ArgumentParser:
     r = sub.add_parser("restore", help="restore a backup over the live db")
     r.add_argument("path")
     r.add_argument("--self-actor-id", default=None)
+
+    sn = sub.add_parser(
+        "snapshot", help="compressed catch-up snapshots (r17)"
+    ).add_subparsers(dest="sub", required=True)
+    snd = sn.add_parser("dump", help="build a snapshot file from the db")
+    snd.add_argument("path")
+    sni = sn.add_parser("install", help="install a snapshot over the db")
+    sni.add_argument("path")
+    sni.add_argument("--self-actor-id", default=None)
 
     cluster = sub.add_parser("cluster").add_subparsers(
         dest="sub", required=True
@@ -176,7 +192,7 @@ async def _admin_call(cfg: Config, cmd: dict) -> int:
     return 0
 
 
-async def _cmd_agent(cfg: Config) -> int:
+async def _cmd_agent(cfg: Config, from_snapshot: Optional[str] = None) -> int:
     import logging
 
     from corrosion_tpu.admin import AdminServer
@@ -194,6 +210,14 @@ async def _cmd_agent(cfg: Config) -> int:
             else "%(asctime)s %(levelname)s %(name)s %(message)s"
         ),
     )
+
+    if from_snapshot:
+        # cold-side bootstrap (r17): install before the store opens, so
+        # the agent boots straight onto the snapshot's bookkeeping and
+        # its first sync rounds are the watermark top-up
+        rc = _snapshot_install(cfg, from_snapshot)
+        if rc != 0:
+            return rc
 
     tripwire = Tripwire.from_signals()
     agent = await setup(cfg, tripwire=tripwire)
@@ -333,6 +357,121 @@ async def _cmd_reload(cfg: Config) -> int:
     return 0
 
 
+async def _agent_is_live(cfg: Config) -> bool:
+    from corrosion_tpu.admin import AdminClient
+
+    try:
+        async with AdminClient(cfg.admin.uds_path) as c:
+            r = await c.call({"cmd": "ping"})
+            return bool(r["ok"])
+    except (ConnectionError, FileNotFoundError, OSError):
+        return False
+
+
+def _expected_schema_sha(cfg: Config):
+    """Schema sha from the configured declarative schema files (None
+    when none are configured — the install then trusts the snapshot)."""
+    if not cfg.db.schema_paths:
+        return None
+    from pathlib import Path
+
+    from corrosion_tpu.store.schema import parse_sql
+    from corrosion_tpu.store.snapshot import schema_sha
+
+    sql = "\n".join(Path(p).read_text() for p in cfg.db.schema_paths)
+    return schema_sha(parse_sql(sql), exclude=(cfg.slo.canary_table,))
+
+
+def _existing_site_id(db_path: str):
+    import sqlite3 as _sqlite3
+
+    if not os.path.exists(db_path):
+        return None
+    try:
+        conn = _sqlite3.connect(db_path)
+        try:
+            row = conn.execute(
+                "SELECT site_id FROM __crdt_site WHERE id = 1"
+            ).fetchone()
+        finally:
+            conn.close()
+    except _sqlite3.Error:
+        return None
+    return bytes(row[0]) if row else None
+
+
+def _snapshot_install(cfg: Config, path: str, self_actor_id=None) -> int:
+    """Shared by `snapshot install` and `agent --from-snapshot`: the
+    cold node keeps its own identity — an existing db's site id (or
+    --self-actor-id) is re-pinned into the installed copy."""
+    import uuid
+
+    from corrosion_tpu.store.snapshot import (
+        SnapshotError,
+        install_snapshot_file,
+    )
+
+    self_site = None
+    if self_actor_id:
+        self_site = uuid.UUID(self_actor_id).bytes
+    else:
+        self_site = _existing_site_id(cfg.db.path)
+    try:
+        res = install_snapshot_file(
+            path,
+            cfg.db.path,
+            expect_schema_sha=_expected_schema_sha(cfg),
+            self_site_id=self_site,
+        )
+    except SnapshotError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(
+        f"installed snapshot over {cfg.db.path}: {res.raw_bytes} bytes,"
+        f" {res.watermark_versions} watermark versions"
+        f" (delta sync tops up from there)"
+    )
+    return 0
+
+
+async def _cmd_snapshot(cfg: Config, args) -> int:
+    # both directions need exclusive db access, same rule as restore
+    if await _agent_is_live(cfg):
+        print(
+            "an agent is running on this database; stop it first"
+            " (live peers serve snapshots over the sync plane instead)",
+            file=sys.stderr,
+        )
+        return 1
+    if args.sub == "dump":
+        from corrosion_tpu.store import snapshot as snap
+        from corrosion_tpu.store.bookkeeping import Bookie
+        from corrosion_tpu.store.crdt import CrdtStore
+
+        store = CrdtStore(cfg.db.path)
+        try:
+            bookie = Bookie()
+            for aid in store.booked_actor_ids():
+                bookie.insert(aid, store.load_booked_versions(aid))
+            header = snap.build_snapshot_file(
+                cfg.db.path,
+                args.path,
+                store.schema,
+                store.site_id.bytes16,
+                snap.bookie_watermark(bookie),
+                cfg.sync.snapshot_chunk_bytes,
+            )
+        finally:
+            store.close()
+        print(
+            f"wrote snapshot {args.path}: {header.raw_bytes} bytes raw,"
+            f" {header.watermark_total()} watermark versions,"
+            f" schema sha {header.schema_sha.hex()[:12]}"
+        )
+        return 0
+    return _snapshot_install(cfg, args.path, args.self_actor_id)
+
+
 def _cmd_db_lock(cfg: Config, cmd: str) -> int:
     import shlex
     import subprocess
@@ -369,7 +508,9 @@ async def _amain(argv: Optional[List[str]] = None) -> int:
     cmd = args.command
 
     if cmd == "agent":
-        return await _cmd_agent(cfg)
+        return await _cmd_agent(cfg, from_snapshot=args.from_snapshot)
+    if cmd == "snapshot":
+        return await _cmd_snapshot(cfg, args)
     if cmd == "backup":
         from corrosion_tpu.store.restore import backup
 

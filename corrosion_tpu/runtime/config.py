@@ -205,6 +205,54 @@ class SubsConfig:
 
 
 @dataclass
+class SyncConfig:
+    """[sync] — the r17 cold-node catch-up plane (agent/catchup.py,
+    store/snapshot.py).
+
+    Snapshot bootstrap: a node whose estimated version gap against the
+    freshest peer exceeds `snapshot_min_gap_versions` fetches the
+    peer's cached compressed snapshot (staleness-bounded by
+    `snapshot_max_age_secs` on the SERVING side), installs it through
+    the locked-swap path, and tops up with delta sync from the embedded
+    watermark — instead of replaying the whole gap change-by-change.
+    `snapshot=false` disables both serving and bootstrapping (the pure-
+    delta A/B lever `scripts/bench_sync.py` measures against).
+
+    Resumable delta sync: a peer dropping mid-stream releases its
+    unserved version ranges back to the shared claim ledger and the
+    SAME sync round re-claims them from surviving peers, up to
+    `max_waves` waves paced by `resume_backoff_{min,max}_secs` (Prime
+    CCL discipline: a dead peer degrades the transfer, never restarts
+    or deadlocks it).  A peer failing `circuit_failures` consecutive
+    sessions opens its circuit for `circuit_reset_secs` (per-peer
+    state on the Agent handle): peer choice DEPRIORITIZES it (never
+    excludes — small clusters must keep probing through a flap) and
+    the snapshot bootstrap refuses it as a bulk-transfer source.  The
+    default 0 auto-scales the reset to 4 × `perf.sync_interval_max_
+    secs` — a breaker horizon must track the retry cadence it guards,
+    or fast-cadence deployments blank their sync plane for hundreds of
+    rounds after one flap."""
+
+    snapshot: bool = True
+    snapshot_min_gap_versions: int = 10_000
+    snapshot_max_age_secs: float = 60.0
+    snapshot_chunk_bytes: int = 256 * 1024
+    snapshot_timeout_secs: float = 300.0
+    # after a successful install the bootstrap stands down and the
+    # delta plane owns the residual gap: under sustained write fire
+    # every small transaction is a fresh version, so the version-gap
+    # heuristic alone would re-trigger bootstrap each round and reset
+    # the node to the (stale) watermark forever
+    snapshot_cooldown_secs: float = 300.0
+    max_concurrent_snapshot_serves: int = 2
+    max_waves: int = 3
+    resume_backoff_min_secs: float = 0.1
+    resume_backoff_max_secs: float = 2.0
+    circuit_failures: int = 3
+    circuit_reset_secs: float = 0.0  # 0 → 4 × perf.sync_interval_max_secs
+
+
+@dataclass
 class ClusterObsConfig:
     """[cluster] — the r12 cluster observatory (agent/observatory.py).
     Each node builds a telemetry digest every `digest_interval_secs`
@@ -281,6 +329,7 @@ class Config:
     pubsub: PubsubConfig = field(default_factory=PubsubConfig)
     subs: SubsConfig = field(default_factory=SubsConfig)
     cluster: ClusterObsConfig = field(default_factory=ClusterObsConfig)
+    sync: SyncConfig = field(default_factory=SyncConfig)
 
 
 _ENV_PREFIX = "CORRO_"

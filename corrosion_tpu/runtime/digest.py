@@ -73,6 +73,13 @@ class NodeDigest:
     loop_lag: float = 0.0  # max event-loop lag seconds
     # per-peer sync backlog: origin actor id -> versions still needed
     sync_backlog: Dict[bytes, int] = field(default_factory=dict)
+    # r17: total versions this node HOLDS across all origin actors
+    # (heads minus gaps minus incomplete partials) — the catch-up
+    # plane's freshness signal: peer choice biases toward the highest
+    # advertiser and the snapshot-bootstrap gap heuristic compares
+    # against it.  Rides as a TRAILING field (old decoders stop before
+    # it, new decoders default 0 on eof — the envelope-ext tolerance).
+    heads_total: int = 0
     # device kernel event totals (corro.kernel.events.total), summed
     # across kernels — empty on agents that host no kernel sim
     events: Dict[str, int] = field(default_factory=dict)
@@ -137,6 +144,7 @@ def encode_digest(d: NodeDigest) -> bytes:
     for stage, h in present:
         w.string(stage)
         write_hist(w, h)
+    w.uvarint(d.heads_total)  # r17 trailing field (default_on_eof)
     return w.bytes()
 
 
@@ -166,6 +174,7 @@ def decode_digest(data: bytes) -> NodeDigest:
     for _ in range(r.uvarint()):
         stage = r.string()
         d.stages[stage] = read_hist(r)
+    d.heads_total = r.uvarint() if not r.eof() else 0
     return d
 
 

@@ -483,6 +483,12 @@ class CrdtStore:
         self._read_pool: List[sqlite3.Connection] = []
         self._read_pool_lock = threading.Lock()
         self._read_out = 0  # checked-out read conns (pool gauges)
+        # swap generation (r17 snapshot install): a database-file swap
+        # bumps this; read conns checked out before the swap are
+        # DISCARDED on release instead of re-pooled — a pre-swap conn's
+        # fd points at the replaced inode and would serve stale reads
+        self._read_gen = 0
+        self._read_conn_gen: Dict[int, int] = {}
         self._closed = False
         # resolve (and on first use, compile) the native merge engine NOW:
         # doing it lazily inside _apply_batch would run a g++ subprocess
@@ -606,6 +612,7 @@ class CrdtStore:
             if self._read_pool:
                 conn = self._read_pool.pop()
                 self._read_out += 1
+                self._read_conn_gen[id(conn)] = self._read_gen
                 METRICS.gauge("corro.sqlite.pool.read.connections").set(
                     self._read_out
                 )
@@ -618,6 +625,7 @@ class CrdtStore:
         conn = self.read_conn()
         with self._read_pool_lock:
             self._read_out += 1
+            self._read_conn_gen[id(conn)] = self._read_gen
             METRICS.gauge("corro.sqlite.pool.read.connections").set(
                 self._read_out
             )
@@ -637,12 +645,14 @@ class CrdtStore:
 
         with self._read_pool_lock:
             self._read_out = max(0, self._read_out - 1)
+            gen = self._read_conn_gen.pop(id(conn), self._read_gen)
             METRICS.gauge("corro.sqlite.pool.read.connections").set(
                 self._read_out
             )
             if (
                 not discard
                 and not self._closed
+                and gen == self._read_gen
                 and len(self._read_pool) < self.READ_POOL_MAX
             ):
                 self._read_pool.append(conn)
@@ -700,6 +710,67 @@ class CrdtStore:
             self._read_pool.clear()
         with self._lock:
             self._conn.close()
+
+    # -- live database swap (r17 snapshot bootstrap) -----------------------
+
+    @contextlib.contextmanager
+    def swapped_database(self):
+        """Replace the database FILE underneath a live store
+        (`store/snapshot.py` install): closes every connection, yields
+        for the caller to swap the file, then reopens onto the new one
+        — fresh write connection and watchdog, caches dropped (pk
+        shapes, statement shapes, head versions all describe the OLD
+        database), schema + capture triggers reloaded, the tail of
+        __init__ replayed against the installed snapshot.
+
+        `self._lock` is held for the WHOLE block, so every direct-conn
+        user (maintenance loops, member persistence, bookkeeping reads)
+        parks on the lock and resumes against the new connection —
+        never observes a closed one.  The caller must still have
+        quiesced the write path (the agent's write gate) and run this
+        on ONE worker thread (the RLock is reentrant per-thread).
+        Readers checked out before the swap are discarded on release
+        via the read-generation bump, never re-pooled."""
+        with self._read_pool_lock:
+            for conn in self._read_pool:
+                conn.close()
+            self._read_pool.clear()
+            self._read_gen += 1
+        with self._lock:
+            self._conn.close()
+            try:
+                yield
+            finally:
+                # reopen even when the swap body failed: restore's
+                # os.replace is atomic, so the path holds either the
+                # old or the new database — never a torn one
+                self._reopen_after_swap()
+
+    def _reopen_after_swap(self) -> None:
+        self._conn = sqlite3.connect(
+            self.path, check_same_thread=False, isolation_level=None,
+            uri=True,
+        )
+        self._conn.row_factory = sqlite3.Row
+        self._setup_conn(self._conn)
+        # the old watchdog thread retires on its next closed-conn
+        # interrupt attempt; swaps are rare enough that a fresh
+        # thread per swap is the simple, correct ownership
+        self._watchdog = _InterruptWatchdog(self._conn)
+        self._pk_unpack_cache.clear()
+        self._shape_cache.clear()
+        self._dv_cache.clear()
+        self._load_schema()
+        if self.schema.tables:
+            self._conn.execute("BEGIN IMMEDIATE")
+            try:
+                for t in self.schema.tables.values():
+                    self._drop_triggers(t.name)
+                    self._create_triggers(t)
+                self._conn.execute("COMMIT")
+            except BaseException:
+                _safe_rollback(self._conn)
+                raise
 
     # -- schema ------------------------------------------------------------
 

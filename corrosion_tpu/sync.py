@@ -157,6 +157,36 @@ def state_need_len(state: SyncState) -> int:
     return total + partial_chunks
 
 
+def held_total(bookie: Bookie) -> int:
+    """Versions this node actually HOLDS across all origin actors: head
+    minus needed gaps minus incomplete partials.  The local half of the
+    r17 snapshot-bootstrap gap heuristic (the remote half is a peer's
+    digest-advertised `heads_total` or a probed SyncState)."""
+    total = 0
+    for _aid, booked in bookie.items().items():
+        with booked.read() as bv:
+            last = bv.last()
+            if last is None:
+                continue
+            total += last
+            total -= sum(e - s + 1 for s, e in bv.needed)
+            total -= sum(
+                1 for p in bv.partials.values() if not p.is_complete()
+            )
+    return total
+
+
+def state_held_total(state: SyncState) -> int:
+    """Versions a peer holds, from its sync summary — what a state
+    probe yields when no digest has arrived yet (cold boot)."""
+    total = sum(state.heads.values())
+    total -= sum(
+        e - s + 1 for ranges in state.need.values() for s, e in ranges
+    )
+    total -= sum(len(v) for v in state.partial_need.values())
+    return total
+
+
 def chunk_range(start: int, end: int, size: int) -> List[Range]:
     """Split an inclusive version range into ≤size chunks
     (peer/mod.rs:986-1004)."""

@@ -609,6 +609,67 @@ def decode_bi_payload(data: bytes) -> Tuple[ActorId, SyncTraceContext, ClusterId
     return actor_id, trace, cluster_id
 
 
+# -- BiPayloadV1::SnapshotReq (r17 catch-up plane, version-gated) ----------
+#
+# A SECOND bi-stream op beside SyncStart: a cold node requesting the
+# serving peer's cached compressed snapshot (agent/catchup.py).  The
+# gate is structural: variant tag 1 makes a pre-r17 server raise the
+# same "unknown BiPayload variant" ValueError its serve path already
+# maps to a counted, closed session — the requester reads EOF and falls
+# back to pure delta sync.  New servers keep decoding tag-0 SyncStart
+# frames from old clients unchanged.
+
+_BI_SYNC_START = 0
+_BI_SNAPSHOT_REQ = 1
+
+
+@dataclass(frozen=True)
+class SnapshotReq:
+    """What a cold node sends: who it is, which cluster, and the schema
+    generation it runs (the server refuses on sha mismatch instead of
+    shipping an uninstallable snapshot)."""
+
+    actor_id: ActorId
+    schema_sha: bytes
+    cluster_id: ClusterId = ClusterId(0)
+
+
+def encode_bi_payload_snapshot_req(req: SnapshotReq) -> bytes:
+    w = Writer()
+    w.u32(0)  # BiPayload::V1
+    w.u32(_BI_SNAPSHOT_REQ)  # BiPayloadV1::SnapshotReq (r17)
+    w.raw(req.actor_id.bytes16)
+    w.vec_u8(req.schema_sha)
+    w.u16(req.cluster_id.value)
+    return w.bytes()
+
+
+def decode_bi_payload_any(data: bytes):
+    """Dispatching decoder for the bi-stream's first frame:
+    ("sync", (actor_id, trace, cluster_id)) or ("snapshot", SnapshotReq).
+    Unknown variants raise ValueError (the version gate)."""
+    r = Reader(data)
+    if r.u32() != 0:
+        raise ValueError("unknown BiPayload version")
+    tag = r.u32()
+    if tag == _BI_SYNC_START:
+        actor_id = ActorId(r.raw(16))
+        trace = SyncTraceContext(
+            traceparent=r.opt(r.string) if not r.eof() else None,
+            tracestate=r.opt(r.string) if not r.eof() else None,
+        )
+        cluster_id = ClusterId(r.u16()) if not r.eof() else ClusterId(0)
+        return "sync", (actor_id, trace, cluster_id)
+    if tag == _BI_SNAPSHOT_REQ:
+        actor_id = ActorId(r.raw(16))
+        sha = r.vec_u8()
+        cluster_id = ClusterId(r.u16()) if not r.eof() else ClusterId(0)
+        return "snapshot", SnapshotReq(
+            actor_id=actor_id, schema_sha=sha, cluster_id=cluster_id
+        )
+    raise ValueError("unknown BiPayload variant")
+
+
 # -- Sync messages (sync.rs) ----------------------------------------------
 
 

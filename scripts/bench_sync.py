@@ -1,0 +1,387 @@
+"""Cold-node catch-up bench: SYNC_SCALE.json (r17).
+
+The sync plane's scale story, measured: a cold node joins a cluster
+whose origin holds a 100k- or 1M-row table, under {quiet,
+concurrent-write-fire}, with the snapshot bootstrap ON vs OFF (pure
+delta — the A/B axis `[sync] snapshot=false` provides), plus the chaos
+loop: partition → heal → catch-up → converge with the cluster
+observatory's divergence detector as the convergence oracle.
+
+Convergence bar per rung: the cold node's row count equals the
+origin's, its bookie reports no needed gaps, and the CRDT clock-row
+count matches (nothing lost, nothing left buffered).  Under fire the
+writer stops first, then the bar must close.
+
+Margin discipline (r15 memory): this 1-core host drifts ±30% between
+runs — the banked record carries wall-clock numbers as EVIDENCE, but
+the tier-1 guard (tests/test_sync_bank.py) pins deterministic facts
+(full convergence, snapshot-vs-delta speedup > 1 on the large rung,
+zero divergence after heal), never wall-clock absolutes.
+
+Usage: python scripts/bench_sync.py [--quick]   (--quick: 100k only)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from corrosion_tpu.runtime import jaxenv  # noqa: E402
+
+jaxenv.force_cpu_inprocess()
+
+from corrosion_tpu.agent.run import (  # noqa: E402
+    make_broadcastable_changes,
+    setup,
+    shutdown,
+    run as run_agent,
+)
+from corrosion_tpu.net.mem import MemNetwork  # noqa: E402
+from corrosion_tpu.runtime.metrics import METRICS  # noqa: E402
+from corrosion_tpu.sync import held_total  # noqa: E402
+
+from tests.test_agent import (  # noqa: E402
+    FAST_SWIM,
+    TEST_SCHEMA,
+    fast_config,
+    wait_until,
+)
+
+ROWS_PER_TX = 2000  # one version per tx: 1M rows = 500 versions
+FIRE_ROWS = 10  # concurrent writer: rows per tx
+FIRE_PERIOD = 0.05  # seconds between fire txs
+
+_MEASURED_FILES = (
+    "corrosion_tpu/store/snapshot.py",
+    "corrosion_tpu/agent/catchup.py",
+    "corrosion_tpu/agent/syncer.py",
+    "corrosion_tpu/sync.py",
+    "corrosion_tpu/store/restore.py",
+)
+
+
+def _code_fingerprint() -> dict:
+    out = {}
+    for rel in _MEASURED_FILES:
+        try:
+            with open(os.path.join(REPO, rel), "rb") as f:
+                out[rel] = hashlib.sha256(f.read()).hexdigest()[:12]
+        except OSError:
+            out[rel] = "missing"
+    return out
+
+
+def peek(name: str, **labels) -> float:
+    for _kind, sname, slabels, value in METRICS.snapshot():
+        if sname == name and slabels == labels:
+            return value
+    return 0.0
+
+
+def count_rows(agent) -> int:
+    conn = agent.store.read_conn()
+    try:
+        return conn.execute("SELECT COUNT(*) FROM tests").fetchone()[0]
+    finally:
+        conn.close()
+
+
+def clock_count(agent) -> int:
+    conn = agent.store.read_conn()
+    try:
+        return conn.execute(
+            "SELECT COUNT(*) FROM tests__crdt_clock"
+        ).fetchone()[0]
+    finally:
+        conn.close()
+
+
+async def boot(net, name, bootstrap=(), tune=None, swim=None):
+    cfg = fast_config(name, bootstrap)
+    cfg.perf.sync_interval_min_secs = 0.2
+    cfg.perf.sync_interval_max_secs = 1.0
+    cfg.cluster.digest_interval_secs = 0.5
+    if tune:
+        tune(cfg)
+    agent = await setup(cfg, network=net)
+    agent.membership.config = swim or FAST_SWIM
+    agent.store.apply_schema_sql(TEST_SCHEMA)
+    await run_agent(agent)
+    return agent
+
+
+async def load_rows(agent, n_rows: int, base: int = 0) -> int:
+    """`n_rows` rows in ROWS_PER_TX-row transactions (one version
+    each); returns versions written."""
+    versions = 0
+    for start in range(base, base + n_rows, ROWS_PER_TX):
+        count = min(ROWS_PER_TX, base + n_rows - start)
+        await make_broadcastable_changes(
+            agent,
+            lambda tx, s=start, c=count: [
+                tx.execute(
+                    "INSERT OR REPLACE INTO tests (id, text) VALUES (?, ?)",
+                    (s + k, f"row-{s + k}"),
+                )
+                for k in range(c)
+            ],
+        )
+        versions += 1
+    return versions
+
+
+async def run_rung(n_rows: int, fire: bool, mode: str, seed: int) -> dict:
+    """One cold-join measurement; mode is "snapshot" or "delta"."""
+    assert mode in ("snapshot", "delta")
+    net = MemNetwork(seed=seed)
+    a = await boot(net, "origin")
+    t_load = time.monotonic()
+    await load_rows(a, n_rows)
+    load_s = time.monotonic() - t_load
+    await asyncio.sleep(1.0)  # retire the broadcast backlog
+
+    installs0 = peek("corro.snapshot.install.total")
+    delta0 = peek("corro.sync.client.changes.received")
+    waves0 = peek("corro.sync.resume.waves.total")
+
+    def tune(cfg):
+        cfg.sync.snapshot = mode == "snapshot"
+        # the load writes ROWS_PER_TX-row versions: 100k rows = 50
+        # versions, so the heuristic threshold sits below that
+        cfg.sync.snapshot_min_gap_versions = 20
+
+    fire_task = None
+    fire_state = {"rows": 0, "stop": False}
+
+    async def fire_writer():
+        base = 10_000_000
+        while not fire_state["stop"]:
+            await make_broadcastable_changes(
+                a,
+                lambda tx: [
+                    tx.execute(
+                        "INSERT OR REPLACE INTO tests (id, text)"
+                        " VALUES (?, ?)",
+                        (base + fire_state["rows"] + k, "fire"),
+                    )
+                    for k in range(FIRE_ROWS)
+                ],
+            )
+            fire_state["rows"] += FIRE_ROWS
+            await asyncio.sleep(FIRE_PERIOD)
+
+    t0 = time.monotonic()
+    c = await boot(net, "cold", bootstrap=("origin",), tune=tune)
+    if fire:
+        fire_task = asyncio.ensure_future(fire_writer())
+    try:
+        def caught_up() -> bool:
+            if not fire:
+                return count_rows(c) >= n_rows
+            # under fire the target MOVES: a caught-up node rides the
+            # live stream a few in-flight transactions behind, and
+            # instantaneous row equality may never be sampled while the
+            # writer runs — "caught" = within a handful of fire txs;
+            # the writer then stops and the EXACT bar below must close
+            return count_rows(a) - count_rows(c) <= 5 * FIRE_ROWS
+
+        # generous cap: the 1M delta rung streams every change
+        assert await wait_until(caught_up, timeout=3600, step=0.25), (
+            f"cold node stalled at {count_rows(c)}"
+        )
+        if fire:
+            fire_state["stop"] = True
+            await fire_task
+            fire_task = None
+        # final bar: rows equal, no gaps, clock rows equal
+        def fully_converged() -> bool:
+            if count_rows(c) != count_rows(a):
+                return False
+            if held_total(c.bookie) != held_total(a.bookie):
+                return False
+            return clock_count(c) == clock_count(a)
+
+        assert await wait_until(fully_converged, timeout=600, step=0.25), (
+            f"final convergence stalled: rows {count_rows(c)}/"
+            f"{count_rows(a)} held {held_total(c.bookie)}/"
+            f"{held_total(a.bookie)}"
+        )
+        wall = time.monotonic() - t0
+        rec = {
+            "rung": f"sync-{n_rows // 1000}k-"
+            f"{'fire' if fire else 'quiet'}-{mode}",
+            "rows": n_rows,
+            "fire": fire,
+            "fire_rows_written": fire_state["rows"],
+            "mode": mode,
+            "versions": (n_rows + ROWS_PER_TX - 1) // ROWS_PER_TX,
+            "load_wall_s": round(load_s, 2),
+            "wall_to_converged_s": round(wall, 2),
+            "converged": True,
+            "rows_final": count_rows(c),
+            "clock_rows_final": clock_count(c),
+            "snapshot_installed": int(
+                peek("corro.snapshot.install.total") - installs0
+            ),
+            "delta_changes_received": int(
+                peek("corro.sync.client.changes.received") - delta0
+            ),
+            "resume_waves": int(
+                peek("corro.sync.resume.waves.total") - waves0
+            ),
+        }
+        if mode == "snapshot":
+            rec["snapshot_raw_bytes"] = c.catchup_census.get("raw_bytes", 0)
+            rec["snapshot_install_s"] = c.catchup_census.get("seconds")
+        return rec
+    finally:
+        if fire_task is not None:
+            fire_state["stop"] = True
+            fire_task.cancel()
+        await shutdown(c)
+        await shutdown(a)
+
+
+async def chaos_phase(seed: int = 29) -> dict:
+    """partition → heal → catch-up → converge, with the observatory's
+    divergence detector as the oracle: the partition must OPEN a
+    divergence episode, and after heal + catch-up the detector must
+    report one view group, no silent nodes, episode closed — zero
+    divergence — while every replica's tables match the origin's."""
+    from corrosion_tpu.agent.membership import SwimConfig
+
+    net = MemNetwork(seed=seed)
+
+    def tune(cfg):
+        # circuits open DURING the partition (the breaker working); a
+        # short reset keeps the post-heal catch-up prompt — the knob an
+        # operator running frequent-partition topologies would set
+        cfg.sync.circuit_reset_secs = 3.0
+
+    # suspicion window longer than the partition: members stay (at
+    # worst SUSPECT, refuted on heal) so the measured catch-up is the
+    # SYNC plane's, not a full SWIM eviction/rejoin cycle; divergence
+    # detection rides the digest-silence signal
+    gentle = SwimConfig(probe_period=0.25, probe_rtt=0.1, suspicion_mult=4)
+    a = await boot(net, "chaos-a", tune=tune, swim=gentle)
+    b = await boot(net, "chaos-b", bootstrap=("chaos-a",), tune=tune,
+                   swim=gentle)
+    c = await boot(net, "chaos-c", bootstrap=("chaos-a",), tune=tune,
+                   swim=gentle)
+    try:
+        await load_rows(a, 10_000)
+        assert await wait_until(
+            lambda: count_rows(b) == 10_000 and count_rows(c) == 10_000,
+            timeout=300, step=0.25,
+        ), "pre-chaos convergence stalled"
+
+        # partition C away and keep writing on the majority side
+        t0 = time.monotonic()
+        for name in ("chaos-a", "chaos-b"):
+            net.partition(name, "chaos-c")
+        await load_rows(a, 4_000, base=10_000)
+
+        def detected() -> bool:
+            return a.observatory.check_divergence()["episode_open"]
+
+        assert await wait_until(detected, timeout=60, step=0.25), (
+            "divergence never detected during partition"
+        )
+        detect_s = time.monotonic() - t0
+
+        for name in ("chaos-a", "chaos-b"):
+            net.heal(name, "chaos-c")
+        t_heal = time.monotonic()
+
+        def converged() -> bool:
+            return (
+                count_rows(c) == count_rows(a) == 14_000
+                and count_rows(b) == 14_000
+                and held_total(c.bookie) == held_total(a.bookie)
+            )
+
+        assert await wait_until(converged, timeout=600, step=0.25), (
+            f"post-heal convergence stalled: {count_rows(c)}"
+        )
+        catchup_s = time.monotonic() - t_heal
+
+        def divergence_zero() -> bool:
+            v = a.observatory.check_divergence()
+            return (
+                not v["divergent"]
+                and not v["episode_open"]
+                and v["groups"] == 1
+                and not v["silent"]
+            )
+
+        assert await wait_until(divergence_zero, timeout=120, step=0.5), (
+            f"divergence never closed: {a.observatory.check_divergence()}"
+        )
+        verdict = a.observatory.check_divergence()
+        return {
+            "rows": 14_000,
+            "partition_writes": 4_000,
+            "detect_s": round(detect_s, 2),
+            "catchup_s": round(catchup_s, 2),
+            "divergence_zero": True,
+            "episodes": verdict["episodes"],
+            "final_groups": verdict["groups"],
+        }
+    finally:
+        await shutdown(c)
+        await shutdown(b)
+        await shutdown(a)
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    sizes = [100_000] if quick else [100_000, 1_000_000]
+    rungs = []
+    for n_rows in sizes:
+        plan = [
+            (n_rows, False, "delta"),
+            (n_rows, False, "snapshot"),
+            (n_rows, True, "snapshot"),
+        ]
+        if n_rows == 100_000:
+            plan.insert(2, (n_rows, True, "delta"))
+        for i, (rows, fire, mode) in enumerate(plan):
+            t0 = time.monotonic()
+            rec = asyncio.new_event_loop().run_until_complete(
+                run_rung(rows, fire, mode, seed=17 + i)
+            )
+            rec["rung_wall_s"] = round(time.monotonic() - t0, 1)
+            rungs.append(rec)
+            print(json.dumps(rec), flush=True)
+    # in-band speedup on the largest rung measured (quiet A/B)
+    big = max(sizes)
+    d = next(r for r in rungs if r["rung"] == f"sync-{big // 1000}k-quiet-delta")
+    s = next(
+        r for r in rungs if r["rung"] == f"sync-{big // 1000}k-quiet-snapshot"
+    )
+    speedup = d["wall_to_converged_s"] / max(1e-9, s["wall_to_converged_s"])
+    chaos = asyncio.new_event_loop().run_until_complete(chaos_phase())
+    print(json.dumps({"chaos": chaos}), flush=True)
+    record = {
+        "rungs": rungs,
+        "chaos": chaos,
+        "large_rung_rows": big,
+        "snapshot_vs_delta_speedup": round(speedup, 2),
+        "code_sha": _code_fingerprint(),
+        "measured_at": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
+    }
+    path = os.path.join(REPO, "SYNC_SCALE.json")
+    with open(path, "w") as f:
+        json.dump(record, f, indent=1)
+    print(f"wrote {path}: speedup {record['snapshot_vs_delta_speedup']}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -489,7 +489,7 @@ def main() -> None:
         "flaky_node": flaky,
     })
     print(json.dumps({"metric": "chaos_soak", "runs": len(runs),
-                      "all_phases": all(len(r["phases"]) == 5 for r in runs)}))
+                      "all_phases": all(len(r["phases"]) == 6 for r in runs)}))
 
 
 if __name__ == "__main__":

@@ -201,6 +201,24 @@ async def run_soak(seed: int) -> dict:
             lambda: tuple(count_rows(ag) for ag in agents.values()),
         )
         summary["phases"].append({"phase": "post-chaos-write", "rows": want})
+
+        # phase 6 (r17): a COLD node joins after every write happened —
+        # its whole table can only arrive through the pull plane (no
+        # broadcast carries old rows), so the 'syncs with other nodes'
+        # coverage fires DETERMINISTICALLY here instead of racing the
+        # broadcast backlog for the single partition-repair row (the
+        # pre-r17 soak's one organic sync window, which full-suite load
+        # could let the backlog win — the r16/r17 in-suite flake)
+        agents["chaos-cold"] = cold = await boot_one(
+            "chaos-cold", bootstrap=tuple(rng.sample(names[:3], 2))
+        )
+        assert await wait_progress(
+            lambda: count_rows(cold) == want,
+            lambda: (count_rows(cold), cold.membership.cluster_size,
+                     cold.membership._probe_no),
+            stall=60.0, cap=300.0,
+        ), f"cold join stalled at {count_rows(cold)}/{want}"
+        summary["phases"].append({"phase": "cold-join-catchup", "rows": want})
     finally:
         from corrosion_tpu.agent.run import shutdown as _sd
 
@@ -251,4 +269,4 @@ def test_chaos_soak_strict_invariants(monkeypatch):
     summary = asyncio.new_event_loop().run_until_complete(
         asyncio.wait_for(run_soak(seed=1337), 1200)
     )
-    assert len(summary["phases"]) == 5
+    assert len(summary["phases"]) == 6

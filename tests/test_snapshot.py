@@ -1,0 +1,323 @@
+"""Snapshot-plane unit tests (r17 catch-up round): container codec,
+schema-sha gate, build/install roundtrip through the locked-swap path,
+the version-gated SnapshotReq peer op, cache staleness, and the digest
+`heads_total` trailing-field tolerance.
+
+All sqlite work is tiny-shape file dbs (tmp_path) — the e2e agent-level
+scenarios live in test_sync_resume.py."""
+
+import os
+import sqlite3
+import zlib
+
+import pytest
+
+from corrosion_tpu.store import snapshot as snap
+from corrosion_tpu.store.bookkeeping import Bookie
+from corrosion_tpu.store.crdt import CrdtStore
+from corrosion_tpu.store.schema import parse_sql
+from corrosion_tpu.types.base import Timestamp
+
+SCHEMA = "CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, text TEXT);"
+
+# clock-table parity EXCLUDES the ts column: it is origin-local
+# bookkeeping (a replica applying remote changes stores ts=0 on the
+# standing delta path), so it legitimately differs by route; the CRDT
+# merge state is the other six columns
+CLOCK_SQL = (
+    "SELECT pk, cid, col_version, db_version, seq, site_id"
+    " FROM tests__crdt_clock ORDER BY pk, cid, db_version"
+)
+
+
+def seeded_store(path, n_versions=12, schema=SCHEMA):
+    store = CrdtStore(str(path))
+    store.apply_schema_sql(schema)
+    for i in range(n_versions):
+        with store.write_tx(Timestamp.now()) as tx:
+            tx.execute(
+                "INSERT OR REPLACE INTO tests (id, text) VALUES (?, ?)",
+                (i, f"v{i}"),
+            )
+    return store
+
+
+def store_bookie(store) -> Bookie:
+    bookie = Bookie()
+    for aid in store.booked_actor_ids():
+        bookie.insert(aid, store.load_booked_versions(aid))
+    return bookie
+
+
+def build(store, out_path, chunk_bytes=4096):
+    return snap.build_snapshot_file(
+        store.path,
+        str(out_path),
+        store.schema,
+        store.site_id.bytes16,
+        snap.bookie_watermark(store_bookie(store)),
+        chunk_bytes=chunk_bytes,
+    )
+
+
+# -- codec ------------------------------------------------------------------
+
+
+def test_header_codec_roundtrip():
+    h = snap.SnapshotHeader(
+        schema_sha=b"\xab" * 32,
+        site_id=b"\x07" * 16,
+        wall=123.5,
+        raw_bytes=1 << 30,
+        chunk_bytes=65536,
+        watermark={b"\x01" * 16: [(1, 10), (12, 99)], b"\x02" * 16: [(5, 5)]},
+    )
+    h2 = snap.decode_header(snap.encode_header(h))
+    assert h2 == h
+    assert h2.watermark_total() == 10 + 88 + 1
+
+
+def test_snapshot_msg_codec_roundtrip():
+    h = snap.SnapshotHeader(
+        schema_sha=b"\x01" * 32, site_id=b"\x02" * 16, wall=1.0,
+        raw_bytes=10, chunk_bytes=4,
+    )
+    assert snap.decode_snapshot_msg(snap.encode_snapshot_msg_header(h)) == h
+    z = zlib.compress(b"hello world")
+    assert snap.decode_snapshot_msg(snap.encode_snapshot_msg_chunk(z)) == z
+    d = snap.SnapshotDone(3, 100, 42)
+    assert snap.decode_snapshot_msg(snap.encode_snapshot_msg_done(d)) == d
+    assert (
+        snap.decode_snapshot_msg(
+            snap.encode_snapshot_msg_rejection(snap.REJECT_SCHEMA)
+        )
+        == snap.REJECT_SCHEMA
+    )
+
+
+def test_schema_sha_canonical_and_gated():
+    a = parse_sql(SCHEMA)
+    b = parse_sql(
+        "create   table tests (id INTEGER NOT NULL PRIMARY KEY,"
+        " text TEXT)  ;"
+    )
+    # whitespace/case-insensitive canonicalization... but sqlite keeps
+    # the raw DDL, so normalization is what makes these agree
+    assert snap.schema_sha(a) == snap.schema_sha(b)
+    c = parse_sql(SCHEMA + "\nCREATE TABLE more (id INTEGER PRIMARY KEY);")
+    assert snap.schema_sha(a) != snap.schema_sha(c)
+    # runtime-owned tables (the SLO canary) are excludable from the gate
+    assert snap.schema_sha(c, exclude=("more",)) == snap.schema_sha(a)
+
+
+def test_bi_payload_snapshot_req_version_gate():
+    from corrosion_tpu.types.actor import ActorId, ClusterId
+    from corrosion_tpu.types.codec import (
+        SnapshotReq,
+        decode_bi_payload,
+        decode_bi_payload_any,
+        encode_bi_payload_snapshot_req,
+        encode_bi_payload_sync_start,
+    )
+
+    req = SnapshotReq(
+        actor_id=ActorId(b"\x09" * 16),
+        schema_sha=b"\x11" * 32,
+        cluster_id=ClusterId(3),
+    )
+    data = encode_bi_payload_snapshot_req(req)
+    kind, decoded = decode_bi_payload_any(data)
+    assert kind == "snapshot" and decoded == req
+    # the version gate: a pre-r17 decoder refuses the new op outright
+    # (its serve path maps ValueError to a counted, closed session)
+    with pytest.raises(ValueError):
+        decode_bi_payload(data)
+    # and the dispatching decoder keeps parsing old SyncStart frames
+    start = encode_bi_payload_sync_start(ActorId(b"\x01" * 16))
+    kind, payload = decode_bi_payload_any(start)
+    assert kind == "sync" and payload[0] == ActorId(b"\x01" * 16)
+
+
+def test_digest_heads_total_rides_and_tolerates_eof():
+    from corrosion_tpu.runtime.digest import (
+        NodeDigest,
+        decode_digest,
+        encode_digest,
+    )
+    from corrosion_tpu.types.codec import Writer
+
+    d = NodeDigest(
+        actor_id=b"\x05" * 16, seq=3, wall=10.0, view_hash=7, view_size=2,
+        heads_total=12345,
+    )
+    enc = encode_digest(d)
+    assert decode_digest(enc).heads_total == 12345
+    # a pre-r17 encoder never writes the trailing field: strip exactly
+    # the trailing uvarint(12345) and the decoder must default to 0
+    w = Writer()
+    w.uvarint(12345)
+    old_bytes = enc[: -len(w.bytes())]
+    assert decode_digest(old_bytes).heads_total == 0
+
+
+# -- build + install --------------------------------------------------------
+
+
+def test_build_install_roundtrip_preserves_state(tmp_path):
+    a = seeded_store(tmp_path / "a.db")
+    out = tmp_path / "a.snapshot"
+    header = build(a, out)
+    assert header.raw_bytes > 0
+    assert header.watermark_total() == 12
+    assert header.schema_sha == snap.schema_sha(a.schema)
+
+    b = CrdtStore(str(tmp_path / "b.db"))
+    b.apply_schema_sql(SCHEMA)
+    b_site = b.site_id
+    with b.swapped_database():
+        res = snap.install_snapshot_file(
+            str(out), b.path,
+            expect_schema_sha=snap.schema_sha(b.schema),
+            self_site_id=b_site.bytes16,
+        )
+    assert res.watermark_versions == 12
+
+    # user rows + CRDT merge state identical; identity preserved;
+    # per-node member state scrubbed (backup-plane contract)
+    rows_a = a._conn.execute("SELECT * FROM tests ORDER BY id").fetchall()
+    rows_b = b._conn.execute("SELECT * FROM tests ORDER BY id").fetchall()
+    assert [tuple(r) for r in rows_a] == [tuple(r) for r in rows_b]
+    ca = [tuple(r) for r in a._conn.execute(CLOCK_SQL)]
+    cb = [tuple(r) for r in b._conn.execute(CLOCK_SQL)]
+    assert ca == cb and len(ca) > 0
+    assert b.site_id == b_site
+    row = b._conn.execute("SELECT site_id FROM __crdt_site").fetchone()
+    assert bytes(row["site_id"]) == b_site.bytes16
+    assert (
+        b._conn.execute("SELECT COUNT(*) FROM __corro_members").fetchone()[0]
+        == 0
+    )
+    # the installed store keeps writing: post-swap tx gets the next
+    # version for b's OWN site, not the builder's
+    with b.write_tx(Timestamp.now()) as tx:
+        tx.execute(
+            "INSERT OR REPLACE INTO tests (id, text) VALUES (999, 'post')"
+        )
+    assert b.db_version_for(b_site) == 1
+    a.close()
+    b.close()
+
+
+def test_install_refuses_schema_mismatch(tmp_path):
+    a = seeded_store(tmp_path / "a.db", n_versions=3)
+    out = tmp_path / "a.snapshot"
+    build(a, out)
+    c = CrdtStore(str(tmp_path / "c.db"))
+    c.apply_schema_sql(
+        "CREATE TABLE other (id INTEGER NOT NULL PRIMARY KEY, v TEXT);"
+    )
+    before = sqlite3.connect(c.path).execute(
+        "SELECT COUNT(*) FROM sqlite_master"
+    ).fetchone()[0]
+    with pytest.raises(snap.SnapshotSchemaMismatch):
+        snap.install_snapshot_file(
+            str(out), c.path,
+            expect_schema_sha=snap.schema_sha(c.schema),
+            self_site_id=c.site_id.bytes16,
+        )
+    # refused BEFORE the swap: the target database is untouched
+    after = sqlite3.connect(c.path).execute(
+        "SELECT COUNT(*) FROM sqlite_master"
+    ).fetchone()[0]
+    assert after == before
+    a.close()
+    c.close()
+
+
+def test_torn_snapshot_detected(tmp_path):
+    a = seeded_store(tmp_path / "a.db", n_versions=3)
+    out = tmp_path / "a.snapshot"
+    build(a, out, chunk_bytes=1024)
+    data = open(out, "rb").read()
+    torn = tmp_path / "torn.snapshot"
+    torn.write_bytes(data[: len(data) // 2])
+    with pytest.raises(snap.SnapshotError):
+        snap.decompress_snapshot_file(str(torn), str(tmp_path / "x.db"))
+    a.close()
+
+
+def test_watermark_excludes_gaps_and_incomplete_partials():
+    from corrosion_tpu.store.bookkeeping import (
+        NULL_GAP_STORE,
+        PartialVersion,
+    )
+    from corrosion_tpu.types.actor import ActorId
+    from corrosion_tpu.types.rangeset import RangeSet
+
+    origin = ActorId(b"\x03" * 16)
+    bookie = Bookie()
+    with bookie.ensure(origin).write() as bv:
+        s = bv.snapshot()
+        s.insert_db(NULL_GAP_STORE, RangeSet([(1, 4), (8, 10)]))
+        bv.commit_snapshot(s)
+        bv.insert_partial(
+            9,
+            PartialVersion(seqs=RangeSet([(0, 2)]), last_seq=9,
+                           ts=Timestamp(1)),
+        )
+    wm = snap.bookie_watermark(bookie)
+    assert wm == {origin.bytes16: [(1, 4), (8, 8), (10, 10)]}
+
+
+def test_local_covered_guard_own_origin_only():
+    """The install guard refuses only when versions WE originated are
+    missing from the watermark (irreplaceable); remote-origin overhang
+    is re-fetchable via the top-up and must not block a live-fire
+    bootstrap."""
+    from types import SimpleNamespace
+
+    from corrosion_tpu.agent.catchup import _local_covered_by
+    from corrosion_tpu.store.bookkeeping import NULL_GAP_STORE
+    from corrosion_tpu.types.actor import ActorId
+    from corrosion_tpu.types.rangeset import RangeSet
+
+    me = ActorId(b"\x01" * 16)
+    other = ActorId(b"\x02" * 16)
+    bookie = Bookie()
+    for who, upto in ((me, 3), (other, 50)):
+        with bookie.ensure(who).write() as bv:
+            s = bv.snapshot()
+            s.insert_db(NULL_GAP_STORE, RangeSet([(1, upto)]))
+            bv.commit_snapshot(s)
+    agent = SimpleNamespace(bookie=bookie, actor_id=me)
+    covered = snap.SnapshotHeader(
+        schema_sha=b"", site_id=other.bytes16, wall=0.0, raw_bytes=0,
+        chunk_bytes=1,
+        # our 3 own versions covered; `other`'s watermark STALE (40<50)
+        watermark={me.bytes16: [(1, 3)], other.bytes16: [(1, 40)]},
+    )
+    assert _local_covered_by(agent, covered) is True
+    uncovered = snap.SnapshotHeader(
+        schema_sha=b"", site_id=other.bytes16, wall=0.0, raw_bytes=0,
+        chunk_bytes=1,
+        watermark={me.bytes16: [(1, 2)], other.bytes16: [(1, 50)]},
+    )
+    assert _local_covered_by(agent, uncovered) is False
+
+
+def test_cache_staleness_window(tmp_path):
+    a = seeded_store(tmp_path / "a.db", n_versions=3)
+    cache = snap.SnapshotCache(a.path)
+    bookie = store_bookie(a)
+    h1 = cache.ensure_fresh(a.schema, a.site_id.bytes16, bookie, 60.0)
+    built1 = cache.built_mono
+    # within the window: the SAME build serves every requester
+    h2 = cache.ensure_fresh(a.schema, a.site_id.bytes16, bookie, 60.0)
+    assert h2 is h1 and cache.built_mono == built1
+    # past the window: rebuilt
+    cache.built_mono -= 120.0
+    h3 = cache.ensure_fresh(a.schema, a.site_id.bytes16, bookie, 60.0)
+    assert h3 is not h1 and cache.built_mono != built1
+    cache.drop()
+    assert not os.path.exists(cache.path)
+    a.close()

@@ -10,7 +10,7 @@ Three layers, mirroring what the suite promises:
    `# corro: noqa[rule]` comment suppresses (proving the whole
    driver-side filter chain, not just the checker).
 3. THE FOLD IS LOSSLESS: the metrics lint folded into the framework
-   still reports the same 192 literal series + 2 wildcard sites in both
+   still reports the same 209 literal series + 2 wildcard sites in both
    directions, and the `scripts/lint_metrics.py` shim keeps its API.
 
 All pure-AST: no jax tracing, no sqlite, no network — the gate must
@@ -696,16 +696,17 @@ def test_capture_parity_real_tree_is_clean():
 
 
 def test_metrics_fold_reports_same_inventory():
-    """The lint_metrics fold is lossless: same 192 literal series (183
-    at r15 + the 9 r16 serving-plane/broadcast-chunking series), same
-    2 wildcard sites, both directions clean, via BOTH the framework
-    checker and the back-compat shim."""
+    """The lint_metrics fold is lossless: same 209 literal series (192
+    at r16 + the 17 r17 catch-up-plane series — corro.snapshot.* and
+    the sync resume/circuit counters), same 2 wildcard sites, both
+    directions clean, via BOTH the framework checker and the
+    back-compat shim."""
     import lint_metrics
 
     assert MetricsDocChecker().run(AnalysisContext(REPO)) == []
     assert lint_metrics.lint() == []
     literals, wildcards = lint_metrics.scan_call_sites()
-    assert len(literals) == 192
+    assert len(literals) == 209
     assert len(wildcards) == 2
     names = lint_metrics.parse_components_table()
     assert len(names) == len(set(names))

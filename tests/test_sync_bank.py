@@ -1,0 +1,130 @@
+"""Banked-record guard for SYNC_SCALE.json (r17 catch-up round).
+
+`scripts/bench_sync.py` banks the cold-node catch-up ladder — a cold
+node joining against a 100k/1M-row origin under {quiet, concurrent-
+write-fire}, snapshot bootstrap vs pure delta A/B — plus the chaos
+loop: partition → heal → catch-up → converge with the cluster
+observatory's divergence detector as the oracle.  This guard pins the
+artifact's shape and the round's acceptance bars (ISSUE 12).
+
+Margin discipline (r15 memory): this 1-core host drifts ±30% between
+runs, so the bars are deterministic facts — full convergence, the
+snapshot path actually taken, zero divergence — plus ONE ratio with a
+wide margin: snapshot must beat pure delta on the large rung (measured
+~7-8x; the bar is >1, an order of magnitude of headroom)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+PATH = os.path.join(os.path.dirname(__file__), "..", "SYNC_SCALE.json")
+
+RUNGS_100K = [
+    "sync-100k-quiet-delta",
+    "sync-100k-quiet-snapshot",
+    "sync-100k-fire-delta",
+    "sync-100k-fire-snapshot",
+]
+RUNGS_1M = [
+    "sync-1000k-quiet-delta",
+    "sync-1000k-quiet-snapshot",
+    "sync-1000k-fire-snapshot",
+]
+
+
+@pytest.fixture(scope="module")
+def banked() -> dict:
+    with open(PATH) as f:
+        return json.load(f)
+
+
+@pytest.fixture(scope="module")
+def rungs(banked) -> dict:
+    return {r["rung"]: r for r in banked["rungs"]}
+
+
+def test_ladder_shape(rungs):
+    for rung in RUNGS_100K + RUNGS_1M:
+        assert rung in rungs, f"missing rung {rung}"
+
+
+def test_records_are_sha_stamped(banked):
+    sha = banked.get("code_sha")
+    assert sha and "corrosion_tpu/store/snapshot.py" in sha
+    assert "corrosion_tpu/agent/catchup.py" in sha
+    assert all(v != "missing" for v in sha.values()), sha
+    assert banked.get("measured_at")
+
+
+def test_every_rung_fully_converged(rungs):
+    """The bar is FULL convergence, fire included: rows equal, bookie
+    gap-free, clock rows equal (the bench asserts those before banking
+    `converged`) — and the row counts in-band must be self-consistent
+    (2 clock rows per row: one cell + one create sentinel)."""
+    for name, rec in rungs.items():
+        assert rec["converged"] is True, name
+        assert rec["rows_final"] >= rec["rows"], name
+        assert rec["clock_rows_final"] == 2 * rec["rows_final"], name
+        if rec["fire"]:
+            assert rec["fire_rows_written"] > 0, name
+            assert rec["rows_final"] == (
+                rec["rows"] + rec["fire_rows_written"]
+            ), name
+
+
+def test_snapshot_rungs_took_the_snapshot_path(rungs):
+    """A/B integrity: snapshot-mode rungs really installed one
+    snapshot; delta-mode rungs never did; and the quiet-snapshot rungs
+    moved (almost) nothing over the change stream — the transfer was
+    the compressed container plus watermark top-up."""
+    for name, rec in rungs.items():
+        if rec["mode"] == "snapshot":
+            assert rec["snapshot_installed"] == 1, name
+            assert rec["snapshot_raw_bytes"] > 0, name
+        else:
+            assert rec["snapshot_installed"] == 0, name
+            # pure delta replays the table over the change stream: ~2
+            # changes per row (cell + create sentinel), with a margin
+            # for the few versions the broadcast backlog delivers
+            assert rec["delta_changes_received"] >= 1.5 * rec["rows"], name
+    for name in ("sync-100k-quiet-snapshot", "sync-1000k-quiet-snapshot"):
+        rec = rungs[name]
+        assert rec["delta_changes_received"] < rec["rows"], name
+
+
+def test_snapshot_beats_delta_on_large_rung(banked, rungs):
+    """ISSUE 12 acceptance: snapshot bootstrap beats pure-delta wall
+    time on the 1M rung, speedup recorded in-band and consistent with
+    the rung walls it claims to summarize."""
+    assert banked["large_rung_rows"] == 1_000_000
+    speedup = banked["snapshot_vs_delta_speedup"]
+    assert speedup > 1.0, speedup
+    d = rungs["sync-1000k-quiet-delta"]["wall_to_converged_s"]
+    s = rungs["sync-1000k-quiet-snapshot"]["wall_to_converged_s"]
+    assert s < d
+    assert abs(speedup - d / s) / speedup < 0.05, (speedup, d, s)
+
+
+def test_1m_under_fire_converges(rungs):
+    """ISSUE 12 acceptance: the cold node converges against the 1M-row
+    table WITH concurrent write traffic, via the snapshot fast path."""
+    rec = rungs["sync-1000k-fire-snapshot"]
+    assert rec["rows"] == 1_000_000
+    assert rec["fire"] and rec["converged"]
+    assert rec["snapshot_installed"] == 1
+
+
+def test_chaos_loop_closes_with_zero_divergence(banked):
+    """ISSUE 12 acceptance: partition → heal → catch-up → converge,
+    with the divergence detector opening exactly during the partition
+    (episodes ≥ 1) and reporting ZERO divergence at the end (one view
+    group, episode closed, replicas row-identical — the bench asserts
+    table equality before banking)."""
+    chaos = banked["chaos"]
+    assert chaos["divergence_zero"] is True
+    assert chaos["episodes"] >= 1
+    assert chaos["final_groups"] == 1
+    assert chaos["partition_writes"] > 0

@@ -1,0 +1,340 @@
+"""Sync-plane fault tests (r17 catch-up round): mid-stream peer death
+resuming on a sibling inside one sync call, the wire-level schema gate
+on snapshot bootstrap, and stale-snapshot + delta top-up pinned
+byte-identical against a pure-delta replica.
+
+Shapes are deliberately tiny (tier-1 runs near the 870 s kill); the
+100k/1M rungs live in scripts/bench_sync.py → SYNC_SCALE.json."""
+
+import asyncio
+
+from corrosion_tpu.agent.ingest import (
+    apply_fully_buffered_loop,
+    handle_changes,
+)
+from corrosion_tpu.agent.run import (
+    make_broadcastable_changes,
+    setup,
+    shutdown,
+)
+from corrosion_tpu.agent.syncer import parallel_sync
+from corrosion_tpu.net.mem import MemNetwork
+from corrosion_tpu.net.transport import TransportError
+from corrosion_tpu.runtime.metrics import METRICS
+
+from tests.test_agent import TEST_SCHEMA, boot, fast_config, wait_until
+
+# CRDT merge state; ts excluded — it is origin-local bookkeeping and a
+# replica applying remote changes stores 0 there on the standing delta
+# path (route-dependent, not convergence-relevant)
+CLOCK_SQL = (
+    "SELECT pk, cid, col_version, db_version, seq, site_id"
+    " FROM tests__crdt_clock ORDER BY pk, cid, db_version, seq"
+)
+
+
+def count_rows(agent) -> int:
+    conn = agent.store.read_conn()
+    try:
+        return conn.execute("SELECT COUNT(*) FROM tests").fetchone()[0]
+    finally:
+        conn.close()
+
+
+def clock_rows(agent):
+    conn = agent.store.read_conn()
+    try:
+        return [tuple(r) for r in conn.execute(CLOCK_SQL)]
+    finally:
+        conn.close()
+
+
+def peek(name: str, **labels) -> float:
+    for _kind, sname, slabels, value in METRICS.snapshot():
+        if sname == name and slabels == labels:
+            return value
+    return 0.0
+
+
+async def load_versions(agent, n, rows_per=2, base=0):
+    for v in range(n):
+        await make_broadcastable_changes(
+            agent,
+            lambda tx, v=v: [
+                tx.execute(
+                    "INSERT OR REPLACE INTO tests (id, text) VALUES (?, ?)",
+                    ((base + v) * rows_per + k, f"r{base + v}-{k}"),
+                )
+                for k in range(rows_per)
+            ],
+        )
+
+
+class _DyingStream:
+    """Proxy that kills the session after `frames` received frames —
+    the deterministic mid-stream peer death."""
+
+    def __init__(self, inner, frames):
+        self.inner = inner
+        self.left = frames
+
+    async def send(self, payload):
+        await self.inner.send(payload)
+
+    async def recv(self):
+        if self.left <= 0:
+            raise TransportError("injected mid-stream death")
+        self.left -= 1
+        return await self.inner.recv()
+
+    async def finish(self):
+        await self.inner.finish()
+
+    def close(self):
+        self.inner.close()
+
+    @property
+    def peer(self):
+        return self.inner.peer
+
+
+def test_mid_stream_peer_death_resumes_on_sibling():
+    """A dies 4 frames into serving C; the SAME parallel_sync call
+    releases A's unserved ranges and re-claims them from B — full
+    convergence with nothing lost and nothing double-applied."""
+
+    async def main():
+        net = MemNetwork(seed=3)
+        a = await boot(net, "agent-a")
+        b = await boot(net, "agent-b", bootstrap=("agent-a",))
+        await load_versions(a, 40)
+        assert await wait_until(lambda: count_rows(b) == 80, timeout=60)
+
+        cfg = fast_config("agent-c")
+        cfg.sync.snapshot = False
+        c = await setup(cfg, network=net)
+        c.store.apply_schema_sql(TEST_SCHEMA)
+        c.tracker.spawn(handle_changes(c))
+        c.tracker.spawn(apply_fully_buffered_loop(c))
+        try:
+            real_open = c.transport.open_bi
+            died = {"n": 0}
+
+            async def open_bi(addr):
+                stream = await real_open(addr)
+                if addr == "agent-a" and died["n"] == 0:
+                    died["n"] += 1
+                    return _DyingStream(stream, frames=4)
+                return stream
+
+            c.transport.open_bi = open_bi
+            waves0 = peek("corro.sync.resume.waves.total")
+            freed0 = peek("corro.sync.resume.versions.total")
+            await parallel_sync(c, [a.actor, b.actor])
+            assert died["n"] == 1, "fault was never injected"
+            assert peek("corro.sync.resume.waves.total") > waves0
+            assert peek("corro.sync.resume.versions.total") > freed0
+            assert await wait_until(lambda: count_rows(c) == 80, timeout=30)
+            # nothing lost, nothing double-applied: the CRDT merge
+            # state is exactly the origin's (row count pins duplicates —
+            # a double apply is idempotent but a clock-row mismatch or
+            # missing version is not)
+            assert await wait_until(
+                lambda: clock_rows(c) == clock_rows(a), timeout=10
+            )
+        finally:
+            await shutdown(c)
+            await shutdown(b)
+            await shutdown(a)
+
+    asyncio.run(main())
+
+
+def test_cold_node_snapshot_bootstrap_converges():
+    """A cold node whose gap exceeds the heuristic installs the peer
+    snapshot through the locked swap and tops up by delta — one e2e
+    pass over the whole plane (probe → fetch → install → top-up)."""
+
+    async def main():
+        net = MemNetwork(seed=7)
+        a = await boot(net, "agent-a")
+        await load_versions(a, 30, rows_per=3)
+        await asyncio.sleep(0.7)  # let the broadcast backlog expire
+        installs0 = peek("corro.snapshot.install.total")
+        serves0 = peek("corro.snapshot.serve.total")
+        cfg = fast_config("agent-c", bootstrap=("agent-a",))
+        cfg.sync.snapshot_min_gap_versions = 10
+        c = await boot(net, "agent-c", bootstrap=("agent-a",), cfg=cfg)
+        try:
+            assert await wait_until(lambda: count_rows(c) == 90, timeout=60)
+            assert peek("corro.snapshot.install.total") == installs0 + 1
+            assert peek("corro.snapshot.serve.total") == serves0 + 1
+            assert c.catchup_census.get("state") == "installed"
+            assert c.catchup_census.get("watermark_versions", 0) >= 30
+            assert await wait_until(
+                lambda: clock_rows(c) == clock_rows(a), timeout=10
+            )
+            # identity preserved: the installed db answers with C's id
+            assert c.store.site_id == c.actor_id
+        finally:
+            await shutdown(c)
+            await shutdown(a)
+
+    asyncio.run(main())
+
+
+def test_snapshot_schema_mismatch_refused_over_wire():
+    """A cold node running a different schema generation is refused by
+    the serving side (typed rejection) and falls back cleanly — no
+    swap, no wedge."""
+
+    async def main():
+        from corrosion_tpu.agent.catchup import maybe_snapshot_bootstrap
+
+        net = MemNetwork(seed=11)
+        a = await boot(net, "agent-a")
+        await load_versions(a, 20)
+        cfg = fast_config("agent-x")
+        cfg.sync.snapshot_min_gap_versions = 5
+        x = await setup(cfg, network=net)
+        x.store.apply_schema_sql(
+            "CREATE TABLE other (id INTEGER NOT NULL PRIMARY KEY, v TEXT);"
+        )
+        try:
+            rejected0 = peek(
+                "corro.snapshot.serve.rejected.total", reason="schema"
+            )
+            installs0 = peek("corro.snapshot.install.total")
+            ok = await maybe_snapshot_bootstrap(x, [a.actor])
+            assert ok is False
+            assert (
+                peek("corro.snapshot.serve.rejected.total", reason="schema")
+                == rejected0 + 1
+            )
+            assert peek("corro.snapshot.install.total") == installs0
+            # the refused node's database is untouched and writable
+            with x.store.write_tx(x.clock.new_timestamp()) as tx:
+                tx.execute(
+                    "INSERT INTO other (id, v) VALUES (1, 'still-alive')"
+                )
+        finally:
+            await shutdown(x)
+            await shutdown(a)
+
+    asyncio.run(main())
+
+
+def test_install_invalidates_ingest_seen_cache():
+    """The r17 fire-grind bug, pinned: a change applied BEFORE a
+    database swap leaves its key in handle_changes' seen-cache while
+    the swap drops its data — without the epoch bump, the re-served
+    change is skipped as 'seen' forever and the version can only limp
+    back in via cache eviction."""
+
+    async def main():
+        from corrosion_tpu.agent.handle import ChangeSource
+        from corrosion_tpu.types.actor import ActorId
+        from corrosion_tpu.types.base import Timestamp
+        from corrosion_tpu.types.codec import chunked_change_v1
+        from corrosion_tpu.types.change import Change
+
+        net = MemNetwork(seed=17)
+        cfg = fast_config("agent-e")
+        e = await setup(cfg, network=net)
+        e.store.apply_schema_sql(TEST_SCHEMA)
+        e.tracker.spawn(handle_changes(e))
+        try:
+            origin = ActorId(b"\x42" * 16)
+            ts = Timestamp.now()
+            changes = [
+                Change(
+                    table="tests", pk=b"\x01\x09\x07", cid="text",
+                    val="hello", col_version=1, db_version=1, seq=0,
+                    site_id=origin.bytes16, cl=1, ts=ts,
+                )
+            ]
+            [cv] = chunked_change_v1(origin, 1, changes, 0, ts)
+            await e.tx_changes.send((cv, ChangeSource.SYNC))
+            assert await wait_until(lambda: count_rows(e) == 1, timeout=15)
+
+            # simulate the swap: the data vanishes, the bookie forgets,
+            # but the seen-cache still remembers the change
+            with e.store._lock:
+                e.store._conn.execute("DELETE FROM tests")
+                e.store._conn.execute("DELETE FROM tests__crdt_clock")
+                e.store._conn.commit()
+            e.store._dv_cache.clear()
+            from corrosion_tpu.store.bookkeeping import BookedVersions
+
+            e.bookie.insert(origin, BookedVersions(origin))
+            e.ingest_epoch += 1  # what snapshot_bootstrap does
+
+            await e.tx_changes.send((cv, ChangeSource.SYNC))
+            assert await wait_until(lambda: count_rows(e) == 1, timeout=15), (
+                "re-served change was shadowed by the stale seen-cache"
+            )
+        finally:
+            await shutdown(e)
+
+    asyncio.run(main())
+
+
+def test_stale_snapshot_topup_matches_pure_delta():
+    """Bootstrap from a STALE snapshot (built at version 10 of 20) plus
+    delta top-up must land on the same tables — user rows and CRDT
+    clock state — as a pure-delta replica and as the origin."""
+
+    async def main():
+        from corrosion_tpu.agent.catchup import ensure_snapshot_cache
+
+        net = MemNetwork(seed=13)
+        a = await boot(net, "agent-a")
+        await load_versions(a, 10)
+        # freeze the serve-side cache at version 10...
+        cache = ensure_snapshot_cache(a)
+        cache.ensure_fresh(
+            a.store.schema, a.store.site_id.bytes16, a.bookie, 3600.0
+        )
+        assert cache.header.watermark_total() == 10
+        a.config.sync.snapshot_max_age_secs = 3600.0  # keep it stale
+        # ...then move the origin 10 versions past it
+        await load_versions(a, 10, base=10)
+        await asyncio.sleep(0.7)  # let the broadcast backlog expire
+
+        cfg_c = fast_config("agent-c", bootstrap=("agent-a",))
+        cfg_c.sync.snapshot_min_gap_versions = 5
+        c = await boot(net, "agent-c", bootstrap=("agent-a",), cfg=cfg_c)
+        cfg_d = fast_config("agent-d", bootstrap=("agent-a",))
+        cfg_d.sync.snapshot = False
+        d = await boot(net, "agent-d", bootstrap=("agent-a",), cfg=cfg_d)
+        try:
+            assert await wait_until(
+                lambda: count_rows(c) == 40 and count_rows(d) == 40,
+                timeout=90,
+            )
+            # C really took the stale-snapshot path (watermark 10 < 20)
+            assert c.catchup_census.get("state") == "installed"
+            assert c.catchup_census.get("watermark_versions") == 10
+            # the pin: stale snapshot + top-up ≡ pure delta ≡ origin
+            assert await wait_until(
+                lambda: clock_rows(c) == clock_rows(a), timeout=10
+            )
+            assert clock_rows(d) == clock_rows(a)
+            conn_c, conn_d = c.store.read_conn(), d.store.read_conn()
+            try:
+                tc = conn_c.execute(
+                    "SELECT * FROM tests ORDER BY id"
+                ).fetchall()
+                td = conn_d.execute(
+                    "SELECT * FROM tests ORDER BY id"
+                ).fetchall()
+            finally:
+                conn_c.close()
+                conn_d.close()
+            assert [tuple(r) for r in tc] == [tuple(r) for r in td]
+        finally:
+            await shutdown(d)
+            await shutdown(c)
+            await shutdown(a)
+
+    asyncio.run(main())
