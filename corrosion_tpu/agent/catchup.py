@@ -34,7 +34,11 @@ Local safety: installing a foreign snapshot DROPS local state, so the
 bootstrap refuses unless every version this node ORIGINATED is covered
 by the snapshot's watermark (own unsynchronized writes are the one
 thing a swap cannot get back; remote-origin overhang is re-fetched by
-the top-up) — `corro.snapshot.install.refused.total{reason=
+the top-up).  The guard runs TWICE: once at header time (cheap abort
+before the bulk transfer) and again under the write-gate priority
+permit right before the swap — own writes can commit during the
+multi-second transfer, and only the under-permit check is
+race-free.  `corro.snapshot.install.refused.total{reason=
 "local_ahead"}` is the witness that the guard fired instead of data
 being lost.
 """
@@ -52,6 +56,7 @@ from corrosion_tpu.agent.handle import Agent
 from corrosion_tpu.net.transport import BiStream, TransportError
 from corrosion_tpu.runtime.metrics import METRICS
 from corrosion_tpu.store import snapshot as snap_mod
+from corrosion_tpu.store.bookkeeping import BookedVersions
 from corrosion_tpu.store.snapshot import (
     REJECT_BUSY,
     REJECT_CLUSTER,
@@ -168,6 +173,23 @@ async def serve_snapshot(agent: Agent, stream: BiStream, req: SnapshotReq) -> No
 
 
 # -- bootstrap (client) side -----------------------------------------------
+
+
+# census keys that survive state transitions: `last_probe_mono` gates
+# the digestless state probe and `installed_mono` gates the post-install
+# cooldown — a failure record must not reset either clock, or a cold
+# node pays a probe dial / re-bootstrap every sync round
+_CENSUS_STICKY = ("last_probe_mono", "installed_mono")
+
+
+def _set_census(agent: Agent, **fields) -> None:
+    new = {
+        k: agent.catchup_census[k]
+        for k in _CENSUS_STICKY
+        if k in agent.catchup_census
+    }
+    new.update(fields)
+    agent.catchup_census = new
 
 
 def _write_chunks(f, chunks: List[bytes]) -> int:
@@ -290,9 +312,7 @@ async def snapshot_bootstrap(agent: Agent, peer: Actor) -> bool:
         return False
     t0 = time.monotonic()
     tmp_db = store.path + ".snap-fetch"
-    agent.catchup_census = {
-        "state": "fetching", "peer": peer.addr, "started_mono": t0,
-    }
+    _set_census(agent, state="fetching", peer=peer.addr, started_mono=t0)
     try:
         try:
             header = await _fetch_snapshot(agent, peer, tmp_db)
@@ -301,16 +321,35 @@ async def snapshot_bootstrap(agent: Agent, peer: Actor) -> bool:
             zlib.error,
         ):
             METRICS.counter("corro.snapshot.bootstrap.failed.total").inc()
-            agent.catchup_census = {"state": "failed", "peer": peer.addr}
+            _set_census(agent, state="failed", peer=peer.addr)
             return False
         if header is None:
             METRICS.counter("corro.snapshot.bootstrap.failed.total").inc()
-            agent.catchup_census = {"state": "failed", "peer": peer.addr}
+            _set_census(agent, state="failed", peer=peer.addr)
             return False
 
         # quiesce the write path for the swap: the PRIORITY lane permit
         # blocks local writers, remote applies and buffered drains alike
         async with agent.write_gate.priority():
+            # the header-time _local_covered_by check ran BEFORE the
+            # multi-second bulk transfer; own-origin writes committed
+            # since (or between fetch completion and permit grant) would
+            # be silently dropped by the swap, regressing our version
+            # head and re-issuing broadcast version numbers with new
+            # contents.  The write path is quiesced under this permit,
+            # so rechecking here is authoritative.
+            if not _local_covered_by(agent, header):
+                METRICS.counter(
+                    "corro.snapshot.install.refused.total",
+                    reason="local_ahead",
+                ).inc()
+                METRICS.counter("corro.snapshot.bootstrap.failed.total").inc()
+                _set_census(
+                    agent, state="failed", peer=peer.addr,
+                    reason="local_ahead",
+                )
+                return False
+
             def install() -> None:
                 with store.swapped_database():
                     snap_mod.install_raw_db(
@@ -327,8 +366,14 @@ async def snapshot_bootstrap(agent: Agent, peer: Actor) -> bool:
                     for aid in store.booked_actor_ids()
                 }
 
-            for aid, bv in (await asyncio.to_thread(rebuild)).items():
-                agent.bookie.insert(aid, bv)
+            # exact replacement, never an insert-merge over the old map:
+            # a surviving entry for an actor absent from the snapshot
+            # (e.g. broadcast changes applied during the transfer window)
+            # would claim versions the swap just dropped, and the delta
+            # top-up would never re-fetch them
+            loaded = await asyncio.to_thread(rebuild)
+            loaded.setdefault(agent.actor_id, BookedVersions(agent.actor_id))
+            agent.bookie.replace_all(loaded)
             # the ingest seen-cache predates the swap: anything it
             # remembers may have been dropped with the old database
             agent.ingest_epoch += 1
@@ -346,14 +391,15 @@ async def snapshot_bootstrap(agent: Agent, peer: Actor) -> bool:
         elapsed = time.monotonic() - t0
         METRICS.counter("corro.snapshot.install.total").inc()
         METRICS.histogram("corro.snapshot.install.seconds").observe(elapsed)
-        agent.catchup_census = {
-            "state": "installed",
-            "peer": peer.addr,
-            "seconds": round(elapsed, 3),
-            "raw_bytes": header.raw_bytes,
-            "watermark_versions": header.watermark_total(),
-            "installed_mono": time.monotonic(),
-        }
+        _set_census(
+            agent,
+            state="installed",
+            peer=peer.addr,
+            seconds=round(elapsed, 3),
+            raw_bytes=header.raw_bytes,
+            watermark_versions=header.watermark_total(),
+            installed_mono=time.monotonic(),
+        )
         log.info(
             "snapshot bootstrap from %s: %d watermark versions, %d bytes, "
             "%.2fs — topping up with delta sync",
@@ -437,10 +483,10 @@ async def maybe_snapshot_bootstrap(agent: Agent, peers: List[Actor]) -> bool:
         )
     except asyncio.TimeoutError:
         METRICS.counter("corro.snapshot.bootstrap.failed.total").inc()
-        agent.catchup_census = {"state": "failed", "peer": peer.addr}
+        _set_census(agent, state="failed", peer=peer.addr)
         return False
     except Exception:
         METRICS.counter("corro.snapshot.bootstrap.failed.total").inc()
-        agent.catchup_census = {"state": "failed", "peer": peer.addr}
+        _set_census(agent, state="failed", peer=peer.addr)
         log.exception("snapshot bootstrap from %s failed", peer.addr)
         return False

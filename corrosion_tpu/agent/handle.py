@@ -92,6 +92,8 @@ class Agent:
     # serves
     snapshots: Optional[object] = None  # SnapshotCache
     snapshot_build_lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+    # sized from [sync] max_concurrent_snapshot_serves at agent build
+    # time (agent/run.py); the default only covers hand-built test agents
     snapshot_serve_sem: asyncio.Semaphore = field(
         default_factory=lambda: asyncio.Semaphore(2)
     )

@@ -214,6 +214,11 @@ async def setup(
         rx_changes=rx_changes,
         tx_apply=tx_apply,
         rx_apply=rx_apply,
+        # [sync] max_concurrent_snapshot_serves: the serve-side permit
+        # pool is sized here, not in the dataclass default
+        snapshot_serve_sem=asyncio.Semaphore(
+            max(1, config.sync.max_concurrent_snapshot_serves)
+        ),
     )
 
     # live-query + raw-update engines fed from every committed batch

@@ -271,6 +271,19 @@ class Bookie:
             self._map[actor_id] = b
             return b
 
+    def replace_all(self, mapping: Dict[ActorId, BookedVersions]) -> None:
+        """Atomically replace the whole actor map with exactly `mapping`
+        (snapshot install, agent/catchup.py).  Actors absent from
+        `mapping` are DROPPED: after a database swap the old map
+        describes state that no longer exists, and a stale survivor
+        would claim versions the swap discarded, hiding them from the
+        delta top-up forever."""
+        with self._lock:
+            self._map = {
+                aid: Booked(bv, self._registry)
+                for aid, bv in mapping.items()
+            }
+
     def items(self) -> Dict[ActorId, Booked]:
         with self._lock:
             return dict(self._map)

@@ -279,6 +279,154 @@ def test_install_invalidates_ingest_seen_cache():
     asyncio.run(main())
 
 
+def test_own_write_during_transfer_refuses_install():
+    """The local-ahead guard's TOCTOU window, pinned: an own-origin
+    write that commits AFTER the header-time coverage check but BEFORE
+    the write-gate permit must still refuse the install — the swap
+    would silently drop an acked local write and regress the node's
+    own version head (re-issuing broadcast version numbers with
+    different contents)."""
+
+    async def main():
+        import corrosion_tpu.agent.catchup as catchup_mod
+        from corrosion_tpu.agent.catchup import maybe_snapshot_bootstrap
+
+        net = MemNetwork(seed=19)
+        a = await boot(net, "agent-a")
+        await load_versions(a, 30)
+        cfg = fast_config("agent-w")
+        cfg.sync.snapshot_min_gap_versions = 10
+        w = await setup(cfg, network=net)
+        w.store.apply_schema_sql(TEST_SCHEMA)
+        try:
+            real_fetch = catchup_mod._fetch_snapshot
+
+            async def fetch_then_write(agent, peer, tmp_db):
+                header = await real_fetch(agent, peer, tmp_db)
+                # lands in the TOCTOU window: past the header-time
+                # check, before snapshot_bootstrap takes the write gate
+                await make_broadcastable_changes(
+                    agent,
+                    lambda tx: [
+                        tx.execute(
+                            "INSERT INTO tests (id, text)"
+                            " VALUES (9999, 'mine')"
+                        )
+                    ],
+                )
+                return header
+
+            catchup_mod._fetch_snapshot = fetch_then_write
+            refused0 = peek(
+                "corro.snapshot.install.refused.total", reason="local_ahead"
+            )
+            installs0 = peek("corro.snapshot.install.total")
+            try:
+                ok = await maybe_snapshot_bootstrap(w, [a.actor])
+            finally:
+                catchup_mod._fetch_snapshot = real_fetch
+            assert ok is False
+            assert (
+                peek(
+                    "corro.snapshot.install.refused.total",
+                    reason="local_ahead",
+                )
+                == refused0 + 1
+            )
+            assert peek("corro.snapshot.install.total") == installs0
+            # the acked write survived, in the db and in the bookie
+            conn = w.store.read_conn()
+            try:
+                row = conn.execute(
+                    "SELECT text FROM tests WHERE id = 9999"
+                ).fetchone()
+            finally:
+                conn.close()
+            assert row is not None and row[0] == "mine"
+            booked = w.bookie.get(w.actor_id)
+            assert booked is not None
+            with booked.read() as bv:
+                assert bv.last() == 1
+        finally:
+            await shutdown(w)
+            await shutdown(a)
+
+    asyncio.run(main())
+
+
+def test_install_replaces_stale_bookie_entries():
+    """The post-swap bookie rebuild must be an exact replacement: a
+    pre-install entry for an actor ABSENT from the snapshot claims
+    versions the swap dropped, and an insert-merge would let it
+    survive — delta top-up then never re-fetches them."""
+
+    async def main():
+        from corrosion_tpu.agent.catchup import maybe_snapshot_bootstrap
+        from corrosion_tpu.store.bookkeeping import BookedVersions
+        from corrosion_tpu.types.actor import ActorId
+
+        net = MemNetwork(seed=23)
+        a = await boot(net, "agent-a")
+        await load_versions(a, 30)
+        cfg = fast_config("agent-y")
+        cfg.sync.snapshot_min_gap_versions = 10
+        cfg.sync.max_concurrent_snapshot_serves = 5
+        y = await setup(cfg, network=net)
+        y.store.apply_schema_sql(TEST_SCHEMA)
+        try:
+            # the [sync] serve-permit knob is wired through agent build
+            assert y.snapshot_serve_sem._value == 5
+            ghost = ActorId(b"\x99" * 16)
+            bv = BookedVersions(ghost)
+            bv.max = 5  # claims versions that exist in no database
+            y.bookie.insert(ghost, bv)
+            ok = await maybe_snapshot_bootstrap(y, [a.actor])
+            assert ok is True
+            assert y.bookie.get(ghost) is None, (
+                "stale bookie entry survived the snapshot install"
+            )
+            # origin and self are exactly what the installed db knows
+            assert y.bookie.get(a.actor_id) is not None
+            assert y.bookie.get(y.actor_id) is not None
+        finally:
+            await shutdown(y)
+            await shutdown(a)
+
+    asyncio.run(main())
+
+
+def test_failed_bootstrap_keeps_probe_rate_limit_stamp():
+    """A failed bootstrap's census record must not erase
+    last_probe_mono — wholesale replacement reset the 15 s state-probe
+    rate limit on every failure, so a digestless cold node paid a
+    probe dial every sync round."""
+
+    async def main():
+        from corrosion_tpu.agent.catchup import snapshot_bootstrap
+        from corrosion_tpu.types.actor import Actor, ActorId
+
+        net = MemNetwork(seed=29)
+        cfg = fast_config("agent-z")
+        z = await setup(cfg, network=net)
+        z.store.apply_schema_sql(TEST_SCHEMA)
+        try:
+            z.catchup_census["last_probe_mono"] = 123.0
+            ghost = Actor(
+                id=ActorId(b"\x31" * 16),
+                addr="nowhere",  # dial fails: counted bootstrap failure
+                ts=z.clock.new_timestamp(),
+                cluster_id=z.cluster_id,
+            )
+            ok = await snapshot_bootstrap(z, ghost)
+            assert ok is False
+            assert z.catchup_census.get("state") == "failed"
+            assert z.catchup_census.get("last_probe_mono") == 123.0
+        finally:
+            await shutdown(z)
+
+    asyncio.run(main())
+
+
 def test_stale_snapshot_topup_matches_pure_delta():
     """Bootstrap from a STALE snapshot (built at version 10 of 20) plus
     delta top-up must land on the same tables — user rows and CRDT
