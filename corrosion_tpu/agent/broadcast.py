@@ -31,6 +31,11 @@ from corrosion_tpu.types.codec import (
     encode_uni_prefix,
 )
 
+# r18 timeout discipline: bound on one uni-stream dispatch (dial + write
+# of a ≤64 KiB payload) — generous for a healthy peer, finite for a
+# zombie whose kernel accepts while its event loop never drains
+SEND_TIMEOUT = 30.0
+
 
 class TokenBucket:
     """10 MiB/s egress limiter (governor at broadcast/mod.rs:460-463)."""
@@ -265,11 +270,16 @@ async def _transmit(agent: Agent, bucket: TokenBucket, p: _Pending) -> bool:
 
 async def _send_one(agent: Agent, actor: Actor, payload: bytes) -> None:
     try:
-        await agent.transport.send_uni(actor.addr, payload)
+        # r18 timeout discipline: a peer whose kernel accepts the dial
+        # but whose loop is stalled (zombie) must cost a counted failed
+        # send, never wedge the broadcast loop behind one uni stream
+        await asyncio.wait_for(
+            agent.transport.send_uni(actor.addr, payload), SEND_TIMEOUT
+        )
         METRICS.counter("corro.broadcast.sent").inc()
         from corrosion_tpu.runtime.invariants import assert_sometimes
 
         # ref assert_sometimes "changes broadcast" (broadcast.rs:642)
         assert_sometimes("changes broadcast")
-    except TransportError:
+    except (TransportError, asyncio.TimeoutError):
         METRICS.counter("corro.broadcast.send.failed").inc()

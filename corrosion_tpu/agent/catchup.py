@@ -120,8 +120,10 @@ async def serve_snapshot(agent: Agent, stream: BiStream, req: SnapshotReq) -> No
             "corro.snapshot.serve.rejected.total",
             reason=_REJECT_NAMES.get(reason, str(reason)),
         ).inc()
-        await stream.send(encode_snapshot_msg_rejection(reason))
-        await stream.finish()
+        await asyncio.wait_for(
+            stream.send(encode_snapshot_msg_rejection(reason)), SEND_TIMEOUT
+        )
+        await asyncio.wait_for(stream.finish(), SEND_TIMEOUT)
 
     if req.cluster_id != agent.cluster_id:
         await reject(REJECT_CLUSTER)
@@ -167,7 +169,7 @@ async def serve_snapshot(agent: Agent, stream: BiStream, req: SnapshotReq) -> No
             for payload in batch:
                 await asyncio.wait_for(stream.send(payload), SEND_TIMEOUT)
                 sent += len(payload)
-        await stream.finish()
+        await asyncio.wait_for(stream.finish(), SEND_TIMEOUT)
         METRICS.counter("corro.snapshot.serve.total").inc()
         METRICS.counter("corro.snapshot.serve.bytes").inc(sent)
 
@@ -222,7 +224,9 @@ async def _fetch_snapshot(
     """Stream the peer's snapshot into `tmp_db` (decompressed).  None on
     any refusal/failure — callers fall back to delta sync."""
     local_sha = local_schema_sha(agent)
-    stream = await agent.transport.open_bi(peer.addr)
+    stream = await asyncio.wait_for(
+        agent.transport.open_bi(peer.addr), RECV_TIMEOUT
+    )
     f = None
     header: Optional[SnapshotHeader] = None
     done: Optional[SnapshotDone] = None
@@ -232,14 +236,17 @@ async def _fetch_snapshot(
     received_raw = 0
     fetched_wire = 0
     try:
-        await stream.send(
-            encode_bi_payload_snapshot_req(
-                SnapshotReq(
-                    actor_id=agent.actor_id,
-                    schema_sha=local_sha,
-                    cluster_id=agent.cluster_id,
+        await asyncio.wait_for(
+            stream.send(
+                encode_bi_payload_snapshot_req(
+                    SnapshotReq(
+                        actor_id=agent.actor_id,
+                        schema_sha=local_sha,
+                        cluster_id=agent.cluster_id,
+                    )
                 )
-            )
+            ),
+            SEND_TIMEOUT,
         )
         f = await asyncio.to_thread(open, tmp_db, "wb")
         while True:

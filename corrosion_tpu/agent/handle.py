@@ -102,6 +102,15 @@ class Agent:
     sync_circuits: dict = field(default_factory=dict)
     # bootstrap census: {"state": idle|fetching|installed|failed, ...}
     catchup_census: dict = field(default_factory=dict)
+    # r18 (found by the traffic_sim zombie-node scenario): the
+    # announcer picks its sleep while healthy — the 300 s steady
+    # period — and used to sleep straight through an isolation that
+    # began mid-sleep, leaving an evicted node silent for up to 5
+    # minutes after the fault cleared.  This event is set when the
+    # SWIM view collapses to self (run.py on_notification) and the
+    # announcer waits on it alongside the tripwire, so isolation
+    # restarts the jittered announce ramp IMMEDIATELY
+    announce_wake: asyncio.Event = field(default_factory=asyncio.Event)
     # bumped by a snapshot install: the ingest seen-cache must drop
     # everything it remembers, because "seen" changes applied BEFORE
     # the database swap were discarded by it — a stale entry would

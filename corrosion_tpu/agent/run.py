@@ -266,6 +266,12 @@ async def setup(
             agent.members.add_member(peer)
         elif note == Notification.MEMBER_DOWN:
             agent.members.remove_member(peer)
+            if agent.membership.cluster_size <= 1:
+                # SWIM view collapsed to self: wake the announcer NOW —
+                # it may be mid-way through a 300 s steady-period sleep
+                # chosen while the cluster was healthy (the r18 zombie
+                # orphaning)
+                agent.announce_wake.set()
         elif note == Notification.ACTIVE and peer.id == agent.actor.id:
             agent.actor = peer  # renewed identity after being declared down
 
@@ -418,8 +424,27 @@ async def _announcer(agent: Agent) -> None:
             # floor keeps full jitter from hot-looping announces when
             # the draw lands near zero
             delay = max(0.05, next(boff))
-        with contextlib.suppress(asyncio.TimeoutError):
-            await asyncio.wait_for(agent.tripwire.wait(), delay)
+        # sleep until delay, tripwire, OR the SWIM view collapsing to
+        # self (announce_wake, set by on_notification): a steady-period
+        # sleep chosen while healthy must not outlive the health it was
+        # chosen under — the r18 zombie-node scenario caught an evicted
+        # node sleeping silently through the rest of its 300 s period.
+        # No wake is lost to the clear(): both the members check above
+        # and this clear run without an intervening await, and
+        # notifications only fire at await points.
+        agent.announce_wake.clear()
+        trip = asyncio.ensure_future(agent.tripwire.wait())
+        wake = asyncio.ensure_future(agent.announce_wake.wait())
+        try:
+            await asyncio.wait(
+                {trip, wake}, timeout=delay,
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+        finally:
+            for t in (trip, wake):
+                t.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await t
 
 
 async def canary_loop(agent: Agent) -> None:

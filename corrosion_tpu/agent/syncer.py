@@ -84,6 +84,12 @@ CHUNK_TARGET_FLOOR = 1024  # never below 1 KiB
 ADAPT_SLOW_SEND_S = 0.5  # halve when one send takes > 500 ms
 ADAPT_GROW = 1.5
 SEND_TIMEOUT = 30.0  # stalled-peer cutoff: frees snapshot conn + permit
+# r18 timeout discipline: EVERY network await in this module carries a
+# deadline (the zombie-node scenario's bug class — a peer whose kernel
+# accepts bytes while its event loop never answers must cost a counted
+# timeout, never a stalled round; enforced repo-wide by the
+# timeout-discipline corro-analyze rule)
+OPEN_TIMEOUT = 10.0  # dial cutoff for open_bi
 
 
 class AdaptiveChunkSize:
@@ -137,12 +143,18 @@ async def serve_sync(agent: Agent, stream: BiStream) -> None:
             return
         peer_actor_id, trace, cluster_id = payload
         if cluster_id != agent.cluster_id:
-            await stream.send(encode_sync_msg(SyncRejection(reason=1)))
-            await stream.finish()
+            await asyncio.wait_for(
+                stream.send(encode_sync_msg(SyncRejection(reason=1))),
+                SEND_TIMEOUT,
+            )
+            await asyncio.wait_for(stream.finish(), SEND_TIMEOUT)
             return
         if agent.sync_serve_sem.locked():
-            await stream.send(encode_sync_msg(SyncRejection(reason=2)))
-            await stream.finish()
+            await asyncio.wait_for(
+                stream.send(encode_sync_msg(SyncRejection(reason=2))),
+                SEND_TIMEOUT,
+            )
+            await asyncio.wait_for(stream.finish(), SEND_TIMEOUT)
             return
         async with agent.sync_serve_sem:
             # adopt the client's W3C trace context from the wire
@@ -162,8 +174,11 @@ async def _serve_sync_inner(
 ) -> None:
     METRICS.counter("corro.sync.server.started").inc()
     state = generate_sync(agent.bookie, agent.actor_id)
-    await stream.send(encode_sync_msg(agent.clock.new_timestamp()))
-    await stream.send(encode_sync_msg(state))
+    await asyncio.wait_for(
+        stream.send(encode_sync_msg(agent.clock.new_timestamp())),
+        SEND_TIMEOUT,
+    )
+    await asyncio.wait_for(stream.send(encode_sync_msg(state)), SEND_TIMEOUT)
 
     sent = 0
     chunker = AdaptiveChunkSize()  # per-session adaptation state
@@ -182,7 +197,7 @@ async def _serve_sync_inner(
                 sent += await _handle_need(
                     agent, stream, actor_id, need, chunker
                 )
-    await stream.finish()
+    await asyncio.wait_for(stream.finish(), SEND_TIMEOUT)
     METRICS.counter("corro.sync.server.changes.sent").inc(sent)
 
 
@@ -265,7 +280,7 @@ async def _handle_need(
                     versions=tuple(empties), ts=agent.clock.new_timestamp()
                 ),
             )
-            await stream.send(encode_sync_msg(cv))
+            await chunker.timed_send(stream, encode_sync_msg(cv))
     elif isinstance(need, NeedPartial):
         version = need.version
 
@@ -626,25 +641,42 @@ async def parallel_sync(
 
 
 async def fetch_peer_state(
-    agent: Agent, peer: Actor, timeout: float = RECV_TIMEOUT
+    agent: Agent, peer: Actor, timeout: Optional[float] = None
 ) -> Optional[SyncState]:
     """One state-only handshake: SyncStart + clock, read the peer's
     summary, half-close without requesting anything.  The cold-boot gap
     probe (`agent/catchup.py`) — cheap enough to run before the first
-    digest arrives."""
+    digest arrives.
+
+    The deadline resolves at CALL time (r18): a `timeout=RECV_TIMEOUT`
+    default froze the module constant at import, so tuned deadlines
+    (the chaos replica's tight tiny-shape timeouts) silently did not
+    apply here — the zombie-node scenario caught the cold-boot probe
+    blocking a sync round for the stale 10 s."""
     import contextlib
 
+    if timeout is None:
+        timeout = RECV_TIMEOUT
+
     try:
-        stream = await agent.transport.open_bi(peer.addr)
-    except (TransportError, OSError):
+        stream = await asyncio.wait_for(
+            agent.transport.open_bi(peer.addr), OPEN_TIMEOUT
+        )
+    except (TransportError, OSError, asyncio.TimeoutError):
         return None
     try:
-        await stream.send(
-            encode_bi_payload_sync_start(
-                agent.actor_id, cluster_id=agent.cluster_id
-            )
+        await asyncio.wait_for(
+            stream.send(
+                encode_bi_payload_sync_start(
+                    agent.actor_id, cluster_id=agent.cluster_id
+                )
+            ),
+            SEND_TIMEOUT,
         )
-        await stream.send(encode_sync_msg(agent.clock.new_timestamp()))
+        await asyncio.wait_for(
+            stream.send(encode_sync_msg(agent.clock.new_timestamp())),
+            SEND_TIMEOUT,
+        )
         while True:
             frame = await asyncio.wait_for(stream.recv(), timeout)
             if frame is None:
@@ -660,7 +692,7 @@ async def fetch_peer_state(
         return None
     finally:
         with contextlib.suppress(Exception):
-            await stream.finish()
+            await asyncio.wait_for(stream.finish(), SEND_TIMEOUT)
         stream.close()
 
 
@@ -676,7 +708,9 @@ async def _sync_one_peer(
     out = _Outstanding()
     received = 0
     try:
-        stream = await agent.transport.open_bi(peer.addr)
+        stream = await asyncio.wait_for(
+            agent.transport.open_bi(peer.addr), OPEN_TIMEOUT
+        )
     except (TransportError, OSError, asyncio.TimeoutError):
         return 0, False, 0
     # the whole client session is one span; its W3C context rides the
@@ -684,14 +718,20 @@ async def _sync_one_peer(
     sp = span("sync.client", peer=peer.addr)
     sp.__enter__()
     try:
-        await stream.send(
-            encode_bi_payload_sync_start(
-                agent.actor_id,
-                trace=SyncTraceContext(traceparent=sp.ctx.traceparent()),
-                cluster_id=agent.cluster_id,
-            )
+        await asyncio.wait_for(
+            stream.send(
+                encode_bi_payload_sync_start(
+                    agent.actor_id,
+                    trace=SyncTraceContext(traceparent=sp.ctx.traceparent()),
+                    cluster_id=agent.cluster_id,
+                )
+            ),
+            SEND_TIMEOUT,
         )
-        await stream.send(encode_sync_msg(agent.clock.new_timestamp()))
+        await asyncio.wait_for(
+            stream.send(encode_sync_msg(agent.clock.new_timestamp())),
+            SEND_TIMEOUT,
+        )
 
         theirs: Optional[SyncState] = None
         while theirs is None:
@@ -720,8 +760,11 @@ async def _sync_one_peer(
             grouped: Dict[ActorId, List[object]] = {}
             for aid, n in turn:
                 grouped.setdefault(aid, []).append(n)
-            await stream.send(encode_sync_msg(list(grouped.items())))
-        await stream.finish()
+            await asyncio.wait_for(
+                stream.send(encode_sync_msg(list(grouped.items()))),
+                SEND_TIMEOUT,
+            )
+        await asyncio.wait_for(stream.finish(), SEND_TIMEOUT)
 
         while True:
             frame = await asyncio.wait_for(stream.recv(), RECV_TIMEOUT)

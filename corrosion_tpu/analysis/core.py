@@ -227,6 +227,7 @@ def all_checkers() -> List[Checker]:
     from corrosion_tpu.analysis.metricsdoc import MetricsDocChecker
     from corrosion_tpu.analysis.parity import LaneParityChecker
     from corrosion_tpu.analysis.purity import KernelPurityChecker
+    from corrosion_tpu.analysis.timeouts import TimeoutDisciplineChecker
 
     return [
         KernelPurityChecker(),
@@ -236,6 +237,7 @@ def all_checkers() -> List[Checker]:
         CodecExtChecker(),
         CaptureParityChecker(),
         MetricsDocChecker(),
+        TimeoutDisciplineChecker(),
     ]
 
 

@@ -150,6 +150,12 @@ class ApiServer:
         # must not mint unbounded metric label values
         resource = request.match_info.route.resource if request.match_info else None
         endpoint = resource.canonical if resource is not None else "unmatched"
+        # BaseException default: a handler cancelled mid-request (agent
+        # restart under churn — the r18 chaos matrix's churn-storm
+        # scenario found this) produces NO response and NO Exception,
+        # and an unbound `status` here turned the clean CancelledError
+        # into an UnboundLocalError in the finally
+        status: object = "cancelled"
         try:
             resp = await handler(request)
             status = resp.status
@@ -423,8 +429,13 @@ class ApiServer:
             m for m in list(agent.membership.members.values())
             if m.state == MemberState.SUSPECT
         ]
+        # r18 chaos census: the drill-vs-outage discriminator — elevated
+        # p99s WITH a populated chaos block is an exercise, not a page
+        from corrosion_tpu.chaos.faults import CENSUS as CHAOS_CENSUS
+
         status = {
             "actor_id": str(agent.actor_id),
+            "chaos": CHAOS_CENSUS.snapshot(),
             "cluster": {
                 "size": agent.membership.cluster_size,
                 "member_states": by_state,

@@ -1,0 +1,23 @@
+"""Chaos engine (r18): production traffic simulator + multi-layer fault
+injection.
+
+The package is the adversary the observability planes (r11 /v1/slo,
+r12 /v1/cluster) were built to grade: `faults` holds the store-layer
+injector and the process-global chaos census, `scenarios` composes the
+network/store/process knobs into named scenarios driven by a
+`ChaosEngine`, and `workload` runs the mixed read/write/subscribe/render
+traffic the scenario matrix measures under (`scripts/traffic_sim.py`
+banks the matrix as TRAFFIC_SIM.json).
+"""
+
+from corrosion_tpu.chaos.faults import CENSUS, ChaosCensus, StoreFaults
+from corrosion_tpu.chaos.scenarios import ChaosEngine, Injection, Scenario
+
+__all__ = [
+    "CENSUS",
+    "ChaosCensus",
+    "ChaosEngine",
+    "Injection",
+    "Scenario",
+    "StoreFaults",
+]

@@ -28,6 +28,20 @@ from typing import Any, AsyncIterator, Dict, List, Optional
 import aiohttp
 
 from corrosion_tpu.net.h2 import H2Client, StreamReset
+from corrosion_tpu.runtime.backoff import Backoff
+
+
+def _reconnect_backoff():
+    """Full-jitter reconnect pacing (the r9 announcer discipline,
+    runtime/backoff.py): when an agent restart drops thousands of
+    subscription streams at once, deterministic doubling would re-dial
+    them all in the same beat at exactly the moment the server is
+    busiest re-admitting.  Uniform-in-[0, base] spreads the stampede;
+    the cap keeps a capped-retry stream's total stall bounded."""
+    return iter(Backoff(
+        min_interval=0.2, max_interval=2.0, factor=2.0,
+        mode="full", retries=None,
+    ))
 
 
 class _H2Resp:
@@ -298,10 +312,15 @@ class SubscriptionStream:
 
     async def _run(self):
         retries = 0
+        boff = _reconnect_backoff()
         while True:
             try:
                 async for ev in self._connect_once():
-                    retries = 0
+                    if retries:
+                        # progress: the retry budget AND the backoff
+                        # ramp both restart from the bottom
+                        retries = 0
+                        boff = _reconnect_backoff()
                     yield ev
                 return  # server ended the stream cleanly
             except SubShedError:
@@ -314,13 +333,18 @@ class SubscriptionStream:
                 retries += 1
                 if self.query_id is None or retries > self._max_retries:
                     raise
-                await asyncio.sleep(min(2.0, 0.1 * 2**retries))
+                await asyncio.sleep(next(boff))
             except (aiohttp.ClientError, asyncio.TimeoutError, ClientError,
                     StreamReset, ConnectionError):
+                # a mid-request agent restart lands here as a TYPED
+                # retryable error (the h2 session's wait_for + the
+                # transport error set — never a hang); past the retry
+                # cap it surfaces to the caller (pinned in
+                # tests/test_chaos.py)
                 retries += 1
                 if self.query_id is None or retries > self._max_retries:
                     raise
-                await asyncio.sleep(min(2.0, 0.1 * 2**retries))
+                await asyncio.sleep(next(boff))
 
     async def _connect_once(self):
         s = await self.client._ensure()

@@ -72,6 +72,11 @@ class LinkFaults:
     node_duplicate: Dict[str, float] = field(default_factory=dict)
     # addr -> probability an outbound datagram is delivered TWICE
     # (dup-prone NIC/retry pathology; exercises SWIM idempotency)
+    link_latency: Dict[Tuple[str, str], float] = field(default_factory=dict)
+    # (src, dst) -> extra one-way delay (s) on that DIRECTED link — the
+    # geo-latency matrix (r18 chaos): per-region RTTs that neither the
+    # global nor the per-node knob can express (a node is "far" from
+    # some peers and "near" others).  Composes additively with both.
 
 
 class _MemBiStream(BiStream):
@@ -86,7 +91,12 @@ class _MemBiStream(BiStream):
         if self._closed or self.other is None:
             raise TransportError("stream closed")
         # the sending side's own addr is the remote end's peer label
-        await self._net._delay(self.other._peer)
+        await self._net._delay(self.other._peer, self._peer)
+        if self._net._stalled(self.other._peer, self._peer):
+            # zombie endpoint: the payload sits in a kernel buffer no
+            # stalled event loop will ever read — send() "succeeds",
+            # nothing is delivered, the peer's recv() hangs
+            return
         self.other._inbox.put_nowait(payload)
 
     async def recv(self) -> Optional[bytes]:
@@ -130,12 +140,19 @@ class MemNetwork:
         self.faults = faults or LinkFaults()
         self._partitions: Set[Tuple[str, str]] = set()
         self._down: Set[str] = set()
+        self._zombies: Set[str] = set()
 
     # -- topology faults --------------------------------------------------
 
     def partition(self, a: str, b: str) -> None:
         self._partitions.add((a, b))
         self._partitions.add((b, a))
+
+    def partition_oneway(self, a: str, b: str) -> None:
+        """Asymmetric partition (r18 chaos): a's traffic to b is dropped
+        while b still reaches a — the half-open link that makes b keep
+        believing a is fine (b's probes go unanswered only one way)."""
+        self._partitions.add((a, b))
 
     def heal(self, a: str, b: str) -> None:
         self._partitions.discard((a, b))
@@ -164,10 +181,39 @@ class MemNetwork:
         self.faults.node_duplicate[addr] = duplicate
 
     def restore(self, addr: str) -> None:
-        """Clear a node's degradation."""
+        """Clear a node's degradation (including zombie state)."""
         self.faults.node_latency.pop(addr, None)
         self.faults.node_datagram_loss.pop(addr, None)
         self.faults.node_duplicate.pop(addr, None)
+        self._zombies.discard(addr)
+
+    def zombie(self, addr: str) -> None:
+        """Mark a node a ZOMBIE (r18 chaos): its process looks alive at
+        the transport layer — connections are accepted, streams open,
+        sends land in its kernel buffers — but its event loop is stalled,
+        so no handler ever runs and no byte ever comes back.  Distinct
+        from `take_down` (connection refused) and `degrade` (slow but
+        answering): the zombie is the peer that makes unbounded
+        `await stream.recv()` hang forever — the bug class the
+        timeout-discipline rule exists for.  Cleared by `restore`."""
+        self._zombies.add(addr)
+
+    def is_zombie(self, addr: str) -> bool:
+        return addr in self._zombies
+
+    def set_link_latency(
+        self, a: str, b: str, secs: float, symmetric: bool = True
+    ) -> None:
+        """Set the geo-matrix delay of the a→b link (and b→a when
+        symmetric).  0 clears the entry."""
+        for pair in ((a, b), (b, a)) if symmetric else ((a, b),):
+            if secs > 0:
+                self.faults.link_latency[pair] = secs
+            else:
+                self.faults.link_latency.pop(pair, None)
+
+    def clear_link_latency(self) -> None:
+        self.faults.link_latency.clear()
 
     def _reachable(self, src: str, dst: str) -> bool:
         if dst in self._down or src in self._down:
@@ -176,9 +222,19 @@ class MemNetwork:
             return False
         return dst in self._nodes
 
-    async def _delay(self, src: Optional[str] = None) -> None:
+    def _stalled(self, src: str, dst: str) -> bool:
+        """True when delivery src→dst must be silently withheld because
+        one endpoint is a zombie: a stalled receiver never drains its
+        socket, a stalled sender never writes to its own."""
+        return src in self._zombies or dst in self._zombies
+
+    async def _delay(
+        self, src: Optional[str] = None, dst: Optional[str] = None
+    ) -> None:
         f = self.faults
         extra = f.node_latency.get(src, 0.0) if src else 0.0
+        if src and dst:
+            extra += f.link_latency.get((src, dst), 0.0)
         if f.latency or f.jitter or extra:
             await asyncio.sleep(
                 f.latency + extra + self._rng.random() * f.jitter
@@ -230,11 +286,13 @@ class MemTransport(Transport):
         )
         if loss and net._rng.random() < loss:
             return
+        if net._stalled(self._src, addr):
+            return  # zombie endpoint: datagrams die in a stalled socket
         node = net._nodes[addr]
         src = self._src
 
         async def deliver():
-            await net._delay(src)
+            await net._delay(src, addr)
             await node.on_datagram(src, data)
 
         # detached delivery like real UDP: the sender never blocks on the
@@ -244,7 +302,7 @@ class MemTransport(Transport):
         if dup and net._rng.random() < dup:
 
             async def deliver_again():
-                await net._delay(src)
+                await net._delay(src, addr)
                 await node.on_datagram(src, data)
 
             _spawn_logged(deliver_again(), "datagram-dup", self._src, addr)
@@ -255,7 +313,11 @@ class MemTransport(Transport):
             raise TransportError(f"unreachable: {addr}")
         node = net._nodes[addr]
         start = time.monotonic()
-        await net._delay(self._src)
+        await net._delay(self._src, addr)
+        if net._stalled(self._src, addr):
+            # zombie endpoint: the stream opens (no error — the kernel
+            # accepts), the payload is never read
+            return
         # deliver as an independent task, like a uni-stream read loop
         _spawn_logged(node.on_uni(self._src, payload), "uni", self._src, addr)
         self.observe_rtt(addr, 2 * (time.monotonic() - start))
@@ -268,6 +330,14 @@ class MemTransport(Transport):
         local = _MemBiStream(addr, net)
         remote = _MemBiStream(self._src, net)
         local.other, remote.other = remote, local
-        await net._delay(self._src)
+        await net._delay(self._src, addr)
+        if net._stalled(self._src, addr):
+            # zombie endpoint: the TCP/QUIC handshake is answered by the
+            # kernel of the stalled process, so open_bi SUCCEEDS — but
+            # the application handler never runs.  The caller gets a
+            # stream that accepts sends and never answers: exactly the
+            # peer shape that must trip recv deadlines + the PeerCircuit
+            # breaker, never stall a sync round.
+            return local
         _spawn_logged(node.on_bi(remote), "bi", self._src, addr)
         return local

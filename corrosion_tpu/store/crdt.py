@@ -475,6 +475,11 @@ class CrdtStore:
         # direct_capture), ANDed with the CORRO_CAPTURE env engine.
         self.direct_capture = True
         self._shape_cache: Dict[str, Optional[object]] = {}
+        # r18 chaos: optional injected disk pathology (chaos/faults.py
+        # StoreFaults) consulted at the writer-statement, COMMIT and
+        # remote-apply touch points — None (the default) costs one
+        # attribute check on each
+        self.chaos = None
         # own/remote head-version cache: db_version_for is on every
         # commit's path, and the value only changes through
         # _bump_db_version (cache updated there) — cleared on rollback
@@ -1199,6 +1204,12 @@ class CrdtStore:
             self._conn.execute("BEGIN IMMEDIATE")
             try:
                 yield self
+                if self.chaos is not None:
+                    # r18 slow/sick-disk injection: commit latency and
+                    # transient I/O errors land HERE, where a real disk
+                    # would surface them — the whole group aborts and
+                    # every writer gets a typed error
+                    self.chaos.on_commit()
                 self._conn.execute("COMMIT")
             except BaseException:
                 _safe_rollback(self._conn)
@@ -1413,6 +1424,10 @@ class CrdtStore:
         `tests/test_crdt_batch.py` (randomized equivalence)."""
         impactful: List[Change] = []
         changed_tables: Dict[str, int] = {}
+        if self.chaos is not None:
+            # r18 slow-disk injection on the ingest path: a sick-disk
+            # node falls behind the cluster, not just its own clients
+            self.chaos.on_apply()
         with self._lock:
             self._conn.execute("BEGIN IMMEDIATE")
             # gate triggers off for the remote apply — a Python store,
@@ -2361,6 +2376,11 @@ class WriteTx:
         (store/capture.py) instead of taking the trigger →
         `__crdt_pending` round-trip; raw/unrecognized SQL keeps the
         trigger path, and the two streams merge in statement order."""
+        if self.store.chaos is not None:
+            # r18 sick-disk injection: a transient SQLITE_BUSY here
+            # aborts THIS writer's sub-transaction only (savepoint
+            # isolation in a group commit)
+            self.store.chaos.on_statement()
         if self._direct:
             shape = self.store.capture_shape(sql)
             if shape is not None:
@@ -2379,6 +2399,8 @@ class WriteTx:
         SAVEPOINT: a row that fails mid-batch rolls the batch back
         before raising, so the in-memory capture never diverges from
         partially-applied statements."""
+        if self.store.chaos is not None:
+            self.store.chaos.on_statement()
         rows = list(rows)
         if self._direct and rows:
             shape = self.store.capture_shape(sql)
@@ -2675,6 +2697,11 @@ class WriteTx:
                 if self._savepoint:
                     conn.execute("RELEASE SAVEPOINT __corro_wtx")
             else:
+                if self.store.chaos is not None:
+                    # r18 slow/sick-disk injection on the solo
+                    # (group-commit-off) path — the group path's hook
+                    # lives in group_tx
+                    self.store.chaos.on_commit()
                 conn.execute("COMMIT")
             self._done = True
             if changes:

@@ -1,0 +1,358 @@
+"""Chaos-engine fault injection: the store shim, the zombie peer, and
+the degradation disciplines the r18 matrix forced (each regression test
+names the scenario that found its bug).
+
+Layers covered here (the full matrix lives in scripts/traffic_sim.py →
+TRAFFIC_SIM.json; its tiny-shape replica in tests/test_traffic_sim.py):
+
+- STORE: transient SQLITE_BUSY during a group commit fails ONLY the
+  affected writer (savepoint isolation proven under injected faults,
+  not just claimed) and leaves the store writable; an injected I/O
+  error at COMMIT surfaces typed to every writer and the next commit
+  succeeds.
+- PROCESS: a zombie peer (sockets open, loop stalled) trips the r17
+  PeerCircuit breaker instead of stalling sync rounds — the
+  timeout-discipline deadlines are what turn the hang into a counted
+  failure.
+- ANNOUNCER: the zombie-node scenario's orphaning bug — an eviction
+  mid-steady-sleep left a node silent for the rest of its 300 s
+  announce period; the announce_wake event must end that sleep the
+  moment the SWIM view collapses to self.
+- CLIENT: a mid-stream agent restart surfaces a TYPED retryable error
+  through the capped full-jitter reconnect loop, never a hang.
+"""
+
+import asyncio
+import contextlib
+import json
+import sqlite3
+import time
+
+import aiohttp
+import pytest
+
+from corrosion_tpu.agent import syncer
+from corrosion_tpu.agent.run import make_broadcastable_changes, shutdown
+from corrosion_tpu.api.http import ApiServer
+from corrosion_tpu.chaos.faults import CENSUS, StoreFaults
+from corrosion_tpu.chaos.scenarios import ChaosEngine, Scenario, zombie_node
+from corrosion_tpu.client import ClientError, CorrosionApiClient
+from corrosion_tpu.net.mem import MemNetwork
+
+from tests.test_agent import TEST_SCHEMA, boot, count_rows, wait_until
+
+
+def test_group_commit_busy_fault_fails_only_affected_writer():
+    """sick-disk scenario class: a transient SQLITE_BUSY raised on one
+    writer's statement mid-group-commit aborts THAT writer's savepoint
+    alone — its 7 batchmates commit, and the store stays writable."""
+
+    async def main():
+        net = MemNetwork(seed=41)
+        a = await boot(net, "sick-a")
+        try:
+            doomed_error = {}
+
+            def writer(i):
+                def fn(tx):
+                    if i == 3:
+                        # deterministic injection through the real shim:
+                        # every statement of THIS writer's sub-tx fails
+                        a.store.chaos = StoreFaults(statement_busy_rate=1.0)
+                    try:
+                        return [tx.execute(
+                            "INSERT INTO tests (id, text) VALUES (?, ?)",
+                            [100 + i, f"w{i}"],
+                        )]
+                    finally:
+                        a.store.chaos = None
+                return fn
+
+            async def submit(i):
+                try:
+                    return await make_broadcastable_changes(a, writer(i))
+                except sqlite3.OperationalError as e:
+                    doomed_error[i] = e
+                    return None
+
+            results = await asyncio.gather(*(submit(i) for i in range(8)))
+            ok = [r for r in results if r is not None]
+            assert len(ok) == 7, f"exactly one writer must fail, got {results}"
+            assert list(doomed_error) == [3]
+            assert "chaos-injected" in str(doomed_error[3])
+            # the store is still writable after the fault
+            res = await make_broadcastable_changes(
+                a,
+                lambda tx: [tx.execute(
+                    "INSERT INTO tests (id, text) VALUES (?, ?)", [999, "ok"],
+                )],
+            )
+            assert res.version > 0
+            assert count_rows(a) == 8  # 7 survivors + the follow-up
+        finally:
+            await shutdown(a)
+
+    asyncio.run(main())
+
+
+def test_commit_io_error_is_typed_and_transient():
+    """sick-disk scenario class: an injected disk I/O error at COMMIT
+    surfaces as a typed sqlite error to the writer; clearing the fault
+    leaves the store fully writable (no wedged lock, no poisoned
+    connection)."""
+
+    async def main():
+        net = MemNetwork(seed=43)
+        a = await boot(net, "sick-b")
+        try:
+            a.store.chaos = StoreFaults(commit_io_error_rate=1.0)
+            with pytest.raises(sqlite3.OperationalError, match="chaos-injected"):
+                await make_broadcastable_changes(
+                    a,
+                    lambda tx: [tx.execute(
+                        "INSERT INTO tests (id, text) VALUES (?, ?)",
+                        [1, "doomed"],
+                    )],
+                )
+            a.store.chaos = None
+            res = await make_broadcastable_changes(
+                a,
+                lambda tx: [tx.execute(
+                    "INSERT INTO tests (id, text) VALUES (?, ?)", [2, "ok"],
+                )],
+            )
+            assert res.version > 0
+            assert count_rows(a) == 1
+        finally:
+            a.store.chaos = None
+            await shutdown(a)
+
+    asyncio.run(main())
+
+
+def test_zombie_peer_trips_circuit_breaker_not_the_round():
+    """zombie-node scenario: a peer whose sockets stay open while its
+    loop is stalled must cost counted recv timeouts that open the r17
+    PeerCircuit breaker — and the sync loop must keep completing rounds
+    (no unbounded stall) while the zombie is in the peer set."""
+
+    async def main():
+        saved = (syncer.RECV_TIMEOUT, syncer.OPEN_TIMEOUT)
+        syncer.RECV_TIMEOUT, syncer.OPEN_TIMEOUT = 0.5, 0.5
+        net = MemNetwork(seed=47)
+        from corrosion_tpu.agent.membership import SwimConfig
+
+        # suspicion window longer than the zombie window: the peer must
+        # STAY in the member set so sync keeps dialing it — the breaker,
+        # not eviction, is what this test exercises
+        gentle = SwimConfig(probe_period=0.25, probe_rtt=0.1,
+                            suspicion_mult=16)
+
+        def tune(cfg):
+            cfg.sync.circuit_reset_secs = 2.0
+
+        a = await boot(net, "za", cfg=_tuned(tune, "za"))
+        a.membership.config = gentle
+        b = await boot(net, "zb", cfg=_tuned(tune, "zb", bootstrap=("za",)))
+        b.membership.config = gentle
+        try:
+            assert await wait_until(
+                lambda: a.membership.cluster_size == 2
+                and b.membership.cluster_size == 2
+            )
+            rounds0 = _peek("corro.sync.client.rounds")
+            net.zombie("zb")
+
+            def circuit_open():
+                c = a.sync_circuits.get(b.actor_id)
+                return c is not None and not c.allows(time.monotonic())
+
+            assert await wait_until(circuit_open, timeout=30), (
+                "zombie peer never opened its circuit"
+            )
+            # rounds kept completing while the zombie was dialed: the
+            # deadline turned each dead session into a bounded failure
+            assert await wait_until(
+                lambda: _peek("corro.sync.client.rounds") > rounds0 + 1,
+                timeout=20,
+            ), "sync rounds stalled behind the zombie"
+
+            # heal: breaker half-opens after reset and sync repairs
+            net.restore("zb")
+            await make_broadcastable_changes(
+                b,
+                lambda tx: [tx.execute(
+                    "INSERT INTO tests (id, text) VALUES (?, ?)", [7, "post"],
+                )],
+            )
+            assert await wait_until(
+                lambda: count_rows(a, "id = 7") == 1, timeout=30
+            ), "cluster never repaired after zombie restore"
+        finally:
+            syncer.RECV_TIMEOUT, syncer.OPEN_TIMEOUT = saved
+            await shutdown(b)
+            await shutdown(a)
+
+    asyncio.run(main())
+
+
+def test_isolation_wakes_announcer_from_steady_sleep():
+    """zombie-node scenario regression (the r18 orphaning bug): with a
+    healthy cluster the announcer sleeps announce_steady_period (300 s
+    default).  A zombie window long enough for mutual eviction used to
+    leave the node SILENT for the rest of that sleep — no probes
+    (nothing left to probe), no announces — an orphan for minutes after
+    the network healed.  The announce_wake event must end the sleep the
+    moment the SWIM view collapses to self, so rejoin rides the
+    jittered ramp instead of the steady period."""
+
+    async def main():
+        net = MemNetwork(seed=53)
+        from corrosion_tpu.agent.membership import SwimConfig
+
+        # fast eviction + fast announce ramp, but the STEADY period
+        # stays at its 300 s default — the pre-fix behavior would park
+        # the announcer there and fail the rejoin bound below
+        fast = SwimConfig(
+            probe_period=0.05, probe_rtt=0.02, suspicion_mult=1.0,
+            announce_backoff_start=0.2, announce_backoff_max=1.0,
+        )
+        a = await boot(net, "wa")
+        a.membership.config = fast
+        b = await boot(net, "wb", bootstrap=("wa",))
+        b.membership.config = fast
+        try:
+            assert await wait_until(
+                lambda: a.membership.cluster_size == 2
+                and b.membership.cluster_size == 2
+            )
+            # let both announcers enter their steady-period sleep
+            await asyncio.sleep(0.3)
+            net.zombie("wb")
+            # mutual eviction: both views collapse to self
+            assert await wait_until(
+                lambda: a.membership.cluster_size == 1
+                and b.membership.cluster_size == 1,
+                timeout=20,
+            ), "zombie window never evicted"
+            net.restore("wb")
+            t0 = time.monotonic()
+            assert await wait_until(
+                lambda: a.membership.cluster_size == 2
+                and b.membership.cluster_size == 2,
+                timeout=15,
+            ), "isolated node never rejoined (announcer still asleep?)"
+            assert time.monotonic() - t0 < 15.0
+        finally:
+            await shutdown(b)
+            await shutdown(a)
+
+    asyncio.run(main())
+
+
+def test_client_restart_surfaces_typed_error_not_hang():
+    """client.py audit pin: an agent restart mid-subscription must
+    surface a TYPED retryable error through the capped full-jitter
+    reconnect loop within a bounded wall — never a hang."""
+
+    async def main():
+        net = MemNetwork(seed=59)
+        a = await boot(net, "ca")
+        api = ApiServer(a)
+        a.config.api.bind_addr = ["127.0.0.1:0"]
+        await api.start()
+        client = CorrosionApiClient(api.addrs[0])
+        try:
+            stream = client.subscribe(
+                "SELECT id, text FROM tests", skip_rows=True
+            )
+            stream._max_retries = 2  # keep the capped loop fast in-suite
+            it = stream.__aiter__()
+
+            async def first_event():
+                await make_broadcastable_changes(
+                    a,
+                    lambda tx: [tx.execute(
+                        "INSERT INTO tests (id, text) VALUES (?, ?)",
+                        [1, "live"],
+                    )],
+                )
+                return await it.__anext__()
+
+            ev = await asyncio.wait_for(first_event(), 10)
+            assert "change" in ev or "columns" in ev
+            # the /v1/status chaos census rides the same live API: with
+            # no drill running it must read inactive (the operator's
+            # drill-vs-outage discriminator)
+            session = await client._ensure()
+            async with session.get(f"{client.base}/v1/status") as resp:
+                status = json.loads(await resp.text())
+            assert status["chaos"]["active"] is False
+            assert status["chaos"]["scenario"] is None
+            # kill the serving side mid-stream
+            await api.stop()
+            with pytest.raises(
+                (aiohttp.ClientError, ConnectionError, ClientError,
+                 StopAsyncIteration)
+            ):
+                # typed within the retry budget (2 retries × ≤2 s full
+                # jitter) — the 20 s wait_for is the hang detector
+                await asyncio.wait_for(_drain(it), 20)
+        finally:
+            await client.close()
+            with contextlib.suppress(Exception):
+                await api.stop()
+            await shutdown(a)
+
+    asyncio.run(main())
+
+
+def test_chaos_census_marks_drills():
+    """/v1/status discriminator: an applied scenario registers in the
+    process-global census (scenario id + per-injection summaries) and
+    restore() clears it."""
+
+    async def main():
+        net = MemNetwork(seed=61)
+        engine = ChaosEngine()
+        assert CENSUS.snapshot()["active"] is False
+        await engine.apply(
+            Scenario("drill-1", [zombie_node(net, "nowhere")])
+        )
+        snap = CENSUS.snapshot()
+        assert snap["active"] is True
+        assert snap["scenario"] == "drill-1"
+        assert any("zombie" in s for s in snap["injections"].values())
+        assert net.is_zombie("nowhere")
+        await engine.restore()
+        snap = CENSUS.snapshot()
+        assert snap["active"] is False
+        assert snap["injections"] == {}
+        assert not net.is_zombie("nowhere")
+
+    asyncio.run(main())
+
+
+# -- helpers ----------------------------------------------------------------
+
+
+def _tuned(tune, addr, bootstrap=()):
+    from tests.test_agent import fast_config
+
+    cfg = fast_config(addr, bootstrap)
+    tune(cfg)
+    return cfg
+
+
+def _peek(name: str, **labels) -> float:
+    from corrosion_tpu.runtime.metrics import METRICS
+
+    for _kind, sname, slabels, value in METRICS.snapshot():
+        if sname == name and slabels == labels:
+            return value
+    return 0.0
+
+
+async def _drain(it):
+    while True:
+        await it.__anext__()

@@ -10,7 +10,7 @@ Three layers, mirroring what the suite promises:
    `# corro: noqa[rule]` comment suppresses (proving the whole
    driver-side filter chain, not just the checker).
 3. THE FOLD IS LOSSLESS: the metrics lint folded into the framework
-   still reports the same 209 literal series + 2 wildcard sites in both
+   still reports the same 213 literal series + 2 wildcard sites in both
    directions, and the `scripts/lint_metrics.py` shim keeps its API.
 
 All pure-AST: no jax tracing, no sqlite, no network — the gate must
@@ -42,6 +42,9 @@ from corrosion_tpu.analysis.lockcheck import (  # noqa: E402
 from corrosion_tpu.analysis.metricsdoc import MetricsDocChecker  # noqa: E402
 from corrosion_tpu.analysis.parity import LaneParityChecker  # noqa: E402
 from corrosion_tpu.analysis.purity import KernelPurityChecker  # noqa: E402
+from corrosion_tpu.analysis.timeouts import (  # noqa: E402
+    TimeoutDisciplineChecker,
+)
 
 
 def _write(root, rel, body):
@@ -692,21 +695,106 @@ def test_capture_parity_real_tree_is_clean():
     assert CaptureParityChecker().run(AnalysisContext(REPO)) == []
 
 
-# -- 8. the metrics fold + baseline machinery -------------------------------
+# -- 8. timeout-discipline --------------------------------------------------
+
+_UNBOUNDED_NET_AWAITS = """
+    async def session(stream, transport, addr):
+        stream2 = await transport.open_bi(addr)
+        await stream.send(b"hello")
+        frame = await stream.recv()
+        await transport.send_uni(addr, b"payload")
+        await stream.finish()
+        return frame
+"""
+
+_BOUNDED_NET_AWAITS = """
+    import asyncio
+
+    RECV_TIMEOUT = 10.0
+    SEND_TIMEOUT = 30.0
+
+    async def session(stream, transport, addr):
+        stream2 = await asyncio.wait_for(
+            transport.open_bi(addr), SEND_TIMEOUT
+        )
+        await asyncio.wait_for(stream.send(b"hello"), SEND_TIMEOUT)
+        frame = await asyncio.wait_for(stream.recv(), RECV_TIMEOUT)
+        await asyncio.wait_for(
+            transport.send_uni(addr, b"payload"), SEND_TIMEOUT
+        )
+        await asyncio.wait_for(stream.finish(), SEND_TIMEOUT)
+        return frame
+"""
+
+
+def test_timeout_discipline_fires_on_seeded_violations(tmp_path):
+    _write(tmp_path, "agent/sessions.py", _UNBOUNDED_NET_AWAITS)
+    ctx = AnalysisContext(str(tmp_path))
+    fs = TimeoutDisciplineChecker(scope=("agent",)).run(ctx)
+    assert len(fs) == 5, "\n".join(f.message for f in fs)
+    assert all("wrap in asyncio.wait_for" in f.message for f in fs)
+    flagged = {f.snippet for f in fs}
+    assert any(".recv()" in s for s in flagged)
+    assert any("open_bi" in s for s in flagged)
+
+
+def test_timeout_discipline_minimal_fix_passes(tmp_path):
+    _write(tmp_path, "agent/sessions.py", _BOUNDED_NET_AWAITS)
+    ctx = AnalysisContext(str(tmp_path))
+    assert TimeoutDisciplineChecker(scope=("agent",)).run(ctx) == []
+
+
+def test_timeout_discipline_exempts_channels_and_datagrams(tmp_path):
+    # in-process channels (tx_/rx_, runtime/channels.py backpressure by
+    # design) and UDP fire-and-forget datagrams are NOT peer waits
+    _write(
+        tmp_path,
+        "agent/loops.py",
+        """
+        async def pump(agent, addr, data):
+            item = await agent.rx_apply.recv()
+            await agent.tx_bcast.send(item)
+            await agent.transport.send_datagram(addr, data)
+        """,
+    )
+    ctx = AnalysisContext(str(tmp_path))
+    assert TimeoutDisciplineChecker(scope=("agent",)).run(ctx) == []
+
+
+def test_timeout_discipline_noqa_suppresses(tmp_path):
+    body = _UNBOUNDED_NET_AWAITS.replace(
+        'await stream.send(b"hello")',
+        'await stream.send(b"hello")  # corro: noqa[timeout-discipline]',
+    )
+    _write(tmp_path, "agent/sessions.py", body)
+    ctx = AnalysisContext(str(tmp_path))
+    result = run_analysis(
+        ctx, [TimeoutDisciplineChecker(scope=("agent",))], baseline={}
+    )
+    assert len(result.suppressed) == 1
+    assert len(result.new) == 4
+
+
+def test_timeout_discipline_real_tree_is_clean():
+    """The zombie-node fix round (r18): every network await in agent/
+    and api/ now carries a deadline — this pin keeps it that way."""
+    assert TimeoutDisciplineChecker().run(AnalysisContext(REPO)) == []
+
+
+# -- 9. the metrics fold + baseline machinery -------------------------------
 
 
 def test_metrics_fold_reports_same_inventory():
-    """The lint_metrics fold is lossless: same 209 literal series (192
-    at r16 + the 17 r17 catch-up-plane series — corro.snapshot.* and
-    the sync resume/circuit counters), same 2 wildcard sites, both
-    directions clean, via BOTH the framework checker and the
-    back-compat shim."""
+    """The lint_metrics fold is lossless: same 213 literal series (209
+    at r17 + the 4 r18 chaos-engine series — corro.chaos.*), same 2
+    wildcard sites, both directions clean, via BOTH the framework
+    checker and the back-compat shim."""
     import lint_metrics
 
     assert MetricsDocChecker().run(AnalysisContext(REPO)) == []
     assert lint_metrics.lint() == []
     literals, wildcards = lint_metrics.scan_call_sites()
-    assert len(literals) == 209
+    assert len(literals) == 213
     assert len(wildcards) == 2
     names = lint_metrics.parse_components_table()
     assert len(names) == len(set(names))

@@ -1,0 +1,164 @@
+"""Tier-1 gate for the chaos matrix (scripts/traffic_sim.py).
+
+Two layers:
+
+1. BANKED-ARTIFACT GUARDS — TRAFFIC_SIM.json (the full 4-node,
+   8-scenario matrix, heavy rungs banked-only) keeps its shape, its
+   sha stamps, and the serving bars: zero op timeouts anywhere (the
+   hang witness), availability floors, typed refusals counted where
+   faults were injected, recovery + the closing zero-divergence
+   verdict per scenario.
+2. IN-SUITE TINY REPLICA — `run_matrix(tiny=True)` runs the 3-node
+   {baseline, zombie-node, sick-disk} subset live (~5 s nominal,
+   budget ≤10 s): the same bars asserted against a real devcluster
+   under real faults every tier-1 run.
+
+Margin discipline (r15 memory): the banked guards pin deterministic
+facts only — counts, floors, verdicts — never wall-clock ratios; the
+replica's wall is bounded by a wide backstop (the host drifts ±30%).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+PATH = os.path.join(REPO, "TRAFFIC_SIM.json")
+
+FULL_SCENARIOS = (
+    "baseline",
+    "geo-latency",
+    "asym-partition",
+    "flap-storm",
+    "churn-storm",
+    "zombie-node",
+    "slow-disk",
+    "sick-disk",
+)
+STAGES = ("write", "query", "subscribe", "render")
+
+
+@pytest.fixture(scope="module")
+def banked() -> dict:
+    with open(PATH) as f:
+        return json.load(f)
+
+
+@pytest.fixture(scope="module")
+def by_id(banked) -> dict:
+    return {s["scenario"]: s for s in banked["scenarios"]}
+
+
+def test_matrix_shape(banked, by_id):
+    assert banked["mode"] == "full"
+    assert banked["nodes"] == 4
+    for sid in FULL_SCENARIOS:
+        assert sid in by_id, f"missing scenario {sid}"
+    for sid, rec in by_id.items():
+        for stage in STAGES:
+            assert stage in rec["stages"], f"{sid}: no {stage} stage"
+        assert rec["injections"] or sid == "baseline"
+
+
+def test_records_are_sha_stamped(banked):
+    sha = banked.get("code_sha")
+    assert sha and "corrosion_tpu/chaos/faults.py" in sha
+    assert "corrosion_tpu/chaos/workload.py" in sha
+    assert "corrosion_tpu/net/mem.py" in sha
+    assert all(v != "missing" for v in sha.values()), sha
+    assert banked.get("measured_at")
+
+
+def test_no_op_ever_hit_its_deadline(by_id):
+    """The matrix's standing bar: faults may shrink `ok`, they must
+    never convert a request into a stall — zero timeouts across every
+    stage of every scenario."""
+    for sid, rec in by_id.items():
+        for stage, st in rec["stages"].items():
+            assert st["timeouts"] == 0, f"{sid}/{stage}"
+
+
+def test_availability_floors(by_id):
+    for sid, rec in by_id.items():
+        for stage in ("write", "query"):
+            st = rec["stages"][stage]
+            assert st["attempts"] > 0, f"{sid}/{stage}: no traffic"
+            floor = 0.98 if sid == "baseline" else 0.5
+            assert st["availability"] >= floor, (
+                f"{sid}/{stage}: {st['availability']}"
+            )
+            assert st["p50_secs"] is not None, f"{sid}/{stage}"
+            assert st["p99_secs"] is not None, f"{sid}/{stage}"
+
+
+def test_every_scenario_recovered_to_zero_divergence(by_id):
+    """The closing verdict: after restore() every scenario's cluster
+    converged (row counts equal everywhere, probe write delivered) and
+    the divergence detector reported one view group."""
+    for sid, rec in by_id.items():
+        r = rec["recovery"]
+        assert r["secs"] is not None, f"{sid}: never recovered"
+        assert r["converged"], sid
+        assert r["divergence_zero"], sid
+
+
+def test_cluster_scorecard_was_scraped(by_id):
+    """The percentiles come from the cluster's OWN planes: every
+    scenario's /v1/slo scrape carries a populated write→event `total`
+    stage, and /v1/cluster answered with full digest coverage."""
+    for sid, rec in by_id.items():
+        slo = rec.get("slo")
+        assert slo and slo.get("total", {}).get("count"), (
+            f"{sid}: /v1/slo total stage empty"
+        )
+        cl = rec.get("cluster")
+        assert cl and cl.get("nodes_known"), f"{sid}: /v1/cluster empty"
+
+
+def test_subscriptions_delivered_under_every_fault(by_id):
+    for sid, rec in by_id.items():
+        assert rec["events_delivered"] > 0, f"{sid}: no live events"
+
+
+def test_injected_store_faults_surface_typed(by_id):
+    """sick-disk: the injected SQLITE_BUSY/IO errors must appear as
+    COUNTED typed refusals (the cluster answered; nothing hung)."""
+    st = by_id["sick-disk"]["stages"]["write"]
+    assert st["refusals"] > 0
+    assert st["timeouts"] == 0
+
+
+# -- the in-suite tiny replica ----------------------------------------------
+
+
+def test_tier1_replica_serves_under_faults():
+    """Live tiny-shape chaos: 3 nodes × {baseline, zombie-node,
+    sick-disk} through the REAL HTTP/subscription surfaces.  Every bar
+    (`_assert_bars`) runs inside `run_matrix`; this test re-states the
+    headline ones and bounds the wall with a wide backstop (nominal
+    ~5 s — the ≤10 s replica budget — backstop 3× for host drift)."""
+    import traffic_sim
+
+    t0 = time.monotonic()
+    record = asyncio.run(traffic_sim.run_matrix(tiny=True))
+    elapsed = time.monotonic() - t0
+    ids = [s["scenario"] for s in record["scenarios"]]
+    assert ids == ["baseline", "zombie-node", "sick-disk"]
+    for rec in record["scenarios"]:
+        for stage, st in rec["stages"].items():
+            assert st["timeouts"] == 0, f"{rec['scenario']}/{stage}"
+        assert rec["recovery"]["divergence_zero"], rec["scenario"]
+    # tiny-shape sick disk fails every statement on the sick node:
+    # typed refusals are deterministic, not a rate coin-flip
+    sick = next(s for s in record["scenarios"] if s["scenario"] == "sick-disk")
+    assert sick["stages"]["write"]["refusals"] > 0
+    assert elapsed < 15.0, f"tiny replica took {elapsed:.1f}s (budget 10s)"
