@@ -74,6 +74,7 @@ class _Pending:
     # envelope-ext stamps re-written per transmission with the digest
     ext_origin_ts: Optional[float] = field(compare=False, default=None)
     ext_traceparent: Optional[str] = field(compare=False, default=None)
+    ext_trace_meta: Optional[int] = field(compare=False, default=None)
 
 
 async def broadcast_loop(agent: Agent) -> None:
@@ -124,7 +125,8 @@ async def broadcast_loop(agent: Agent) -> None:
                         due=now,
                         seq=seq,
                         payload=encode_uni_from_prefix(
-                            prefix, cv.origin_ts, cv.traceparent
+                            prefix, cv.origin_ts, cv.traceparent,
+                            trace_meta=cv.trace_meta,
                         ),
                         prefix=prefix,
                         origin=cv.actor_id.bytes16,
@@ -137,6 +139,7 @@ async def broadcast_loop(agent: Agent) -> None:
                         ),
                         ext_origin_ts=cv.origin_ts,
                         ext_traceparent=cv.traceparent,
+                        ext_trace_meta=cv.trace_meta,
                     ),
                 )
 
@@ -197,6 +200,7 @@ def _fit_to_bucket(cv, capacity: float):
         cv.actor_id, cs.version, cs.changes, cs.last_seq, cs.ts,
         origin_ts=cv.origin_ts, traceparent=cv.traceparent,
         max_bytes=max(1, int(capacity) // 2), seq_range=cs.seqs,
+        trace_meta=cv.trace_meta,
     )
     METRICS.counter("corro.broadcast.chunked.total").inc(len(chunks))
     return chunks
@@ -222,7 +226,8 @@ async def _transmit(agent: Agent, bucket: TokenBucket, p: _Pending) -> bool:
         p.payload
         if digest is None
         else encode_uni_from_prefix(
-            p.prefix, p.ext_origin_ts, p.ext_traceparent, digest
+            p.prefix, p.ext_origin_ts, p.ext_traceparent, digest,
+            p.ext_trace_meta,
         )
     )
     if len(payload) > bucket.capacity:
@@ -235,7 +240,16 @@ async def _transmit(agent: Agent, bucket: TokenBucket, p: _Pending) -> bool:
         # commit→wire: broadcast batching + queue delay at the origin
         from corrosion_tpu.runtime.latency import e2e_observe
 
-        e2e_observe("broadcast", time.time() - p.origin_wall)
+        delta = e2e_observe("broadcast", time.time() - p.origin_wall)
+        if p.ext_traceparent is not None:
+            # r19: the same hop as a stage span on the write's trace
+            from corrosion_tpu.runtime.trace import meta_forced, stage_span
+
+            stage_span(
+                p.ext_traceparent, "broadcast.send", "broadcast", delta,
+                forced=meta_forced(p.ext_trace_meta),
+                actor=str(agent.actor_id),
+            )
     if p.send_count == 0:
         # ring0 gets first-transmission priority (mod.rs:591-651)
         targets.extend(

@@ -125,6 +125,20 @@ async def serve_snapshot(agent: Agent, stream: BiStream, req: SnapshotReq) -> No
         )
         await asyncio.wait_for(stream.finish(), SEND_TIMEOUT)
 
+    from corrosion_tpu.runtime.trace import continue_from
+
+    # adopt the cold node's bootstrap trace from the wire (r19): the
+    # serve — rejection or full stream — is one span of THAT trace
+    with continue_from(
+        req.traceparent, "catchup.snapshot.serve",
+        peer=str(req.actor_id), actor=str(agent.actor_id),
+    ):
+        await _serve_snapshot_inner(agent, stream, req, reject)
+
+
+async def _serve_snapshot_inner(
+    agent: Agent, stream: BiStream, req: SnapshotReq, reject
+) -> None:
     if req.cluster_id != agent.cluster_id:
         await reject(REJECT_CLUSTER)
         return
@@ -180,8 +194,10 @@ async def serve_snapshot(agent: Agent, stream: BiStream, req: SnapshotReq) -> No
 # census keys that survive state transitions: `last_probe_mono` gates
 # the digestless state probe and `installed_mono` gates the post-install
 # cooldown — a failure record must not reset either clock, or a cold
-# node pays a probe dial / re-bootstrap every sync round
-_CENSUS_STICKY = ("last_probe_mono", "installed_mono")
+# node pays a probe dial / re-bootstrap every sync round.  `traceparent`
+# (r19) is the bootstrap's root trace context: the same sync round's
+# delta top-up continues it so a cold-node bootstrap reads as ONE trace
+_CENSUS_STICKY = ("last_probe_mono", "installed_mono", "traceparent")
 
 
 def _set_census(agent: Agent, **fields) -> None:
@@ -223,6 +239,8 @@ async def _fetch_snapshot(
 ) -> Optional[SnapshotHeader]:
     """Stream the peer's snapshot into `tmp_db` (decompressed).  None on
     any refusal/failure — callers fall back to delta sync."""
+    from corrosion_tpu.runtime.trace import current_traceparent, span
+
     local_sha = local_schema_sha(agent)
     stream = await asyncio.wait_for(
         agent.transport.open_bi(peer.addr), RECV_TIMEOUT
@@ -235,6 +253,11 @@ async def _fetch_snapshot(
     received_chunks = 0
     received_raw = 0
     fetched_wire = 0
+    # the fetch is one child span of the catchup.bootstrap root; its
+    # W3C context rides the SnapshotReq (trailing eof-tolerant field)
+    # so the SERVING peer's serve span joins the same trace
+    fetch_span = span("catchup.snapshot.fetch", peer=peer.addr)
+    fetch_span.__enter__()
     try:
         await asyncio.wait_for(
             stream.send(
@@ -243,6 +266,7 @@ async def _fetch_snapshot(
                         actor_id=agent.actor_id,
                         schema_sha=local_sha,
                         cluster_id=agent.cluster_id,
+                        traceparent=current_traceparent(),
                     )
                 )
             ),
@@ -306,6 +330,7 @@ async def _fetch_snapshot(
         METRICS.counter("corro.snapshot.fetch.bytes").inc(fetched_wire)
         return header
     finally:
+        fetch_span.__exit__(None, None, None)
         if f is not None:
             await asyncio.to_thread(f.close)
         stream.close()
@@ -484,6 +509,17 @@ async def maybe_snapshot_bootstrap(agent: Agent, peers: List[Actor]) -> bool:
         gap = state_held_total(theirs) - held
     if peer is None or gap < cfg.snapshot_min_gap_versions:
         return False
+    from corrosion_tpu.runtime.trace import span
+
+    # r19: the bootstrap's ROOT span — fetch + serve (via the SnapshotReq
+    # traceparent) + install hang off it, and the same round's delta
+    # top-up continues it from the census so one trace reads end to end
+    bootstrap_span = span(
+        "catchup.bootstrap", peer=peer.addr, actor=str(agent.actor_id),
+        gap=gap,
+    )
+    bootstrap_span.__enter__()
+    agent.catchup_census["traceparent"] = bootstrap_span.ctx.traceparent()
     try:
         return await asyncio.wait_for(
             snapshot_bootstrap(agent, peer), cfg.snapshot_timeout_secs
@@ -497,3 +533,5 @@ async def maybe_snapshot_bootstrap(agent: Agent, peers: List[Actor]) -> bool:
         _set_census(agent, state="failed", peer=peer.addr)
         log.exception("snapshot bootstrap from %s failed", peer.addr)
         return False
+    finally:
+        bootstrap_span.__exit__(None, None, None)

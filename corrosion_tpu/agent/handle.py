@@ -128,7 +128,11 @@ class Agent:
         return self.actor.cluster_id
 
     def notify_change_hooks(
-        self, changes: List[Change], origin_wall: Optional[float] = None
+        self,
+        changes: List[Change],
+        origin_wall: Optional[float] = None,
+        traceparent: Optional[str] = None,
+        trace_meta: Optional[int] = None,
     ) -> None:
         """Feed one committed batch to the subs/updates hooks.  Runs on
         whatever thread committed (write path / ingest worker): the
@@ -141,13 +145,18 @@ class Agent:
         this thread), `origin` is the origin node's commit wall clock
         when it rode the envelope here (None otherwise).  The matcher
         measures apply→event against it and the stream write measures
-        the end-to-end total."""
+        the end-to-end total.  r19: the origin's W3C trace context +
+        tail-sampling meta ride the same stamp so the match/deliver
+        stage spans stitch to the write's trace."""
         import time as _time
 
         from corrosion_tpu.runtime.latency import BatchStamp
         from corrosion_tpu.runtime.metrics import METRICS
 
-        stamp = BatchStamp(origin=origin_wall, applied=_time.time())
+        stamp = BatchStamp(
+            origin=origin_wall, applied=_time.time(),
+            traceparent=traceparent, trace_meta=trace_meta,
+        )
         start = _time.monotonic()
         for hook in list(self.change_hooks):
             hook(changes, stamp)

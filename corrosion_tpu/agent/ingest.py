@@ -171,10 +171,23 @@ async def handle_changes(agent: Agent) -> None:
                 ts = getattr(cs, "ts", None)
                 if ts and not ts.is_zero():
                     agent.clock.update_with_timestamp(ts)
-                # novel broadcast-sourced changes get re-broadcast
+                # novel broadcast-sourced changes get re-broadcast;
+                # a traced change relays with its hop count bumped so
+                # downstream apply spans name their distance from the
+                # origin (r19 — the forced-keep bit travels untouched)
                 if source == ChangeSource.BROADCAST and not _is_empty(cv):
+                    if cv.trace_meta is not None:
+                        from dataclasses import replace as _replace
+
+                        from corrosion_tpu.runtime.trace import bump_hop
+
+                        relay = _replace(
+                            cv, trace_meta=bump_hop(cv.trace_meta)
+                        )
+                    else:
+                        relay = cv
                     agent.tx_bcast.try_send(
-                        BroadcastInput(change=cv, is_local=False)
+                        BroadcastInput(change=relay, is_local=False)
                     )
                 buf.append((cv, source, keys, time.monotonic()))
                 if len(buf) > perf.processing_queue_len:
@@ -258,19 +271,47 @@ def process_multiple_changes(
     # wall-clock delta: e2e_observe clamps skew-negative values.  The
     # OLDEST origin travels on to the hooks so apply→event and the
     # end-to-end total attribute against the batch's worst element.
+    # r19: each traced change also records an `ingest.apply` stage span
+    # (origin commit → local apply committed) continuing the origin's
+    # trace, and the oldest element's trace context rides the stamp to
+    # the match/deliver stages.
     from corrosion_tpu.runtime.latency import e2e_observe
+    from corrosion_tpu.runtime.trace import meta_forced, meta_hop, stage_span
 
     origin_min: Optional[float] = None
+    oldest_tp: Optional[str] = None
+    oldest_meta: Optional[int] = None
     now_wall = time.time()
+    actor_str = str(agent.actor_id)
     for cv, source in batch:
         if cv.origin_ts is None:
             continue
-        e2e_observe("apply", now_wall - cv.origin_ts, source=source.value)
+        delta = e2e_observe(
+            "apply", now_wall - cv.origin_ts, source=source.value
+        )
+        if cv.traceparent is not None:
+            cs = cv.changeset
+            stage_span(
+                cv.traceparent, "ingest.apply", "apply", delta,
+                forced=meta_forced(cv.trace_meta),
+                actor=actor_str, source=source.value,
+                hop=meta_hop(cv.trace_meta),
+                table=(
+                    cs.changes[0].table
+                    if isinstance(cs, ChangesetFull) and cs.changes
+                    else ""
+                ),
+            )
         if origin_min is None or cv.origin_ts < origin_min:
             origin_min = cv.origin_ts
+            oldest_tp = cv.traceparent
+            oldest_meta = cv.trace_meta
 
     if all_impactful:
-        agent.notify_change_hooks(all_impactful, origin_min)
+        agent.notify_change_hooks(
+            all_impactful, origin_min,
+            traceparent=oldest_tp, trace_meta=oldest_meta,
+        )
     METRICS.histogram("corro.agent.changes.processing.time.seconds").observe(
         time.monotonic() - start
     )

@@ -242,6 +242,23 @@ async def setup(
         breach_checks=config.slo.breach_checks,
     )
 
+    # r19 tail-based trace capture: stage spans buffer per-trace and
+    # are kept only on error / SLO breach / lottery (tracestore.py).
+    # Process-global like the metrics registry — the first agent's
+    # config wins when several share a process (tests call configure()
+    # directly for other knobs)
+    if config.trace.enabled:
+        from corrosion_tpu.runtime import tracestore
+
+        tracestore.ensure(
+            targets=config.slo.targets,
+            lottery_n=config.trace.lottery_n,
+            max_traces=config.trace.max_traces,
+            max_spans_per_trace=config.trace.max_spans_per_trace,
+            keep_max=config.trace.keep_max,
+            idle_close_secs=config.trace.idle_close_secs,
+        )
+
     # r12 cluster observatory: telemetry digests piggyback the gossip
     # datagrams (hooks below) + broadcast envelopes (broadcast_loop);
     # received digests feed the anti-entropy store behind /v1/cluster
@@ -303,10 +320,16 @@ async def run(agent: Agent) -> None:
         if cv.traceparent:
             # stitch the origin's span on the EAGER dissemination path
             # too (sync already adopts the SyncStart traceparent); the
-            # traceparent stays ON the cv so a re-broadcast relays it
-            from corrosion_tpu.runtime.trace import continue_from
+            # traceparent stays ON the cv so a re-broadcast relays it.
+            # stage="recv" buffers the hop marker with the trace in the
+            # r19 tail sampler (which node saw the frame, at which hop)
+            from corrosion_tpu.runtime.trace import continue_from, meta_hop
 
-            with continue_from(cv.traceparent, "broadcast.recv", peer=src):
+            with continue_from(
+                cv.traceparent, "broadcast.recv", peer=src,
+                stage="recv", actor=str(agent.actor_id),
+                hop=meta_hop(cv.trace_meta),
+            ):
                 agent.tx_changes.try_send((cv, ChangeSource.BROADCAST))
         else:
             agent.tx_changes.try_send((cv, ChangeSource.BROADCAST))
@@ -858,17 +881,21 @@ async def make_broadcastable_changes(
     """
     from corrosion_tpu.runtime.trace import span
 
-    # one span per local write: its W3C context rides the broadcast
+    # one ROOT span per local write: its W3C context rides the broadcast
     # envelope so remote applies stitch to this trace (r11 — the eager
-    # path's counterpart of the SyncStart traceparent)
-    with span("write.local") as write_span:
+    # path's counterpart of the SyncStart traceparent); stage="write"
+    # routes it into the r19 tail sampler when one is configured
+    with span(
+        "write.local", stage="write", actor=str(agent.actor_id)
+    ) as write_span:
         return await _make_broadcastable_changes_inner(
-            agent, fn, write_span.ctx.traceparent()
+            agent, fn, write_span.ctx.traceparent(), write_span
         )
 
 
 async def _make_broadcastable_changes_inner(
-    agent: Agent, fn: Callable[["object"], List[object]], traceparent: str
+    agent: Agent, fn: Callable[["object"], List[object]], traceparent: str,
+    write_span=None,
 ) -> ExecResult:
     import time as _time
 
@@ -911,7 +938,24 @@ async def _make_broadcastable_changes_inner(
         # the ORIGIN stamp: wall clock at local commit — every
         # corro.e2e.* stage downstream measures against this instant
         origin_wall = _time.time()
-        agent.notify_change_hooks(changes, origin_wall)
+        # r19 trace meta: the origin's cached head decision (lottery on
+        # the trace id) rides the envelope so every node on the path
+        # keeps the same trace without coordination; hop starts at 0
+        trace_meta = None
+        if write_span is not None:
+            from corrosion_tpu.runtime import tracestore
+            from corrosion_tpu.runtime.trace import make_meta
+
+            st = tracestore.store()
+            if st is not None:
+                write_span.attrs["table"] = changes[0].table
+                trace_meta = make_meta(
+                    forced=st.head_forced(write_span.ctx.trace_id)
+                )
+        agent.notify_change_hooks(
+            changes, origin_wall, traceparent=traceparent,
+            trace_meta=trace_meta,
+        )
         # encode-once, spliced (r16): each chunk's body is assembled
         # from the wire_cell bytes finalize_group already stamped — one
         # header/tail pack + a join per chunk, no per-value re-walk
@@ -921,6 +965,7 @@ async def _make_broadcastable_changes_inner(
         for cv in chunked_change_v1(
             agent.actor_id, db_version, changes, last_seq, ts,
             origin_ts=origin_wall, traceparent=traceparent,
+            trace_meta=trace_meta,
         ):
             await agent.tx_bcast.send(BroadcastInput(change=cv, is_local=True))
     rows = sum(r for r in _int_results(results))

@@ -875,10 +875,23 @@ async def sync_loop(agent: Agent, rng: Optional[random.Random] = None) -> None:
         # from the watermark (agent/catchup.py; never raises)
         from corrosion_tpu.agent.catchup import maybe_snapshot_bootstrap
 
-        await maybe_snapshot_bootstrap(agent, peers)
+        installed = await maybe_snapshot_bootstrap(agent, peers)
         start = time.monotonic()
         try:
-            received = await asyncio.wait_for(parallel_sync(agent, peers), 300)
+            if installed and agent.catchup_census.get("traceparent"):
+                # r19: the watermark top-up continues the bootstrap's
+                # root trace — snapshot fetch + serve + install + delta
+                # top-up read as ONE trace on the collector
+                with continue_from(
+                    agent.catchup_census["traceparent"], "catchup.topup"
+                ):
+                    received = await asyncio.wait_for(
+                        parallel_sync(agent, peers), 300
+                    )
+            else:
+                received = await asyncio.wait_for(
+                    parallel_sync(agent, peers), 300
+                )
         except asyncio.TimeoutError:
             received = 0
         elapsed = max(time.monotonic() - start, 1e-9)

@@ -55,6 +55,15 @@ def _held_versions(agent: Agent) -> int:
     return held_total(agent.bookie)
 
 
+def _trace_census() -> dict:
+    """The /v1/status `traces` block: tail-sampler occupancy + keep/drop
+    totals (a locked-copy read, poll-safe like the rest of the plane)."""
+    from corrosion_tpu.runtime import tracestore
+
+    st = tracestore.store()
+    return st.census() if st is not None else {"enabled": False}
+
+
 class _Limit:
     """Load-shedding concurrency limit: full ⇒ 503 (util.rs:181-328)."""
 
@@ -95,6 +104,7 @@ class ApiServer:
         app.router.add_get("/v1/flight", self.h_flight)
         app.router.add_get("/v1/slo", self.h_slo)
         app.router.add_get("/v1/cluster", self.h_cluster)
+        app.router.add_get("/v1/traces", self.h_traces)
         return app
 
     async def start(self) -> None:
@@ -498,6 +508,10 @@ class ApiServer:
                 "trigger": peek("corro.write.capture.trigger.total"),
                 "fallback": peek("corro.write.capture.fallback.total"),
             },
+            # r19 trace census: is the tail sampler on, how full is the
+            # in-flight buffer, how many traces were kept vs dropped
+            # (full kept traces live at GET /v1/traces)
+            "traces": _trace_census(),
             # r11 SLO plane pointer: the canary's live numbers (full
             # per-stage percentiles live at GET /v1/slo)
             "slo": {
@@ -616,6 +630,17 @@ class ApiServer:
             slo = agent.slo = SloMonitor(targets=agent.config.slo.targets)
         stages = slo.check(window_secs=window)
 
+        # r19 exemplars: each stage row names the kept traces whose
+        # worst span of THAT stage is slowest — the jump from "p99
+        # breached" to "this write, through these nodes"
+        from corrosion_tpu.runtime import tracestore
+
+        st = tracestore.store()
+        for stage, row in stages.items():
+            row["slowest_trace_ids"] = (
+                st.slowest_ids(stage, 3) if st is not None else []
+            )
+
         snap = METRICS.snapshot()
 
         def peek(name: str, default: float = 0.0, **labels) -> float:
@@ -648,6 +673,50 @@ class ApiServer:
                     )
                     + peek("corro.e2e.canary.seconds_count", scope="remote"),
                 },
+            }
+        )
+
+    async def h_traces(self, request: web.Request) -> web.Response:
+        """End-to-end write-trace plane (r19): the slowest-N KEPT traces
+        from the tail sampler — each one write's full
+        write→broadcast→apply→match→deliver causality with a per-stage
+        breakdown, the keep reason (error / forced / slo:<stage> /
+        lottery), the actors it crossed, and whether a chaos injection
+        was live at capture.  Where /v1/slo answers "which stage is
+        slow in aggregate", this answers "which WRITE, through which
+        nodes, stalled where".  Filters: `?n=` (default 20),
+        `?stage=`, `?actor=`, `?table=`; `?spans=0` drops the
+        per-span rows for compact dashboards."""
+        from corrosion_tpu.runtime import tracestore
+
+        st = tracestore.store()
+        if st is None:
+            return web.json_response(
+                {
+                    "actor_id": str(self.agent.actor_id),
+                    "census": {"enabled": False},
+                    "traces": [],
+                }
+            )
+        try:
+            n = int(request.query.get("n", "20"))
+        except ValueError:
+            raise web.HTTPBadRequest(text="n must be an integer")
+        traces = st.kept(
+            n=max(1, min(n, st.keep_max)),
+            stage=request.query.get("stage") or None,
+            actor=request.query.get("actor") or None,
+            table=request.query.get("table") or None,
+        )
+        if request.query.get("spans") == "0":
+            traces = [
+                {k: v for k, v in t.items() if k != "spans"} for t in traces
+            ]
+        return web.json_response(
+            {
+                "actor_id": str(self.agent.actor_id),
+                "census": st.census(),
+                "traces": traces,
             }
         )
 

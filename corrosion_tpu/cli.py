@@ -104,6 +104,18 @@ def _build_parser() -> argparse.ArgumentParser:
     lk = sub.add_parser("locks")
     lk.add_argument("--top", type=int, default=None)
 
+    trc = sub.add_parser(
+        "traces", help="fetch the node's kept slow traces (GET /v1/traces)"
+    )
+    trc.add_argument("--n", type=int, default=10, help="slowest-N traces")
+    trc.add_argument("--stage", default=None,
+                     help="only traces with this stage (write/broadcast/"
+                          "apply/match/deliver)")
+    trc.add_argument("--actor", default=None)
+    trc.add_argument("--table", default=None)
+    trc.add_argument("--json", action="store_true",
+                     help="raw JSON instead of the table render")
+
     actor = sub.add_parser("actor").add_subparsers(dest="sub", required=True)
     av = actor.add_parser("version")
     av.add_argument("actor_id")
@@ -493,6 +505,55 @@ def _cmd_db_lock(cfg: Config, cmd: str) -> int:
         locks.release()
 
 
+async def _cmd_traces(cfg: Config, args) -> int:
+    """Admin fetch of GET /v1/traces: the slowest kept traces with their
+    per-stage breakdown, rendered as one fixed-width table per trace
+    (or raw JSON with --json)."""
+    import aiohttp
+
+    params = {"n": str(args.n)}
+    for k in ("stage", "actor", "table"):
+        v = getattr(args, k)
+        if v:
+            params[k] = v
+    url = f"http://{_api_addr(cfg)}/v1/traces"
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                url, params=params, timeout=aiohttp.ClientTimeout(total=10)
+            ) as resp:
+                body = await resp.json()
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        print(f"could not reach {url}: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(body, indent=2))
+        return 0
+    census = body.get("census", {})
+    if not census.get("enabled"):
+        print("trace plane disabled ([trace] enabled=false)")
+        return 0
+    print(
+        f"kept {census['kept_total']} dropped {census['dropped_total']} "
+        f"buffered {census['buffered']} (1/{census['lottery_n']} lottery)"
+    )
+    for t in body["traces"]:
+        chaos = f" chaos={t['chaos']}" if t.get("chaos") else ""
+        print(
+            f"\ntrace {t['trace_id']}  {t['duration_secs'] * 1e3:.3f} ms  "
+            f"reason={t['reason']}  spans={t['n_spans']} "
+            f"hops={t['hops']}{chaos}"
+        )
+        print(f"  {'stage':<10} {'count':>5} {'sum_ms':>10} {'max_ms':>10}")
+        for stage, row in t["stages"].items():
+            print(
+                f"  {stage:<10} {row['count']:>5} "
+                f"{row['seconds'] * 1e3:>10.3f} "
+                f"{row['max_secs'] * 1e3:>10.3f}"
+            )
+    return 0
+
+
 async def _cmd_template(cfg: Config, args) -> int:
     from corrosion_tpu.tpl import render_specs, watch_specs
 
@@ -563,6 +624,8 @@ async def _amain(argv: Optional[List[str]] = None) -> int:
         return await _admin_call(cfg, {"cmd": "sync", "sub": args.sub})
     if cmd == "locks":
         return await _admin_call(cfg, {"cmd": "locks", "top": args.top})
+    if cmd == "traces":
+        return await _cmd_traces(cfg, args)
     if cmd == "actor":
         return await _admin_call(
             cfg,

@@ -89,7 +89,7 @@ class StreamSink:
     __slots__ = (
         "max_lag_bytes", "max_lag_batches", "pending", "pending_bytes",
         "replayed_max", "hold", "held", "done", "closed", "_oldest_wall",
-        "_oldest_origin", "writer",
+        "_oldest_origin", "_oldest_tp", "_oldest_meta", "writer",
     )
 
     def __init__(self, max_lag_bytes: int, max_lag_batches: int):
@@ -107,9 +107,13 @@ class StreamSink:
         )
         self.closed = False
         # oldest unobserved latency stamps among pending payloads: one
-        # conservative (worst-element) observation per flush
+        # conservative (worst-element) observation per flush — the r19
+        # trace context rides with them so the deliver stage span
+        # stitches to the same write trace
         self._oldest_wall: Optional[float] = None
         self._oldest_origin: Optional[float] = None
+        self._oldest_tp: Optional[str] = None
+        self._oldest_meta: Optional[int] = None
         self.writer: Optional["FanoutWriter"] = None
 
     # -- transport interface (overridden per flavor) -----------------------
@@ -187,6 +191,8 @@ class StreamSink:
         ew = getattr(batch, "event_wall", None)
         if ew is not None and self._oldest_wall is None:
             self._oldest_wall = ew
+            self._oldest_tp = getattr(batch, "traceparent", None)
+            self._oldest_meta = getattr(batch, "trace_meta", None)
         og = getattr(batch, "origin", None)
         if og is not None and self._oldest_origin is None:
             self._oldest_origin = og
@@ -244,11 +250,26 @@ class StreamSink:
         if not self.pending:
             if observe and self._oldest_wall is not None:
                 now = time.time()
-                e2e_observe("deliver", now - self._oldest_wall)
+                delta = e2e_observe("deliver", now - self._oldest_wall)
                 if self._oldest_origin is not None:
                     e2e_observe("total", now - self._oldest_origin)
+                if self._oldest_tp is not None:
+                    # r19 deliver stage span, stride-sampled exactly
+                    # like the latency observation it shares a gate
+                    # with — a 100k-sink walk never pays per-sink spans
+                    from corrosion_tpu.runtime.trace import (
+                        meta_forced,
+                        stage_span,
+                    )
+
+                    stage_span(
+                        self._oldest_tp, "subs.deliver", "deliver", delta,
+                        forced=meta_forced(self._oldest_meta),
+                    )
             self._oldest_wall = None
             self._oldest_origin = None
+            self._oldest_tp = None
+            self._oldest_meta = None
             return True
         # clogged: shed once past the lag bounds
         data_batches = sum(1 for p, _ in self.pending if p is not None)

@@ -135,9 +135,11 @@ class EventBatch(list):
     write): `event_wall` is when the diff produced these events,
     `origin` the origin node's commit wall clock when a stamp traveled
     with the batch — what event→delivered and the end-to-end total are
-    measured against."""
+    measured against.  r19: `traceparent`/`trace_meta` carry the
+    origin's trace context on to the deliver stage span."""
 
-    __slots__ = ("_payload", "event_wall", "origin")
+    __slots__ = ("_payload", "event_wall", "origin", "traceparent",
+                 "trace_meta")
 
     def payload(self) -> bytes:
         """All events as NDJSON lines (newline-terminated), lazily
@@ -1006,11 +1008,25 @@ class MatcherHandle:
         batch = EventBatch(events)
         batch.event_wall = time.time()
         batch.origin = stamp.origin if stamp is not None else None
+        batch.traceparent = stamp.traceparent if stamp is not None else None
+        batch.trace_meta = stamp.trace_meta if stamp is not None else None
         if stamp is not None:
             # apply→event: candidate batching window + diff execution
             from corrosion_tpu.runtime.latency import e2e_observe
 
-            e2e_observe("match", batch.event_wall - stamp.applied)
+            delta = e2e_observe("match", batch.event_wall - stamp.applied)
+            if stamp.traceparent is not None:
+                # r19: the same hop as a stage span on the write's trace
+                from corrosion_tpu.runtime.trace import (
+                    meta_forced,
+                    stage_span,
+                )
+
+                stage_span(
+                    stamp.traceparent, "subs.match", "match", delta,
+                    forced=meta_forced(stamp.trace_meta),
+                    sub=self.id, events=len(events),
+                )
         with self._sub_lock:
             subs = list(self._subscribers)
             sinks = self._sinks

@@ -158,6 +158,32 @@ class SloConfig:
 
 
 @dataclass
+class TraceConfig:
+    """[trace] — the r19 end-to-end write-tracing plane (runtime/
+    trace.py stage spans + runtime/tracestore.py tail sampler).
+
+    When `enabled`, every traced write's stage spans
+    (write→broadcast→apply→match→deliver, stitched cross-node by the
+    W3C traceparent on the broadcast/sync envelope ext) buffer in a
+    bounded per-trace ring and are KEPT only when the trace errors,
+    breaches an [slo] per-stage target, was head-lottery-selected at
+    the origin (1 in `lottery_n`, deterministic on the trace id so
+    every node keeps the same traces), or wins the local lottery —
+    everything else drops at close with O(1) cost.  Kept traces serve
+    `GET /v1/traces` (slowest-N, per-stage breakdown), feed exemplar
+    ids into /v1/slo stage rows, and export through the OTLP plane
+    when a collector is configured.  `lottery_n=0` disables the
+    lottery (keep only errors/breaches/forced)."""
+
+    enabled: bool = True
+    lottery_n: int = 64
+    max_traces: int = 512
+    max_spans_per_trace: int = 64
+    keep_max: int = 256
+    idle_close_secs: float = 1.0
+
+
+@dataclass
 class PubsubConfig:
     """[pubsub] — live-query matcher knobs.  `candidate_batch_wait` is
     the matcher's candidate-batching window in seconds: the PR-6 SLO
@@ -330,6 +356,7 @@ class Config:
     subs: SubsConfig = field(default_factory=SubsConfig)
     cluster: ClusterObsConfig = field(default_factory=ClusterObsConfig)
     sync: SyncConfig = field(default_factory=SyncConfig)
+    trace: TraceConfig = field(default_factory=TraceConfig)
 
 
 _ENV_PREFIX = "CORRO_"

@@ -254,21 +254,37 @@ class BatchStamp:
     stamp traveled — e.g. pre-upgrade peers); `applied` is the wall
     clock at the LOCAL apply/commit that fed the hooks.  When candidate
     batches coalesce in the matcher, the OLDEST stamp wins — a batch's
-    latency is its worst element's."""
+    latency is its worst element's.
+
+    r19: `traceparent`/`trace_meta` carry the origin's W3C trace
+    context + tail-sampling meta alongside the wall stamps, so the
+    match and deliver stage spans stitch to the same trace id the
+    write opened.  Coalescing keeps the trace of whichever stamp wins
+    the oldest-origin contest (the batch is attributed to its worst
+    element in spans exactly as it is in histograms)."""
 
     origin: Optional[float]
     applied: float
+    traceparent: Optional[str] = None
+    trace_meta: Optional[int] = None
 
     def oldest(self, other: Optional["BatchStamp"]) -> "BatchStamp":
         if other is None:
             return self
-        origin = (
-            min(self.origin, other.origin)
-            if self.origin is not None and other.origin is not None
-            else (self.origin if self.origin is not None else other.origin)
-        )
+        if self.origin is not None and other.origin is not None:
+            older = self if self.origin <= other.origin else other
+            origin = older.origin
+        elif self.origin is not None:
+            older, origin = self, self.origin
+        elif other.origin is not None:
+            older, origin = other, other.origin
+        else:
+            older, origin = (self if self.traceparent else other), None
         return BatchStamp(
-            origin=origin, applied=min(self.applied, other.applied)
+            origin=origin,
+            applied=min(self.applied, other.applied),
+            traceparent=older.traceparent,
+            trace_meta=older.trace_meta,
         )
 
 

@@ -66,6 +66,43 @@ def _rand_hex(n: int) -> str:
     return os.urandom(n // 2).hex()
 
 
+# -- tail-sampling trace meta (the envelope ext v3 byte) --------------------
+#
+# One byte rides the broadcast/sync envelopes next to the traceparent
+# (`types/codec.py` _ENVELOPE_EXT_V3): bit 0 carries the ORIGIN's head
+# decision (lottery win → every node on the path keeps the trace without
+# coordination), bits 2..7 the relay hop count (capped at 63) so a
+# remote apply span can say how many re-broadcasts it is from the
+# origin.  Bits 1 is reserved.
+
+TRACE_META_FORCED = 0x01
+_META_HOP_SHIFT = 2
+_META_HOP_MAX = 63
+
+
+def meta_forced(meta: Optional[int]) -> bool:
+    return bool(meta) and bool(meta & TRACE_META_FORCED)
+
+
+def meta_hop(meta: Optional[int]) -> int:
+    return ((meta or 0) >> _META_HOP_SHIFT) & _META_HOP_MAX
+
+
+def make_meta(forced: bool = False, hop: int = 0) -> int:
+    return (TRACE_META_FORCED if forced else 0) | (
+        min(_META_HOP_MAX, max(0, hop)) << _META_HOP_SHIFT
+    )
+
+
+def bump_hop(meta: Optional[int]) -> Optional[int]:
+    """Relay path: same flags, hop + 1 (saturating)."""
+    if meta is None:
+        return None
+    return (meta & ((1 << _META_HOP_SHIFT) - 1)) | (
+        min(_META_HOP_MAX, meta_hop(meta) + 1) << _META_HOP_SHIFT
+    )
+
+
 def current_context() -> Optional[SpanContext]:
     return _current.get()
 
@@ -108,11 +145,10 @@ class Span:
         if self._token is not None:
             _current.reset(self._token)
         METRICS.histogram("corro_span_seconds", span=self.name).observe(elapsed)
-        if otel.exporter() is not None and self.ctx.sampled:
-            otel.record_span(
+        if self.ctx.sampled:
+            _finish_span(
                 self.name,
-                self.ctx.trace_id,
-                self.ctx.span_id,
+                self.ctx,
                 self.parent.span_id if self.parent is not None else None,
                 self._start_ns,
                 self._start_ns + int(elapsed * 1e9),
@@ -130,8 +166,89 @@ class Span:
         )
 
 
+def _finish_span(
+    name: str,
+    ctx: SpanContext,
+    parent_span_id: Optional[str],
+    start_ns: int,
+    end_ns: int,
+    attrs: Dict[str, str],
+    error: bool = False,
+    forced: bool = False,
+) -> None:
+    """Route one finished span: stage-tagged spans buffer in the tail
+    sampler's per-trace ring (`runtime/tracestore.py`) when one is
+    configured — exported only if the trace is KEPT — while untagged
+    spans keep the r11 direct-export path.  The unconfigured hot path
+    pays one global None-check (the cached head-decision discipline)."""
+    stage = attrs.get("stage")
+    if stage is not None:
+        from corrosion_tpu.runtime import tracestore
+
+        store = tracestore.store()
+        if store is not None:
+            store.add_span(
+                {
+                    "name": name,
+                    "trace_id": ctx.trace_id,
+                    "span_id": ctx.span_id,
+                    "parent_span_id": parent_span_id,
+                    "start_ns": start_ns,
+                    "end_ns": end_ns,
+                    "attrs": attrs,
+                    "error": error,
+                    "forced": forced,
+                }
+            )
+            return
+    if otel.exporter() is not None:
+        otel.record_span(
+            name, ctx.trace_id, ctx.span_id, parent_span_id,
+            start_ns, end_ns, attrs, error=error,
+        )
+
+
 def span(name: str, **attrs: str) -> Span:
     return Span(name, attrs={k: str(v) for k, v in attrs.items()})
+
+
+def stage_span(
+    traceparent: Optional[str],
+    name: str,
+    stage: str,
+    duration_s: float,
+    error: bool = False,
+    forced: bool = False,
+    **attrs,
+) -> Optional[SpanContext]:
+    """Synthesize one finished STAGE span as a child of the wire
+    context, covering the last `duration_s` seconds (hop stamps measure
+    origin→here wall deltas; a contextvar-scoped Span cannot represent
+    that interval).  The hot-path cost when no store/exporter is
+    configured is one parse + two global None-checks; callers on
+    per-sink walks stride-sample (pubsub/fanout.py)."""
+    parent = parse_traceparent(traceparent)
+    if parent is None:
+        return None
+    ctx = SpanContext(
+        trace_id=parent.trace_id,
+        span_id=_rand_hex(16),
+        sampled=parent.sampled,
+    )
+    if not ctx.sampled:
+        return ctx
+    end_ns = time.time_ns()
+    start_ns = end_ns - int(max(0.0, duration_s) * 1e9)
+    a = {"stage": stage}
+    a.update({k: str(v) for k, v in attrs.items()})
+    METRICS.histogram("corro_span_seconds", span=name).observe(
+        max(0.0, duration_s)
+    )
+    _finish_span(
+        name, ctx, parent.span_id, start_ns, end_ns, a,
+        error=error, forced=forced,
+    )
+    return ctx
 
 
 def continue_from(traceparent: Optional[str], name: str, **attrs: str) -> Span:

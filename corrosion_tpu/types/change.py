@@ -115,6 +115,12 @@ class ChangeV1:
     # eager broadcast path (sync already carries one in SyncStart).
     origin_ts: Optional[float] = field(default=None, compare=False)
     traceparent: Optional[str] = field(default=None, compare=False)
+    # r19 tail-sampling trace meta (one byte on the wire, the envelope
+    # ext v3 gate): bit 0 = forced-keep — the ORIGIN's head decision
+    # (lottery win) so every node on the path keeps the same trace
+    # without coordination; bits 2..7 = relay hop count, bumped by the
+    # re-broadcast path.  Bit layout owned by runtime/trace.py.
+    trace_meta: Optional[int] = field(default=None, compare=False)
     # r14 encode-once: the speedy-encoded `actor_id + changeset` body
     # (types/codec.py `encode_change_v1_body`).  Stamped ONCE at local
     # commit and on broadcast decode (the receiver already holds the

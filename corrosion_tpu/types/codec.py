@@ -331,13 +331,27 @@ def read_change_v1(r: Reader) -> ChangeV1:
 #   ext v2 := u8 version(=2) · opt<f64 origin_ts> · opt<string traceparent>
 #             · vec<u8> digest          (r12: an encoded telemetry digest,
 #                                        runtime/digest.py — opaque here)
+#   ext v3 := u8 version(=3) · opt<f64 origin_ts> · opt<string traceparent>
+#             · vec<u8> digest · opt<u8 trace_meta>
+#                                        (r19: tail-sampling trace meta —
+#                                         bit 0 forced-keep from the origin's
+#                                         head decision, bits 2..7 relay hop
+#                                         count; runtime/trace.py owns the
+#                                         bit layout)
 #
 # v2 is only written when a digest rides along, so v1 readers (which
 # read the stamps and ignore anything after) parse v2 payloads, and
-# digest-free payloads stay byte-identical to the r11 layout.
+# digest-free payloads stay byte-identical to the r11 layout.  v3 is
+# only written when trace meta rides along: a pre-v3 peer reads the
+# stamps (and the digest — 3 passes its `>= v2` gate; a meta-only v3
+# payload writes an EMPTY digest vec, which the v3 reader normalizes
+# back to None) and leaves the trailing meta byte unread, while a v3
+# reader over a v1/v2 body hits eof before the meta and yields None —
+# the same structural tolerance in both directions as v1/v2.
 
 _ENVELOPE_EXT_V1 = 1
 _ENVELOPE_EXT_V2 = 2
+_ENVELOPE_EXT_V3 = 3
 
 
 def _write_envelope_ext(
@@ -345,30 +359,48 @@ def _write_envelope_ext(
     origin_ts: Optional[float],
     traceparent: Optional[str],
     digest: Optional[bytes] = None,
+    trace_meta: Optional[int] = None,
 ) -> None:
-    if origin_ts is None and traceparent is None and digest is None:
+    if (
+        origin_ts is None
+        and traceparent is None
+        and digest is None
+        and trace_meta is None
+    ):
         return
-    w.u8(_ENVELOPE_EXT_V2 if digest is not None else _ENVELOPE_EXT_V1)
+    if trace_meta is not None:
+        w.u8(_ENVELOPE_EXT_V3)
+    elif digest is not None:
+        w.u8(_ENVELOPE_EXT_V2)
+    else:
+        w.u8(_ENVELOPE_EXT_V1)
     w.opt(origin_ts, w.f64)
     w.opt(traceparent, w.string)
-    if digest is not None:
-        w.vec_u8(digest)
+    if digest is not None or trace_meta is not None:
+        w.vec_u8(digest if digest is not None else b"")
+    if trace_meta is not None:
+        w.opt(trace_meta, w.u8)
 
 
 def _read_envelope_ext(
     r: Reader,
-) -> Tuple[Optional[float], Optional[str], Optional[bytes]]:
+) -> Tuple[Optional[float], Optional[str], Optional[bytes], Optional[int]]:
     if r.eof():
-        return None, None, None
+        return None, None, None, None
     ver = r.u8()
     if ver < _ENVELOPE_EXT_V1:  # pragma: no cover — never written
-        return None, None, None
+        return None, None, None, None
     origin_ts = r.opt(r.f64)
     traceparent = r.opt(r.string)
     digest = (
         r.vec_u8() if ver >= _ENVELOPE_EXT_V2 and not r.eof() else None
     )
-    return origin_ts, traceparent, digest
+    trace_meta = (
+        r.opt(r.u8) if ver >= _ENVELOPE_EXT_V3 and not r.eof() else None
+    )
+    # a meta-only v3 payload carries an empty digest vec as padding;
+    # consumers (observatory.receive) must never see b"" as a digest
+    return origin_ts, traceparent, digest or None, trace_meta
 
 
 def _with_ext(
@@ -376,8 +408,14 @@ def _with_ext(
     origin_ts: Optional[float],
     traceparent: Optional[str],
     wire_body: Optional[bytes] = None,
+    trace_meta: Optional[int] = None,
 ) -> ChangeV1:
-    if origin_ts is None and traceparent is None and wire_body is None:
+    if (
+        origin_ts is None
+        and traceparent is None
+        and wire_body is None
+        and trace_meta is None
+    ):
         return cv
     from dataclasses import replace
 
@@ -385,6 +423,7 @@ def _with_ext(
         cv,
         origin_ts=origin_ts,
         traceparent=traceparent,
+        trace_meta=trace_meta,
         wire_body=wire_body if wire_body is not None else cv.wire_body,
     )
 
@@ -457,6 +496,7 @@ def chunked_change_v1(
     traceparent: Optional[str] = None,
     max_bytes: int = 8 * 1024,  # MAX_CHANGES_BYTE_SIZE (change.rs:179)
     seq_range: Optional[Tuple[int, int]] = None,
+    trace_meta: Optional[int] = None,
 ) -> List[ChangeV1]:
     """Split one version's ordered changes into broadcast-sized
     ChangeV1 chunks, each carrying its spliced `wire_body`.  Grouping is
@@ -489,6 +529,7 @@ def chunked_change_v1(
                 ),
                 origin_ts=origin_ts,
                 traceparent=traceparent,
+                trace_meta=trace_meta,
                 wire_body=b"".join(parts),
             )
         )
@@ -525,10 +566,11 @@ def encode_uni_from_prefix(
     origin_ts: Optional[float],
     traceparent: Optional[str],
     digest: Optional[bytes] = None,
+    trace_meta: Optional[int] = None,
 ) -> bytes:
     w = Writer()
     w.raw(prefix)
-    _write_envelope_ext(w, origin_ts, traceparent, digest)
+    _write_envelope_ext(w, origin_ts, traceparent, digest, trace_meta)
     return w.bytes()
 
 
@@ -545,6 +587,7 @@ def encode_uni_payload(
         cv.origin_ts,
         cv.traceparent,
         digest,
+        cv.trace_meta,
     )
 
 
@@ -562,9 +605,10 @@ def decode_uni_payload_ext(
     # keep it so a relay wraps these bytes instead of re-serializing
     body = bytes(r.data[body_start : r.pos])
     cluster_id = ClusterId(r.u16()) if not r.eof() else ClusterId(0)  # default_on_eof
-    origin_ts, traceparent, digest = _read_envelope_ext(r)
+    origin_ts, traceparent, digest, trace_meta = _read_envelope_ext(r)
     return (
-        _with_ext(cv, origin_ts, traceparent, wire_body=body),
+        _with_ext(cv, origin_ts, traceparent, wire_body=body,
+                  trace_meta=trace_meta),
         cluster_id,
         digest,
     )
@@ -627,11 +671,16 @@ _BI_SNAPSHOT_REQ = 1
 class SnapshotReq:
     """What a cold node sends: who it is, which cluster, and the schema
     generation it runs (the server refuses on sha mismatch instead of
-    shipping an uninstallable snapshot)."""
+    shipping an uninstallable snapshot).  `traceparent` (r19) is a
+    TRAILING optional field, eof-tolerant both ways like the SyncStart
+    trace context: an r17 server stops reading at cluster_id and never
+    sees it; an r19 reader over an r17 frame hits eof and yields None —
+    so a cold-node bootstrap stitches into one readable trace."""
 
     actor_id: ActorId
     schema_sha: bytes
     cluster_id: ClusterId = ClusterId(0)
+    traceparent: Optional[str] = None
 
 
 def encode_bi_payload_snapshot_req(req: SnapshotReq) -> bytes:
@@ -641,6 +690,9 @@ def encode_bi_payload_snapshot_req(req: SnapshotReq) -> bytes:
     w.raw(req.actor_id.bytes16)
     w.vec_u8(req.schema_sha)
     w.u16(req.cluster_id.value)
+    if req.traceparent is not None:
+        # only written when present: r17 request bytes stay identical
+        w.opt(req.traceparent, w.string)
     return w.bytes()
 
 
@@ -664,8 +716,10 @@ def decode_bi_payload_any(data: bytes):
         actor_id = ActorId(r.raw(16))
         sha = r.vec_u8()
         cluster_id = ClusterId(r.u16()) if not r.eof() else ClusterId(0)
+        traceparent = r.opt(r.string) if not r.eof() else None
         return "snapshot", SnapshotReq(
-            actor_id=actor_id, schema_sha=sha, cluster_id=cluster_id
+            actor_id=actor_id, schema_sha=sha, cluster_id=cluster_id,
+            traceparent=traceparent,
         )
     raise ValueError("unknown BiPayload variant")
 
@@ -810,7 +864,10 @@ def encode_sync_msg(msg) -> bytes:
         _write_body(w, msg)  # encode-once: shared body bytes when stamped
         # next to the W3C traceparent that already rides SyncStart:
         # the origin wall stamp (freshness-gated by the sync server)
-        _write_envelope_ext(w, msg.origin_ts, msg.traceparent)
+        # and, since r19, the tail-sampling trace meta
+        _write_envelope_ext(
+            w, msg.origin_ts, msg.traceparent, trace_meta=msg.trace_meta
+        )
     elif isinstance(msg, Timestamp):
         w.u32(_SYNC_CLOCK)
         w.u64(msg.ntp64)
@@ -839,8 +896,8 @@ def decode_sync_msg(data: bytes):
         return _read_sync_state(r)
     if tag == _SYNC_CHANGESET:
         cv = read_change_v1(r)
-        origin_ts, traceparent, _digest = _read_envelope_ext(r)
-        return _with_ext(cv, origin_ts, traceparent)
+        origin_ts, traceparent, _digest, trace_meta = _read_envelope_ext(r)
+        return _with_ext(cv, origin_ts, traceparent, trace_meta=trace_meta)
     if tag == _SYNC_CLOCK:
         return Timestamp(r.u64())
     if tag == _SYNC_REJECTION:

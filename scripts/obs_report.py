@@ -160,6 +160,14 @@ def _run_e2e_workload(writes: int) -> None:
     latency from the embedded origin wall stamp)."""
     import asyncio
 
+    # a denser lottery than the production default (1/64 would keep ~0
+    # of this tiny workload's traces): the report's slowest-traces table
+    # should show real rows, and breach/error keeps are unaffected
+    from corrosion_tpu.runtime import tracestore
+    from corrosion_tpu.runtime.config import SloConfig
+
+    tracestore.configure(targets=SloConfig().targets, lottery_n=4)
+
     async def workload() -> None:
         from corrosion_tpu.agent.run import (
             canary_loop,
@@ -311,6 +319,48 @@ def render_slo_section(emit, writes: int = 30) -> None:
     emit()
 
 
+def render_traces_section(emit, n: int = 8) -> None:
+    """r19: the tail sampler's slowest kept traces — per-trace stage
+    breakdown (the same rows GET /v1/traces serves), rendered after the
+    SLO section's e2e workload so the kept ring holds that workload's
+    lottery/breach winners."""
+    from corrosion_tpu.runtime import tracestore
+
+    st = tracestore.store()
+    emit("## slowest kept traces (corro.trace.*, GET /v1/traces)")
+    if st is None:
+        emit("(trace plane not configured)")
+        emit()
+        return
+    st.sweep(now=st._clock() + st.idle_close_secs + 1)  # close stragglers
+    census = st.census()
+    emit(
+        f"kept={census['kept_total']} dropped={census['dropped_total']} "
+        f"lottery=1/{census['lottery_n']} "
+        f"idle_close={census['idle_close_secs']}s"
+    )
+    traces = st.kept(n=n)
+    if not traces:
+        emit("(no traces kept)")
+        emit()
+        return
+    emit(
+        f"{'trace_id':<16} {'ms':>9} {'reason':<12} {'spans':>5} "
+        f"{'hops':>4}  stage breakdown (sum ms)"
+    )
+    for t in traces:
+        breakdown = " ".join(
+            f"{stage}={row['seconds'] * 1e3:.2f}"
+            for stage, row in t["stages"].items()
+        )
+        emit(
+            f"{t['trace_id'][:16]:<16} {t['duration_secs'] * 1e3:>9.3f} "
+            f"{t['reason']:<12} {t['n_spans']:>5} {t['hops']:>4}  "
+            f"{breakdown}"
+        )
+    emit()
+
+
 def render_cluster_section(emit, writes: int = 6) -> None:
     """r12: the cluster observatory — replay a two-node mem-net
     partition through the shared scenario harness and render what the
@@ -416,6 +466,7 @@ def main() -> None:
     render_slo_section(
         emit, writes=int(os.environ.get("OBS_REPORT_E2E_WRITES", "30"))
     )
+    render_traces_section(emit)
     render_cluster_section(
         emit, writes=int(os.environ.get("OBS_REPORT_CLUSTER_WRITES", "6"))
     )

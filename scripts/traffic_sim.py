@@ -333,6 +333,20 @@ class TrafficSim:
             "recovery": recovery,
             **summary,
         }
+        if scenario_id == "churn-storm":
+            # r19 (closes the r18 ROADMAP sub-item): the churned node
+            # restarted through the real boot path and recovered over
+            # the r17 catch-up plane — bank ITS /v1/status catch-up
+            # census so the record says HOW it caught up (bootstrap
+            # state, held versions, resume waves, open circuits), not
+            # just that row counts converged
+            churned = self.nodes[list(self.nodes)[-1]].workload_node
+            status = (
+                await workload.scrape(churned, "/v1/status")
+                if churned is not None
+                else None
+            )
+            rec["catchup"] = (status or {}).get("sync", {}).get("catchup")
         return rec
 
     def scenario_matrix(self) -> List[Tuple[str, List[Injection]]]:
@@ -428,6 +442,13 @@ def _assert_bars(rec: dict, tiny: bool) -> None:
             "sick-disk: injected store faults never surfaced as typed "
             "refusals"
         )
+    if sid == "churn-storm":
+        cc = rec.get("catchup")
+        assert cc, (
+            "churn-storm: the restarted node's /v1/status catch-up "
+            "census was not scraped into the record"
+        )
+        assert "held_versions" in cc and "bootstrap" in cc, cc
 
 
 async def run_matrix(tiny: bool) -> dict:

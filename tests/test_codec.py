@@ -412,6 +412,130 @@ def test_envelope_ext_v2_digest_compat():
     assert got3 == dig and cv3 == plain and cv3.origin_ts is None
 
 
+# -- r19 envelope ext v3: tail-sampling trace meta ---------------------------
+
+
+def test_envelope_ext_v3_trace_meta_compat():
+    """Both directions of the r19 trace-meta gate: meta-free payloads
+    stay byte-identical to the r11/r12 layouts (v3 is only written when
+    meta rides along), an emulated PRE-V3 reader over a v3 payload reads
+    the stamps + the (empty) digest vec and leaves the trailing meta
+    byte-exactly unread, and a V3 reader over a v1/v2 body hits eof and
+    yields no trace meta."""
+    from corrosion_tpu.runtime.trace import (
+        bump_hop,
+        make_meta,
+        meta_forced,
+        meta_hop,
+    )
+    from corrosion_tpu.types.codec import (
+        Reader,
+        decode_uni_payload_ext,
+        read_change_v1,
+    )
+
+    meta = make_meta(forced=True, hop=2)
+    tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    stamped = _stamped_cv(origin_ts=7.5, traceparent=tp)
+    with_meta = _stamped_cv(origin_ts=7.5, traceparent=tp, trace_meta=meta)
+
+    # meta-free bytes: the field existing changes nothing (v1 layout)
+    v1_bytes = encode_uni_payload(stamped, ClusterId(1))
+    assert encode_uni_payload(
+        _stamped_cv(origin_ts=7.5, traceparent=tp, trace_meta=None),
+        ClusterId(1),
+    ) == v1_bytes
+
+    # new payload → new reader: meta surfaces, flags/hop decode
+    v3_bytes = encode_uni_payload(with_meta, ClusterId(1))
+    assert len(v3_bytes) > len(v1_bytes)
+    cv, cid, dig = decode_uni_payload_ext(v3_bytes)
+    assert cid == ClusterId(1)
+    assert cv.trace_meta == meta
+    assert meta_forced(cv.trace_meta) and meta_hop(cv.trace_meta) == 2
+    assert dig is None  # the v3 padding vec is normalized, never b""
+    assert cv.origin_ts == pytest.approx(7.5)
+    assert cv.traceparent == tp
+
+    # v3 reader over a V1 body: no trace meta (eof before the gate)
+    assert decode_uni_payload_ext(v1_bytes)[0].trace_meta is None
+    # ...and over a V2 (digest-carrying) body: digest intact, meta None
+    v2_bytes = encode_uni_payload(stamped, ClusterId(1), digest=b"\x01dd")
+    cv2, _, dig2 = decode_uni_payload_ext(v2_bytes)
+    assert dig2 == b"\x01dd" and cv2.trace_meta is None
+
+    # new payload → emulated PRE-V3 (r12) reader: version byte passes
+    # its >= v1 gate, stamps read, digest vec read (empty), and exactly
+    # the trailing opt<u8> meta (2 bytes) is left unread
+    r = Reader(v3_bytes)
+    assert (r.u32(), r.u32(), r.u32()) == (0, 0, 0)
+    old_cv = read_change_v1(r)
+    assert ClusterId(r.u16()) == ClusterId(1)
+    assert r.u8() >= 2  # r12 gate: digest vec is read for ver >= 2
+    assert r.opt(r.f64) == pytest.approx(7.5)
+    assert r.opt(r.string) == tp
+    assert r.vec_u8() == b""  # the meta-only payload's padding vec
+    assert len(v3_bytes) - r.pos == 2  # opt-present byte + meta byte
+    assert old_cv == stamped
+
+    # digest + meta ride together (the broadcast loop's re-written ext)
+    both = encode_uni_payload(with_meta, ClusterId(1), digest=b"\x01dd")
+    cv3, _, dig3 = decode_uni_payload_ext(both)
+    assert dig3 == b"\x01dd" and cv3.trace_meta == meta
+
+    # same gate on the sync wire
+    got = decode_sync_msg(encode_sync_msg(with_meta))
+    assert got.trace_meta == meta
+    assert decode_sync_msg(encode_sync_msg(stamped)).trace_meta is None
+
+    # hop bump saturates and preserves flags (the relay path helper)
+    assert meta_hop(bump_hop(meta)) == 3 and meta_forced(bump_hop(meta))
+    assert meta_hop(bump_hop(make_meta(hop=63))) == 63
+
+
+def test_snapshot_req_traceparent_compat():
+    """The r19 trailing traceparent on SnapshotReq: absent → r17 bytes
+    unchanged (an r17 server consumes the whole frame), present → a
+    strict trailing extension an r17 reader never reaches, and the r19
+    reader over an r17 frame yields None."""
+    from corrosion_tpu.types.codec import (
+        Reader,
+        SnapshotReq,
+        decode_bi_payload_any,
+        encode_bi_payload_snapshot_req,
+    )
+
+    aid = ActorId(b"\x41" * 16)
+    plain = SnapshotReq(actor_id=aid, schema_sha=b"s" * 8, cluster_id=ClusterId(2))
+    tp = "00-" + "ee" * 16 + "-" + "ff" * 8 + "-01"
+    traced = SnapshotReq(
+        actor_id=aid, schema_sha=b"s" * 8, cluster_id=ClusterId(2),
+        traceparent=tp,
+    )
+
+    plain_bytes = encode_bi_payload_snapshot_req(plain)
+    traced_bytes = encode_bi_payload_snapshot_req(traced)
+    assert traced_bytes[: len(plain_bytes)] == plain_bytes  # strictly trailing
+
+    kind, req = decode_bi_payload_any(traced_bytes)
+    assert kind == "snapshot" and req.traceparent == tp
+    kind2, req2 = decode_bi_payload_any(plain_bytes)
+    assert kind2 == "snapshot" and req2.traceparent is None
+
+    # emulated r17 reader on the traced frame: stops after cluster_id,
+    # the traceparent bytes are simply left unread
+    r = Reader(traced_bytes)
+    assert (r.u32(), r.u32()) == (0, 1)
+    assert ActorId(r.raw(16)) == aid
+    assert r.vec_u8() == b"s" * 8
+    assert ClusterId(r.u16()) == ClusterId(2)
+    assert not r.eof()
+    # ...and consumes the plain frame whole
+    r2 = Reader(plain_bytes)
+    r2.u32(), r2.u32(), r2.raw(16), r2.vec_u8(), r2.u16()
+    assert r2.eof()
+
+
 def test_swim_digest_ext_compat():
     """Same discipline on the gossip datagrams: a digest-free SWIM
     packet encodes zero ext bytes (an emulated pre-r12 decoder consumes

@@ -10,7 +10,7 @@ Three layers, mirroring what the suite promises:
    `# corro: noqa[rule]` comment suppresses (proving the whole
    driver-side filter chain, not just the checker).
 3. THE FOLD IS LOSSLESS: the metrics lint folded into the framework
-   still reports the same 213 literal series + 2 wildcard sites in both
+   still reports the same 218 literal series + 2 wildcard sites in both
    directions, and the `scripts/lint_metrics.py` shim keeps its API.
 
 All pure-AST: no jax tracing, no sqlite, no network — the gate must
@@ -785,8 +785,8 @@ def test_timeout_discipline_real_tree_is_clean():
 
 
 def test_metrics_fold_reports_same_inventory():
-    """The lint_metrics fold is lossless: same 213 literal series (209
-    at r17 + the 4 r18 chaos-engine series — corro.chaos.*), same 2
+    """The lint_metrics fold is lossless: same 218 literal series (213
+    at r18 + the 5 r19 tail-sampler series — corro.trace.*), same 2
     wildcard sites, both directions clean, via BOTH the framework
     checker and the back-compat shim."""
     import lint_metrics
@@ -794,7 +794,7 @@ def test_metrics_fold_reports_same_inventory():
     assert MetricsDocChecker().run(AnalysisContext(REPO)) == []
     assert lint_metrics.lint() == []
     literals, wildcards = lint_metrics.scan_call_sites()
-    assert len(literals) == 213
+    assert len(literals) == 218
     assert len(wildcards) == 2
     names = lint_metrics.parse_components_table()
     assert len(names) == len(set(names))

@@ -1,0 +1,215 @@
+"""The r19 end-to-end tracing pin: one HTTP write on node A produces ONE
+trace_id whose stage spans (write→broadcast→recv→apply→match→deliver)
+cross two nodes, collected through the fake-OTLP collector
+(tests/test_otel.py pattern) and served by GET /v1/traces with a
+per-stage breakdown — and the tail sampler's verdicts are exercised
+live and DETERMINISTICALLY: a healthy write (lottery disabled) is
+dropped at trace close; a write breaching an SLO stage target is kept.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from corrosion_tpu.net.mem import MemNetwork
+from corrosion_tpu.runtime import otel, tracestore
+
+from tests.test_agent import wait_until
+from tests.test_http_api import boot_with_api
+from tests.test_pubsub_http import next_of
+
+E2E_STAGES = ("write", "broadcast", "recv", "apply", "match", "deliver")
+
+
+class _Collector(BaseHTTPRequestHandler):
+    def do_POST(self):  # noqa: N802
+        length = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(length))
+        self.server.bodies.append((self.path, body))
+        self.send_response(200)
+        self.send_header("Content-Length", "2")
+        self.end_headers()
+        self.wfile.write(b"{}")
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+@pytest.fixture
+def collector():
+    srv = HTTPServer(("127.0.0.1", 0), _Collector)
+    srv.bodies = []
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+    otel.configure(None)
+    tracestore.configure(None)
+
+
+def _otlp_stage_spans(srv):
+    """Stage-tagged spans only: the background sync plane's untagged
+    spans (sync.client / sync.server) keep the r11 direct-export path
+    and are not part of the tail-sampled verdict under test."""
+    out = []
+    for _path, body in srv.bodies:
+        for rs in body["resourceSpans"]:
+            for ss in rs["scopeSpans"]:
+                for s in ss["spans"]:
+                    attrs = {x["key"]: x["value"] for x in s["attributes"]}
+                    if "stage" in attrs:
+                        out.append(s)
+    return out
+
+
+def _force_close(st):
+    """Advance past idle-close for everything buffered and sweep (the
+    test drives sweeps by hand: auto_sweep=False)."""
+    import time
+
+    return st.sweep(now=time.monotonic() + st.idle_close_secs + 1)
+
+
+async def _traced_write(st, a, client_a, it, rowid):
+    """One HTTP write on A observed on B's subscription stream; returns
+    the buffered trace id (the one new trace the write opened)."""
+    before = set(st._buf)
+    await client_a.execute(
+        [["INSERT OR REPLACE INTO tests (id, text) VALUES (?, ?)",
+          [rowid, f"t{rowid}"]]]
+    )
+    ev = await next_of(it, "change", timeout=15.0)
+    assert ev["change"][2][0] == rowid
+
+    new = [t for t in st._buf if t not in before]
+    assert await wait_until(
+        lambda: any(
+            {r["attrs"]["stage"] for r in st._buf[t].spans} >= set(E2E_STAGES)
+            for t in new
+            if t in st._buf
+        ),
+        timeout=10.0,
+    ), {t: [r["attrs"]["stage"] for r in st._buf[t].spans] for t in new}
+    (tid,) = [
+        t for t in new
+        if {r["attrs"]["stage"] for r in st._buf[t].spans} >= set(E2E_STAGES)
+    ]
+    return tid
+
+
+def test_one_write_one_trace_across_two_nodes_tail_sampled(collector):
+    port = collector.server_address[1]
+
+    async def main():
+        net = MemNetwork(seed=67)
+        a, api_a, client_a = await boot_with_api(net, "trace-a")
+        b, api_b, client_b = await boot_with_api(net, "trace-b", ["trace-a"])
+        otel.configure(f"http://127.0.0.1:{port}", flush_interval_s=60.0)
+        # deterministic tail sampler: lottery OFF, targets unreachable —
+        # the healthy write can only be dropped
+        st = tracestore.configure(
+            targets={s: 100.0 for s in E2E_STAGES},
+            lottery_n=0,
+            auto_sweep=False,
+        )
+        try:
+            await wait_until(
+                lambda: len(a.members) == 1 and len(b.members) == 1
+            )
+            stream = client_b.subscribe("SELECT id, text FROM tests")
+            it = stream.__aiter__()
+            await next_of(it, "eoq")
+
+            # -- healthy write: buffered, then DROPPED at close ---------
+            tid_healthy = await _traced_write(st, a, client_a, it, 41)
+            spans = st._buf[tid_healthy].spans
+            actors = {
+                r["attrs"]["actor"] for r in spans if "actor" in r["attrs"]
+            }
+            assert actors == {str(a.actor_id), str(b.actor_id)}
+            _force_close(st)
+            assert st.kept() == []
+            assert st.census()["dropped_total"] >= 1
+            otel.exporter().flush()
+            # dropped = its stage spans are never exported
+            assert _otlp_stage_spans(collector) == []
+
+            # -- breaching write: the apply stage target is 0 → KEPT ----
+            st.targets["apply"] = 0.0
+            tid_slow = await _traced_write(st, a, client_a, it, 42)
+            _force_close(st)
+            kept = st.kept(n=5)
+            assert [t["trace_id"] for t in kept] == [tid_slow]
+            rec = kept[0]
+            assert rec["reason"] == "slo:apply"
+            assert rec["n_spans"] >= 5
+            assert set(rec["stages"]) >= set(E2E_STAGES)
+            assert rec["actors"] == sorted(
+                [str(a.actor_id), str(b.actor_id)]
+            )
+            assert rec["tables"] == ["tests"]
+
+            # kept spans were forwarded to the OTLP collector: ONE trace
+            # id, ≥5 stage spans, both nodes represented
+            otel.exporter().flush()
+            exported = _otlp_stage_spans(collector)
+            assert {s["traceId"] for s in exported} == {tid_slow}
+            by_stage = {}
+            for s in exported:
+                attrs = {x["key"]: x["value"] for x in s["attributes"]}
+                by_stage.setdefault(
+                    attrs["stage"]["stringValue"], []
+                ).append(attrs)
+            assert set(by_stage) >= set(E2E_STAGES)
+            exported_actors = {
+                a_["actor"]["stringValue"]
+                for group in by_stage.values()
+                for a_ in group
+                if "actor" in a_
+            }
+            assert exported_actors == {str(a.actor_id), str(b.actor_id)}
+
+            # -- the HTTP planes serve the same verdicts ----------------
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://{api_b.addrs[0]}/v1/traces", params={"n": "5"}
+                ) as resp:
+                    assert resp.status == 200
+                    body = await resp.json()
+                async with s.get(
+                    f"http://{api_b.addrs[0]}/v1/slo"
+                ) as resp:
+                    slo_body = await resp.json()
+                async with s.get(
+                    f"http://{api_b.addrs[0]}/v1/status"
+                ) as resp:
+                    status_body = await resp.json()
+            assert body["census"]["enabled"]
+            (t,) = body["traces"]
+            assert t["trace_id"] == tid_slow
+            assert set(t["stages"]) >= set(E2E_STAGES)
+            assert t["spans"][0]["stage"] == "write"  # start-ordered
+            # SLO exemplars name the kept trace on its breached stage
+            assert tid_slow in slo_body["stages"]["apply"]["slowest_trace_ids"]
+            # /v1/status census block
+            assert status_body["traces"]["enabled"]
+            assert status_body["traces"]["kept_total"] >= 1
+        finally:
+            await client_a.close()
+            await client_b.close()
+            await api_a.stop()
+            await api_b.stop()
+            from corrosion_tpu.agent.run import shutdown
+
+            await shutdown(a)
+            await shutdown(b)
+
+    asyncio.run(main())

@@ -1,0 +1,244 @@
+"""Tail-based trace capture (runtime/tracestore.py): keep/drop
+decisions, bounded buffering, the kept-trace query surface, and the
+span-routing seam in runtime/trace.py.
+
+Everything here is deterministic: a fake clock drives idle-close, and
+trace ids are crafted so the 1/N lottery verdict is chosen by the test
+(int(trace_id[:8], 16) % lottery_n)."""
+
+from __future__ import annotations
+
+from corrosion_tpu.runtime import trace as tr
+from corrosion_tpu.runtime import tracestore
+from corrosion_tpu.runtime.tracestore import TraceStore
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _tid(prefix8: str) -> str:
+    """A 32-hex trace id whose lottery draw is int(prefix8, 16)."""
+    assert len(prefix8) == 8
+    return prefix8 + "0" * 24
+
+
+def _span(tid, stage, dur_s, *, error=False, forced=False, start_s=0.0,
+          **attrs):
+    start_ns = int((1_000_000 + start_s) * 1e9)
+    a = {"stage": stage}
+    a.update({k: str(v) for k, v in attrs.items()})
+    return {
+        "name": f"{stage}.span",
+        "trace_id": tid,
+        "span_id": "ab" * 8,
+        "parent_span_id": None,
+        "start_ns": start_ns,
+        "end_ns": start_ns + int(dur_s * 1e9),
+        "attrs": a,
+        "error": error,
+        "forced": forced,
+    }
+
+
+def _store(**kw) -> TraceStore:
+    kw.setdefault("targets", {"apply": 0.5, "deliver": 0.1})
+    kw.setdefault("lottery_n", 0)  # deterministic: lottery off unless set
+    kw.setdefault("clock", FakeClock())
+    return TraceStore(**kw)
+
+
+def _close_all(st: TraceStore) -> int:
+    st._clock.t += st.idle_close_secs + 1
+    return st.sweep()
+
+
+def test_healthy_trace_dropped_at_close():
+    st = _store()
+    tid = _tid("00000001")  # lottery off anyway
+    st.add_span(_span(tid, "write", 0.001))
+    st.add_span(_span(tid, "apply", 0.01))
+    assert _close_all(st) == 1
+    assert st.kept() == []
+    assert st.dropped_total == 1 and st.kept_total == 0
+
+
+def test_slo_breach_keeps_with_stage_reason():
+    st = _store()
+    tid = _tid("00000001")
+    st.add_span(_span(tid, "write", 0.001))
+    st.add_span(_span(tid, "apply", 0.9))  # > 0.5 target
+    _close_all(st)
+    (kept,) = st.kept()
+    assert kept["trace_id"] == tid
+    assert kept["reason"] == "slo:apply"
+    assert kept["stages"]["apply"]["max_secs"] > 0.5
+
+
+def test_error_and_forced_precede_slo_and_lottery():
+    st = _store()
+    t_err = _tid("00000001")
+    st.add_span(_span(t_err, "apply", 0.9, error=True))
+    t_forced = _tid("00000002")
+    st.add_span(_span(t_forced, "write", 0.001, forced=True))
+    _close_all(st)
+    reasons = {t["trace_id"]: t["reason"] for t in st.kept(n=10)}
+    assert reasons[t_err] == "error"
+    assert reasons[t_forced] == "forced"
+
+
+def test_lottery_is_deterministic_on_trace_id():
+    st = _store(lottery_n=16)
+    winner = _tid("00000010")  # 0x10 % 16 == 0
+    loser = _tid("00000011")  # 0x11 % 16 == 1
+    assert st.head_forced(winner) and not st.head_forced(loser)
+    st.add_span(_span(winner, "write", 0.001))
+    st.add_span(_span(loser, "write", 0.001))
+    _close_all(st)
+    kept_ids = [t["trace_id"] for t in st.kept(n=10)]
+    assert kept_ids == [winner]
+    assert st.kept(n=10)[0]["reason"] == "lottery"
+    # lottery_n=0 disables the lottery entirely
+    assert not _store(lottery_n=0).head_forced(winner)
+
+
+def test_buffer_evicts_oldest_trace_whole():
+    st = _store(max_traces=3)
+    tids = [_tid(f"0000000{i}") for i in range(1, 5)]
+    for tid in tids:
+        st.add_span(_span(tid, "apply", 0.9))
+    # the oldest trace was evicted whole; the 3 newest close + keep
+    _close_all(st)
+    kept_ids = {t["trace_id"] for t in st.kept(n=10)}
+    assert kept_ids == set(tids[1:])
+
+
+def test_per_trace_span_cap_counts_overflow():
+    st = _store(max_spans_per_trace=4)
+    tid = _tid("00000001")
+    for _ in range(7):
+        st.add_span(_span(tid, "apply", 0.9))
+    _close_all(st)
+    (kept,) = st.kept()
+    assert kept["n_spans"] == 4 and kept["spans_dropped"] == 3
+
+
+def test_summary_breakdown_filters_and_exemplars():
+    st = _store()
+    slow = _tid("00000001")
+    st.add_span(_span(slow, "write", 0.002, actor="a1", table="tests"))
+    st.add_span(
+        _span(slow, "apply", 0.9, actor="a2", table="tests", hop=1,
+              start_s=0.002)
+    )
+    fast = _tid("00000002")
+    st.add_span(_span(fast, "apply", 0.6, actor="a9", table="other"))
+    _close_all(st)
+
+    # slowest-N ordering: `slow` spans ~0.9s total, `fast` ~0.6s
+    ids = [t["trace_id"] for t in st.kept(n=10)]
+    assert ids == [slow, fast]
+    # filters
+    assert [t["trace_id"] for t in st.kept(actor="a2")] == [slow]
+    assert [t["trace_id"] for t in st.kept(table="other")] == [fast]
+    assert [t["trace_id"] for t in st.kept(stage="write")] == [slow]
+    # per-stage breakdown + cross-node rollup
+    (kept,) = st.kept(actor="a2")
+    assert set(kept["stages"]) == {"write", "apply"}
+    assert kept["actors"] == ["a1", "a2"] and kept["hops"] == 1
+    assert kept["spans"][0]["stage"] == "write"  # start-ordered
+    # stage exemplars, slowest first
+    assert st.slowest_ids("apply", 2) == [slow, fast]
+    assert st.slowest_ids("write", 2) == [slow]
+
+
+def test_kept_ring_bounded():
+    st = _store(keep_max=2)
+    for i in range(1, 5):
+        tid = _tid(f"0000000{i}")
+        st.add_span(_span(tid, "apply", 0.9))
+        _close_all(st)
+    assert st.census()["kept_ring"] == 2
+    assert st.census()["kept_total"] == 4
+
+
+def test_census_shape():
+    st = _store()
+    st.add_span(_span(_tid("00000001"), "apply", 0.9))
+    c = st.census()
+    assert c["enabled"] and c["buffered"] == 1
+    _close_all(st)
+    c2 = st.census()
+    assert c2["buffered"] == 0 and c2["kept_total"] == 1
+
+
+def test_kept_traces_export_to_otel_on_keep_only():
+    from corrosion_tpu.runtime import otel
+
+    class FakeExp:
+        def __init__(self):
+            self.spans = []
+
+        def record(self, span):
+            self.spans.append(span)
+
+    st = _store()
+    fake = FakeExp()
+    otel._EXPORTER = fake
+    try:
+        dropped = _tid("00000001")
+        st.add_span(_span(dropped, "write", 0.001))
+        kept = _tid("00000002")
+        st.add_span(_span(kept, "apply", 0.9))
+        _close_all(st)
+        assert {s["traceId"] for s in fake.spans} == {kept}
+    finally:
+        otel._EXPORTER = None
+
+
+def test_span_routing_seam_buffers_stage_spans_only():
+    """Span.__exit__ / stage_span route stage-tagged spans into the
+    configured store (deferred export); untagged spans keep the r11
+    direct path (tests/test_otel.py pins that side)."""
+    st = tracestore.configure(
+        targets={}, lottery_n=0, auto_sweep=False, clock=FakeClock()
+    )
+    try:
+        with tr.span("write.local", stage="write", actor="a1") as sp:
+            pass
+        tid = sp.ctx.trace_id
+        with tr.span("sync.client"):  # untagged: never buffered
+            pass
+        assert tid in st._buf and len(st._buf) == 1
+        # stage_span synthesizes a child covering the last duration_s
+        ctx = tr.stage_span(
+            sp.ctx.traceparent(), "ingest.apply", "apply", 0.25,
+            actor="a2", hop=1,
+        )
+        assert ctx.trace_id == tid
+        buf = st._buf[tid]
+        assert [r["attrs"]["stage"] for r in buf.spans] == ["write", "apply"]
+        rec = buf.spans[1]
+        assert rec["parent_span_id"] == sp.ctx.span_id
+        assert abs((rec["end_ns"] - rec["start_ns"]) / 1e9 - 0.25) < 1e-6
+        # unparsable / absent context: no span, no crash
+        assert tr.stage_span(None, "x", "apply", 0.1) is None
+        assert tr.stage_span("garbage", "x", "apply", 0.1) is None
+        # unsampled wire context: context returned, nothing buffered
+        unsampled = "00-" + "aa" * 16 + "-" + "bb" * 8 + "-00"
+        tr.stage_span(unsampled, "x", "apply", 0.1)
+        assert "aa" * 16 not in st._buf
+    finally:
+        tracestore.configure(None)
+    assert tracestore.store() is None
+
+
+def test_forced_head_decision_rides_meta_bits():
+    assert tr.meta_forced(tr.make_meta(forced=True))
+    assert not tr.meta_forced(tr.make_meta(forced=False, hop=5))
+    assert tr.meta_hop(tr.make_meta(hop=5)) == 5
+    assert tr.meta_forced(None) is False and tr.meta_hop(None) == 0

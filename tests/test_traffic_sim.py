@@ -129,6 +129,22 @@ def test_subscriptions_delivered_under_every_fault(by_id):
         assert rec["events_delivered"] > 0, f"{sid}: no live events"
 
 
+def test_churn_storm_banks_catchup_census(by_id):
+    """r19 (closes the r18 open sub-item): the churn-storm record
+    carries the RESTARTED node's /v1/status catch-up census — how it
+    caught up (bootstrap state, held versions, resume waves, circuit
+    state), not just that row counts converged."""
+    cc = by_id["churn-storm"].get("catchup")
+    assert cc, "churn-storm record has no catch-up census"
+    for key in (
+        "snapshot_enabled", "bootstrap", "held_versions",
+        "resume_waves", "circuits_open",
+    ):
+        assert key in cc, f"catchup census missing {key}: {cc}"
+    # the churned node rejoined holding real state
+    assert cc["held_versions"] > 0
+
+
 def test_injected_store_faults_surface_typed(by_id):
     """sick-disk: the injected SQLITE_BUSY/IO errors must appear as
     COUNTED typed refusals (the cluster answered; nothing hung)."""
