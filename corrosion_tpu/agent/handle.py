@@ -83,6 +83,10 @@ class Agent:
     # r12 cluster observatory (agent/observatory.py): digest
     # anti-entropy store + view-divergence detector, serves /v1/cluster
     observatory: Optional[object] = None
+    # r20 alerting plane (runtime/alerts.py): declarative rules over
+    # the metrics TSDB with a pending→firing→resolved lifecycle;
+    # serves /v1/alerts, summaries ride the observatory digests
+    alerts: Optional[object] = None
     # r14 write-path group commit (agent/run.py GroupCommitter):
     # concurrent local writers coalesce into shared sqlite transactions
     commit_group: Optional[object] = None

@@ -151,6 +151,11 @@ class Observatory:
             for ev, v in by_event.items():
                 events[ev] = events.get(ev, 0) + int(v)
 
+        # r20: the node's active alerts ride the digest so any node can
+        # serve the cluster alert view (bounded, firing-first)
+        eng = getattr(self.agent, "alerts", None)
+        alerts = eng.active_summaries() if eng is not None else []
+
         with self._lock:
             self._seq += 1
             seq = self._seq
@@ -167,6 +172,7 @@ class Observatory:
             loop_lag=loop_lag,
             sync_backlog=backlog,
             heads_total=max(0, heads_total),
+            alerts=alerts,
             events=events,
             stages=lat.stage_hists(window_secs=None),
         )
@@ -377,6 +383,52 @@ class Observatory:
         }
 
     # -- the any-node cluster plane ----------------------------------------
+
+    def cluster_alerts(self) -> dict:
+        """What `GET /v1/alerts?scope=cluster` serves: every node's
+        digest-carried active alerts plus a per-rule rollup — from ANY
+        single node, over the same anti-entropy store /v1/cluster
+        reads.  The serving node's own digest is rebuilt at read time
+        (same discipline as cluster_report)."""
+        self.build_and_store()
+        now_mono = time.monotonic()
+        stale_after = self.cfg.stale_after_secs
+        nodes: Dict[str, dict] = {}
+        rollup: Dict[str, dict] = {}
+        with self._lock:  # snapshot vs the worker-thread builder
+            held_all = list(self._store.values())
+        for held in held_all:
+            d = held.digest
+            age = now_mono - held.received_mono
+            name = str(ActorId(d.actor_id))
+            nodes[name] = {
+                "age_secs": round(age, 3),
+                "fresh": age <= stale_after,
+                "alerts": list(d.alerts),
+            }
+            if age > stale_after:
+                continue  # stale digests list but never roll up
+            for a in d.alerts:
+                row = rollup.setdefault(a["rule"], {
+                    "severity": a["severity"],
+                    "firing": [], "pending": [], "drill": False,
+                })
+                row[a["state"]].append(name)
+                row["drill"] = row["drill"] or bool(a.get("drill"))
+        for row in rollup.values():
+            row["firing"].sort()
+            row["pending"].sort()
+        return {
+            "actor_id": str(self.agent.actor_id),
+            "scope": "cluster",
+            "coverage": {
+                "known": len(nodes),
+                "fresh": sum(1 for n in nodes.values() if n["fresh"]),
+                "stale_after_secs": stale_after,
+            },
+            "rollup": rollup,
+            "nodes": nodes,
+        }
 
     def cluster_report(self) -> dict:
         """What `GET /v1/cluster` serves: digest coverage, per-node

@@ -259,6 +259,23 @@ async def setup(
             idle_close_secs=config.trace.idle_close_secs,
         )
 
+    # r20 alerting plane: the TSDB sampler thread is process-global
+    # (first agent's [tsdb] knobs win, the tracestore rule); the rule
+    # engine is per-agent so its health score reads THIS node's
+    # membership LHM and its summaries ride THIS node's digests
+    if config.tsdb.enabled and config.alerts.enabled:
+        from corrosion_tpu.runtime import tsdb as _tsdb
+        from corrosion_tpu.runtime.alerts import AlertEngine
+
+        db = _tsdb.ensure(
+            sample_interval_secs=config.tsdb.sample_interval_secs,
+            slots=config.tsdb.slots,
+            max_series=config.tsdb.max_series,
+        )
+        agent.alerts = AlertEngine(
+            tsdb=db, cfg=config.alerts, agent=agent
+        )
+
     # r12 cluster observatory: telemetry digests piggyback the gossip
     # datagrams (hooks below) + broadcast envelopes (broadcast_loop);
     # received digests feed the anti-entropy store behind /v1/cluster
@@ -367,6 +384,11 @@ async def run(agent: Agent) -> None:
         from corrosion_tpu.agent.observatory import observatory_loop
 
         t.spawn(observatory_loop(agent))
+    if agent.alerts is not None:
+        # r20: rule evaluation over the TSDB (pending→firing→resolved)
+        from corrosion_tpu.runtime.alerts import alerts_loop
+
+        t.spawn(alerts_loop(agent))
     # db maintenance: WAL truncate ladder + incremental vacuum
     # (handlers.rs:379-547) — this is what makes perf.wal_threshold_gb live
     from corrosion_tpu.store.maintenance import vacuum_loop, wal_maintenance_loop
@@ -627,6 +649,26 @@ def _cancelled_error() -> BaseException:
     return asyncio.CancelledError("group leader cancelled before commit")
 
 
+def _count_write_error(e: BaseException) -> None:
+    """Typed store-fault accounting on the local write path: every
+    sqlite-level writer failure (sick disk: SQLITE_BUSY, I/O errors;
+    real or chaos-injected — both raise the same typed error) lands in
+    `corro.store.write.errors.total{kind=}`, the series the
+    `store-faults` alert rule (runtime/alerts.py) watches."""
+    import sqlite3 as _sqlite3
+
+    if not isinstance(e, _sqlite3.Error):
+        return
+    msg = str(e).lower()
+    if "locked" in msg or "busy" in msg:
+        kind = "busy"
+    elif "i/o" in msg or "disk" in msg:
+        kind = "io"
+    else:
+        kind = "other"
+    METRICS.counter("corro.store.write.errors.total", kind=kind).inc()
+
+
 def _pending_row_bytes(r) -> int:
     """Rough wire-size of one captured-cell row — (tbl, pk, cid, val)
     tuples since r15's in-memory direct capture (the group byte budget:
@@ -815,6 +857,7 @@ class GroupCommitter:
                                     pending = tx.commit_deferred()
                             except BaseException as e:
                                 item.error = e
+                                _count_write_error(e)
                                 if not use_sp:
                                     # savepoint-free sub-tx: the shared
                                     # transaction is poisoned — abort it
@@ -845,6 +888,8 @@ class GroupCommitter:
                     # the shared finalize/COMMIT died: every sub-tx in
                     # this group rolled back with it (a failed
                     # savepoint-free solo writer keeps its OWN error)
+                    if not any(it.error is e for it in batch):
+                        _count_write_error(e)  # group-level fault
                     for it, _p in group:
                         if it.error is None:
                             it.error = e
@@ -930,9 +975,13 @@ async def _make_broadcastable_changes_inner(
                             bv.commit_snapshot(snap)
                     return results, changes, db_version, last_seq
 
-            results, changes, db_version, last_seq = await asyncio.to_thread(
-                txn
-            )
+            try:
+                results, changes, db_version, last_seq = (
+                    await asyncio.to_thread(txn)
+                )
+            except BaseException as e:
+                _count_write_error(e)
+                raise
 
     if changes:
         # the ORIGIN stamp: wall clock at local commit — every

@@ -105,6 +105,7 @@ class ApiServer:
         app.router.add_get("/v1/slo", self.h_slo)
         app.router.add_get("/v1/cluster", self.h_cluster)
         app.router.add_get("/v1/traces", self.h_traces)
+        app.router.add_get("/v1/alerts", self.h_alerts)
         return app
 
     async def start(self) -> None:
@@ -512,6 +513,13 @@ class ApiServer:
             # in-flight buffer, how many traces were kept vs dropped
             # (full kept traces live at GET /v1/traces)
             "traces": _trace_census(),
+            # r20 alerts census: which rules are firing/pending right
+            # now and how sick this node judges itself (full lifecycle
+            # rows + history live at GET /v1/alerts)
+            "alerts": (
+                agent.alerts.census()
+                if agent.alerts is not None else {"enabled": False}
+            ),
             # r11 SLO plane pointer: the canary's live numbers (full
             # per-stage percentiles live at GET /v1/slo)
             "slo": {
@@ -719,6 +727,34 @@ class ApiServer:
                 "traces": traces,
             }
         )
+
+    async def h_alerts(self, request: web.Request) -> web.Response:
+        """Alerting plane (r20): the typed, lifecycle-tracked alerts
+        the `[alerts]` rules raised over the metrics TSDB.  Default
+        scope serves THIS node's engine (rule states, active alerts
+        with drill marks / exemplar trace ids / incident paths, and
+        the transition history; `?history=0` trims it);
+        `?scope=cluster` serves every node's digest-carried active
+        alerts plus a per-rule rollup — from ANY single node, over the
+        observatory's anti-entropy store."""
+        if request.query.get("scope") == "cluster":
+            obs = self.agent.observatory
+            if obs is None:
+                raise web.HTTPNotImplemented(
+                    text="cluster observatory disabled "
+                         "([cluster] digests=false)"
+                )
+            return web.json_response(obs.cluster_alerts())
+        eng = self.agent.alerts
+        if eng is None:
+            return web.json_response(
+                {"enabled": False, "rules": [], "active": []}
+            )
+        report = eng.report(
+            history=request.query.get("history") != "0"
+        )
+        report["actor_id"] = str(self.agent.actor_id)
+        return web.json_response(report)
 
     async def h_cluster(self, request: web.Request) -> web.Response:
         """Cluster observatory plane (r12): the CLUSTER-wide answer any

@@ -116,6 +116,17 @@ def _build_parser() -> argparse.ArgumentParser:
     trc.add_argument("--json", action="store_true",
                      help="raw JSON instead of the table render")
 
+    al = sub.add_parser(
+        "alerts", help="fetch the node's alert states (GET /v1/alerts)"
+    )
+    al.add_argument("--cluster", action="store_true",
+                    help="cluster scope: every node's digest-carried "
+                         "active alerts + per-rule rollup")
+    al.add_argument("--history", action="store_true",
+                    help="include the fired/resolved transition history")
+    al.add_argument("--json", action="store_true",
+                    help="raw JSON instead of the table render")
+
     actor = sub.add_parser("actor").add_subparsers(dest="sub", required=True)
     av = actor.add_parser("version")
     av.add_argument("actor_id")
@@ -554,6 +565,83 @@ async def _cmd_traces(cfg: Config, args) -> int:
     return 0
 
 
+async def _cmd_alerts(cfg: Config, args) -> int:
+    """Operator fetch of GET /v1/alerts: rule states, active alerts
+    (drill marks, exemplar trace ids), optional history — or the
+    cluster rollup with --cluster."""
+    import aiohttp
+
+    params = {}
+    if args.cluster:
+        params["scope"] = "cluster"
+    elif not args.history:
+        params["history"] = "0"
+    url = f"http://{_api_addr(cfg)}/v1/alerts"
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                url, params=params, timeout=aiohttp.ClientTimeout(total=10)
+            ) as resp:
+                body = await resp.json()
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        print(f"could not reach {url}: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(body, indent=2))
+        return 0
+    if args.cluster:
+        cov = body.get("coverage", {})
+        print(
+            f"cluster alerts from {body.get('actor_id')}: "
+            f"{cov.get('known', 0)} node(s) known, "
+            f"{cov.get('fresh', 0)} fresh"
+        )
+        rollup = body.get("rollup", {})
+        if not rollup:
+            print("no active alerts cluster-wide")
+            return 0
+        print(f"{'rule':<20} {'sev':<5} {'firing':<24} {'pending':<24} drill")
+        for rule, row in sorted(rollup.items()):
+            print(
+                f"{rule:<20} {row['severity']:<5} "
+                f"{','.join(row['firing']) or '-':<24} "
+                f"{','.join(row['pending']) or '-':<24} "
+                f"{'yes' if row['drill'] else '-'}"
+            )
+        return 0
+    if not body.get("enabled"):
+        print("alerting plane disabled ([alerts] enabled=false)")
+        return 0
+    print(
+        f"health score {body.get('health_score')}  "
+        f"({len(body.get('active', []))} active)"
+    )
+    print(f"{'rule':<20} {'sev':<5} {'state':<8} {'value':>12}  notes")
+    for r in body.get("rules", []):
+        notes = []
+        if r.get("drill"):
+            notes.append(f"drill={r['drill']}")
+        if r.get("trace_ids"):
+            notes.append(f"traces={','.join(r['trace_ids'][:2])}")
+        if r.get("incident"):
+            notes.append("incident")
+        v = r.get("value")
+        print(
+            f"{r['rule']:<20} {r['severity']:<5} {r['state']:<8} "
+            f"{v if v is not None else '-':>12}  {' '.join(notes)}"
+        )
+    for h in body.get("history", []):
+        dur = (
+            f" after {h['duration_secs']}s"
+            if h.get("duration_secs") is not None else ""
+        )
+        print(
+            f"  {h['wall']:.3f} {h['rule']} {h['event']}{dur}"
+            + (f" [drill: {h['drill']}]" if h.get("drill") else "")
+        )
+    return 0
+
+
 async def _cmd_template(cfg: Config, args) -> int:
     from corrosion_tpu.tpl import render_specs, watch_specs
 
@@ -626,6 +714,8 @@ async def _amain(argv: Optional[List[str]] = None) -> int:
         return await _admin_call(cfg, {"cmd": "locks", "top": args.top})
     if cmd == "traces":
         return await _cmd_traces(cfg, args)
+    if cmd == "alerts":
+        return await _cmd_alerts(cfg, args)
     if cmd == "actor":
         return await _admin_call(
             cfg,

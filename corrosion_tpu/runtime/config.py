@@ -184,6 +184,52 @@ class TraceConfig:
 
 
 @dataclass
+class TsdbConfig:
+    """[tsdb] — the local metrics time-series store (runtime/tsdb.py,
+    r20).  A daemon thread samples the process registry every
+    `sample_interval_secs` (counters→rates, gauges→levels, latency
+    p50/p99), keeping `slots` points per series in a bounded ring —
+    the substrate the `[alerts]` rules evaluate against.  Memory is
+    capped by `slots × max_series` and accounted in `corro.tsdb.*`."""
+
+    enabled: bool = True
+    # Prometheus-scrape-like cadence: cheap enough to forget about
+    # (one registry snapshot per tick), fine enough for for-durations
+    # in the seconds; harnesses that need sub-second alerting
+    # (scripts/traffic_sim.py) tune it per run
+    sample_interval_secs: float = 5.0
+    slots: int = 240  # ring depth per series (240 × 5 s = 20 min)
+    max_series: int = 4096
+
+
+@dataclass
+class AlertsConfig:
+    """[alerts] — declarative anomaly rules over the TSDB
+    (runtime/alerts.py, r20).  `rules` is a list of
+    `[[alerts.rules]]` tables ({name, kind=threshold|rate|absent,
+    series, op, value, for_secs, window_secs, severity, agg, labels,
+    summary}); `default_pack` prepends the built-in pack (SLO burn,
+    loop lag, shed/refusal rates, open sync circuits, view
+    divergence, store faults) — an operator rule with the same name
+    overrides the pack's.  `for_scale` multiplies every rule's
+    for/window durations (the chaos harness shrinks them to fit tiny
+    scenario windows).  The health knobs feed the Lifeguard-style
+    local-health score that WIDENS for-durations (up to
+    `health_widen_max`×) when this node itself is sick — a lagging
+    node distrusts its own timers instead of flooding false pages."""
+
+    enabled: bool = True
+    eval_interval_secs: float = 5.0
+    history_max: int = 256
+    default_pack: bool = True
+    for_scale: float = 1.0
+    rules: List[dict] = field(default_factory=list)
+    health_lag_secs: float = 0.25
+    health_fault_rate: float = 5.0
+    health_widen_max: float = 4.0
+
+
+@dataclass
 class PubsubConfig:
     """[pubsub] — live-query matcher knobs.  `candidate_batch_wait` is
     the matcher's candidate-batching window in seconds: the PR-6 SLO
@@ -357,6 +403,8 @@ class Config:
     cluster: ClusterObsConfig = field(default_factory=ClusterObsConfig)
     sync: SyncConfig = field(default_factory=SyncConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
+    tsdb: TsdbConfig = field(default_factory=TsdbConfig)
+    alerts: AlertsConfig = field(default_factory=AlertsConfig)
 
 
 _ENV_PREFIX = "CORRO_"

@@ -40,6 +40,12 @@ from corrosion_tpu.types.codec import Reader, Writer
 
 DIGEST_V1 = 1
 
+# r20 alert-summary enum codes (wire form of the trailing alert block)
+_SEV_CODE = {"info": 0, "warn": 1, "page": 2}
+_SEV_NAME = {v: k for k, v in _SEV_CODE.items()}
+_STATE_CODE = {"pending": 1, "firing": 2}
+_STATE_NAME = {v: k for k, v in _STATE_CODE.items()}
+
 
 def view_hash(ids: Iterable[bytes]) -> int:
     """Canonical u64 hash of a membership view: the sorted 16-byte actor
@@ -80,6 +86,13 @@ class NodeDigest:
     # against it.  Rides as a TRAILING field (old decoders stop before
     # it, new decoders default 0 on eof — the envelope-ext tolerance).
     heads_total: int = 0
+    # r20: this node's ACTIVE alerts (runtime/alerts.py
+    # `active_summaries`: rule, severity, state pending|firing, since
+    # wall, drill flag, trigger value) — how `GET /v1/alerts?scope=
+    # cluster` answers from ANY node.  Rides as a second TRAILING
+    # block after `heads_total` with the same eof tolerance: old
+    # decoders stop before it, new decoders default to [] on eof.
+    alerts: List[dict] = field(default_factory=list)
     # device kernel event totals (corro.kernel.events.total), summed
     # across kernels — empty on agents that host no kernel sim
     events: Dict[str, int] = field(default_factory=dict)
@@ -145,6 +158,19 @@ def encode_digest(d: NodeDigest) -> bytes:
         w.string(stage)
         write_hist(w, h)
     w.uvarint(d.heads_total)  # r17 trailing field (default_on_eof)
+    # r20 trailing alert block (default_on_eof like heads_total): the
+    # severity/state string<->code maps live beside the codec so the
+    # wire never carries free-form strings for enum fields
+    w.uvarint(len(d.alerts))
+    for a in d.alerts:
+        w.string(a["rule"])
+        w.u8(_SEV_CODE.get(a.get("severity", "warn"), 1))
+        w.u8(
+            _STATE_CODE.get(a.get("state", "firing"), 2)
+            | (0x80 if a.get("drill") else 0)
+        )
+        w.f64(float(a.get("since") or 0.0))
+        w.f64(float(a.get("value") or 0.0))
     return w.bytes()
 
 
@@ -175,6 +201,19 @@ def decode_digest(data: bytes) -> NodeDigest:
         stage = r.string()
         d.stages[stage] = read_hist(r)
     d.heads_total = r.uvarint() if not r.eof() else 0
+    if not r.eof():
+        for _ in range(r.uvarint()):
+            rule = r.string()
+            sev = r.u8()
+            state = r.u8()
+            d.alerts.append({
+                "rule": rule,
+                "severity": _SEV_NAME.get(sev, "warn"),
+                "state": _STATE_NAME.get(state & 0x7F, "firing"),
+                "drill": bool(state & 0x80),
+                "since": r.f64(),
+                "value": r.f64(),
+            })
     return d
 
 

@@ -93,6 +93,13 @@ def _escape_label_value(v: str) -> str:
 
 
 class Registry:
+    # r20 cardinality guard: distinct label sets one series NAME may
+    # mint before further label sets are dropped typed.  A runaway
+    # label value (a pk in a label, an unescaped path) used to grow
+    # the registry without bound; the largest legitimate family today
+    # (corro.api.requests endpoint×status) is well under this.
+    max_label_sets = 512
+
     def __init__(self):
         self._counters: Dict[Tuple[str, LabelKey], Counter] = {}
         self._gauges: Dict[Tuple[str, LabelKey], Gauge] = {}
@@ -102,30 +109,81 @@ class Registry:
         # substrate; carries its own internal lock like the others
         self._latencies: Dict[Tuple[str, LabelKey], object] = {}
         self._lock = threading.Lock()
+        # per-name label-set counts (all kinds pooled) + the shared
+        # detached instruments capped mint attempts are handed: callers
+        # keep a working object, the writes just land nowhere
+        self._name_counts: Dict[str, int] = {}
+        self._null_counter = Counter()
+        self._null_gauge = Gauge()
+        self._null_histogram = Histogram()
+        self._null_latency = None
+
+    def _admit(self, name: str) -> bool:
+        """Under self._lock: account one NEW label set for `name`;
+        False when the per-name cap is hit (the caller then drops
+        typed and returns the detached instrument)."""
+        n = self._name_counts.get(name, 0)
+        if n >= self.max_label_sets:
+            return False
+        self._name_counts[name] = n + 1
+        return True
+
+    def _series_total_locked(self) -> int:
+        return (
+            len(self._counters) + len(self._gauges)
+            + len(self._histograms) + len(self._latencies)
+        )
+
+    def _note_mint(self) -> None:
+        """Publish the registry's own size after a mint (outside the
+        lock; bounded re-entry — minting corro.metrics.series itself
+        lands in the existing-instrument fast path on the inner call).
+        The total is recomputed AFTER the gauge is resolved so the
+        first mint's recursive gauge mint is counted too."""
+        g = self.gauge("corro.metrics.series")
+        with self._lock:
+            total = self._series_total_locked()
+        g.set(total)
+
+    def _note_drop(self, kind: str) -> None:
+        """Typed drop count for a label set refused by the cardinality
+        cap (outside the lock; this family has one label set per kind,
+        so it can never trip the cap it reports on)."""
+        self.counter(
+            "corro.metrics.cardinality.dropped.total", kind=kind
+        ).inc()
+
+    def _get(self, table, kind, factory, name, labels):
+        """Shared guarded mint: existing instruments return on the fast
+        path; a NEW label set is admitted against the per-name cap or
+        refused (typed drop + the shared detached instrument)."""
+        key = (name, _labels_key(labels))
+        minted = False
+        with self._lock:
+            inst = table.get(key)
+            if inst is None and self._admit(name):
+                inst = table[key] = factory()
+                minted = True
+        if inst is None:
+            self._note_drop(kind)
+            return None
+        if minted:
+            self._note_mint()
+        return inst
 
     def counter(self, name: str, **labels: str) -> Counter:
-        key = (name, _labels_key(labels))
-        with self._lock:
-            c = self._counters.get(key)
-            if c is None:
-                c = self._counters[key] = Counter()
-            return c
+        c = self._get(self._counters, "counter", Counter, name, labels)
+        return c if c is not None else self._null_counter
 
     def gauge(self, name: str, **labels: str) -> Gauge:
-        key = (name, _labels_key(labels))
-        with self._lock:
-            g = self._gauges.get(key)
-            if g is None:
-                g = self._gauges[key] = Gauge()
-            return g
+        g = self._get(self._gauges, "gauge", Gauge, name, labels)
+        return g if g is not None else self._null_gauge
 
     def histogram(self, name: str, **labels: str) -> Histogram:
-        key = (name, _labels_key(labels))
-        with self._lock:
-            h = self._histograms.get(key)
-            if h is None:
-                h = self._histograms[key] = Histogram()
-            return h
+        h = self._get(
+            self._histograms, "histogram", Histogram, name, labels
+        )
+        return h if h is not None else self._null_histogram
 
     def latency(self, name: str, **labels: str):
         """Windowed percentile histogram (runtime/latency.py): log
@@ -133,12 +191,23 @@ class Registry:
         and cumulative.  Use for every latency an SLO is judged on."""
         from corrosion_tpu.runtime.latency import WindowedLatency
 
-        key = (name, _labels_key(labels))
+        w = self._get(
+            self._latencies, "latency", WindowedLatency, name, labels
+        )
+        if w is None:
+            with self._lock:
+                if self._null_latency is None:
+                    self._null_latency = WindowedLatency()
+            return self._null_latency
+        return w
+
+    def latency_items(self):
+        """Every latency instrument as (name, labels, instrument) rows
+        — what the TSDB's quantile sampling pass iterates
+        (runtime/tsdb.py) without minting series by looking."""
         with self._lock:
-            w = self._latencies.get(key)
-            if w is None:
-                w = self._latencies[key] = WindowedLatency()
-            return w
+            items = list(self._latencies.items())
+        return [(n, dict(labels), w) for (n, labels), w in items]
 
     def latency_family(self, name: str):
         """All label sets of one latency series, as (name, labels,

@@ -361,6 +361,55 @@ def render_traces_section(emit, n: int = 8) -> None:
     emit()
 
 
+def render_alerts_section(emit) -> None:
+    """r20: the alerting plane — sample the now-populated registry into
+    a short-ring TSDB, evaluate the default rule pack, and render the
+    rule-state table (the same rows GET /v1/alerts serves).  A healthy
+    report shows every rule ok with its current evaluation value; the
+    point here is the end-to-end plumbing registry → TSDB → rules."""
+    from corrosion_tpu.runtime.alerts import AlertEngine
+    from corrosion_tpu.runtime.config import AlertsConfig
+    from corrosion_tpu.runtime.tsdb import MetricsTSDB
+
+    db = MetricsTSDB(
+        registry=METRICS, sample_interval_secs=0.05, slots=64
+    )
+    eng = AlertEngine(
+        tsdb=db, cfg=AlertsConfig(for_scale=0.01), registry=METRICS
+    )
+    for _ in range(4):  # a few ticks so counters get real rate points
+        db.sample_once()
+        time.sleep(0.06)
+        eng.evaluate()
+    rep = eng.report(history=True)
+    c = db.census()
+
+    emit("## alerting plane (corro.alerts.* / corro.tsdb.*, "
+         "GET /v1/alerts)")
+    emit(
+        f"tsdb: {c['series']} series / {c['points']} points over "
+        f"{c['samples']} samples; local health score "
+        f"{rep['health_score']} (for-duration widening "
+        f"×{1 + rep['health_score']:.2f})"
+    )
+    emit(
+        f"{'rule':<20} {'sev':<5} {'kind':<10} {'state':<8} "
+        f"{'value':>12}  series"
+    )
+    for r in rep["rules"]:
+        v = "—" if r["value"] is None else f"{r['value']:.4g}"
+        emit(
+            f"{r['rule']:<20} {r['severity']:<5} {r['kind']:<10} "
+            f"{r['state']:<8} {v:>12}  {r['series']}"
+        )
+    for h in rep.get("history", []):
+        emit(
+            f"  transition: {h['rule']} {h['event']}"
+            + (f" [drill: {h['drill']}]" if h.get("drill") else "")
+        )
+    emit()
+
+
 def render_cluster_section(emit, writes: int = 6) -> None:
     """r12: the cluster observatory — replay a two-node mem-net
     partition through the shared scenario harness and render what the
@@ -470,6 +519,7 @@ def main() -> None:
     render_cluster_section(
         emit, writes=int(os.environ.get("OBS_REPORT_CLUSTER_WRITES", "6"))
     )
+    render_alerts_section(emit)
 
     path = os.environ.get(
         "OBS_REPORT_OUT", os.path.join(REPO, "OBS_REPORT.md")

@@ -111,9 +111,24 @@ def live_buffer_report(n: int, k: int, feeds: int, tick_mode: str) -> dict:
         n=n, slots=k, feeds_per_tick=feeds,
         feed_entries=max(16, k // 16), tie_epoch=512, tick_mode=tick_mode,
     )
-    t0 = time.monotonic()
-    compiled = aot_compile_scanned_tick(params)
-    compile_s = time.monotonic() - t0
+    # the accounting below reads memory_analysis()/as_text(): an
+    # executable deserialized from the persistent cache reports zeroed
+    # stats and no HLO, so the AOT introspection always compiles fresh
+    # (tests/test_pview_memguard.py carries the same opt-out; the
+    # reset matters — the cache singleton ignores config flips once
+    # another compile has initialized it)
+    from jax._src import compilation_cache as _cc
+
+    old_enable = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    _cc.reset_cache()
+    try:
+        t0 = time.monotonic()
+        compiled = aot_compile_scanned_tick(params)
+        compile_s = time.monotonic() - t0
+    finally:
+        jax.config.update("jax_enable_compilation_cache", old_enable)
+        _cc.reset_cache()
     ma = compiled.memory_analysis()
     copies = table_copy_count(compiled.as_text(), n, k)
     table_b = n * k * 4

@@ -195,10 +195,24 @@ class TrafficSim:
         )
         self.duration = 0.8 if tiny else 6.0
         self.recovery_cap = 8.0 if tiny else 45.0
+        # alert settle caps: how long after the workload window (faults
+        # still injected) the expected typed alert may take to reach
+        # FIRING, and how long after recovery it may take to resolve
+        self.alert_fire_cap = 3.0 if tiny else 10.0
+        self.alert_resolve_cap = 4.0 if tiny else 12.0
         self.nodes: Dict[str, SimNode] = {}
 
         def tune(cfg):
             cfg.sync.circuit_reset_secs = 1.0 if tiny else 3.0
+            # r20 alerting plane at scenario-window timescales: fast
+            # TSDB sampling/eval and for-durations scaled so a typed
+            # alert can complete pending→firing inside the fault window
+            # and resolve inside the recovery window (the health score
+            # may still widen them Lifeguard-style — the caps above
+            # leave room for the worst-case ×4)
+            cfg.tsdb.sample_interval_secs = 0.08 if tiny else 0.25
+            cfg.alerts.eval_interval_secs = 0.08 if tiny else 0.2
+            cfg.alerts.for_scale = 0.04 if tiny else 0.15
 
         names = [f"n{i}" for i in range(n)]
         for name in names:
@@ -298,6 +312,50 @@ class TrafficSim:
             "divergence_zero": self._divergence_zero(),
         }
 
+    # -- alert observation --------------------------------------------------
+
+    # the drill-vs-outage proof (r20): each fault scenario that has a
+    # typed alert in the default pack must RAISE it while injected
+    # (with the drill mark, since the chaos census is populated) and
+    # RESOLVE it after restore()
+    EXPECTED_ALERTS = {
+        "sick-disk": "store-faults",
+        "zombie-node": "view-divergence",
+    }
+
+    async def _scrape_alerts(self) -> Optional[dict]:
+        wn = self.nodes["n0"].workload_node
+        if wn is None:
+            return None
+        from corrosion_tpu.chaos.workload import MixedWorkload
+
+        return await MixedWorkload(self.live_nodes).scrape(
+            wn, "/v1/alerts?history=0"
+        )
+
+    @staticmethod
+    def _alert_row(report: Optional[dict], rule: str) -> Optional[dict]:
+        for r in (report or {}).get("rules", []):
+            if r["rule"] == rule:
+                return r
+        return None
+
+    async def _await_alert_state(
+        self, rule: str, want_firing: bool, cap: float
+    ) -> Optional[dict]:
+        """Poll n0's /v1/alerts until `rule` reaches (or leaves) the
+        FIRING state; returns the final report (never raises — the
+        bars judge the banked outcome)."""
+        deadline = time.monotonic() + cap
+        report = None
+        while time.monotonic() < deadline:
+            report = await self._scrape_alerts()
+            row = self._alert_row(report, rule)
+            if row is not None and (row["state"] == "firing") == want_firing:
+                break
+            await asyncio.sleep(0.1)
+        return report
+
     # -- one scenario -------------------------------------------------------
 
     async def run_scenario(
@@ -322,8 +380,23 @@ class TrafficSim:
         summary = await workload.summary(
             scrape_node=self.nodes["n0"].workload_node
         )
+        # r20 alert proof, injection half: faults are STILL live here —
+        # the scenario's typed alert must be firing (drill-marked) on
+        # the alerting plane before restore() is allowed to clear it
+        expected_alert = self.EXPECTED_ALERTS.get(scenario_id)
+        alerts_during = None
+        if expected_alert is not None:
+            alerts_during = await self._await_alert_state(
+                expected_alert, want_firing=True, cap=self.alert_fire_cap
+            )
         await self.engine.restore()
         recovery = await self.measure_recovery()
+        alerts_after = None
+        if expected_alert is not None:
+            alerts_after = await self._await_alert_state(
+                expected_alert, want_firing=False,
+                cap=self.alert_resolve_cap,
+            )
         rec = {
             "scenario": scenario_id,
             "injections": [
@@ -333,6 +406,19 @@ class TrafficSim:
             "recovery": recovery,
             **summary,
         }
+        if expected_alert is not None:
+            during_row = self._alert_row(alerts_during, expected_alert)
+            after_row = self._alert_row(alerts_after, expected_alert)
+            rec["alerts"] = {
+                "expected": expected_alert,
+                "during": during_row,
+                "after": after_row,
+                "raised": bool(during_row)
+                and during_row["state"] == "firing",
+                "drill": (during_row or {}).get("drill"),
+                "resolved": bool(after_row)
+                and after_row["state"] != "firing",
+            }
         if scenario_id == "churn-storm":
             # r19 (closes the r18 ROADMAP sub-item): the churned node
             # restarted through the real boot path and recovered over
@@ -442,6 +528,26 @@ def _assert_bars(rec: dict, tiny: bool) -> None:
             "sick-disk: injected store faults never surfaced as typed "
             "refusals"
         )
+    # r20 alert bars: the scenario's typed alert raised while injected
+    # (drill-marked — the chaos census was live) and resolved after
+    # restore().  Tier-1 replica asserts the sick-disk store-fault
+    # alert; the full matrix additionally holds zombie-node's
+    # view-divergence alert to the same bar.
+    if sid == "sick-disk" or (sid == "zombie-node" and not tiny):
+        al = rec.get("alerts")
+        assert al, f"{sid}: no alert observation in the record"
+        assert al["raised"], (
+            f"{sid}: typed alert {al['expected']!r} never reached "
+            f"FIRING while the fault was injected: {al['during']}"
+        )
+        assert al["drill"], (
+            f"{sid}: alert fired without the drill mark while the "
+            f"chaos census was active: {al['during']}"
+        )
+        assert al["resolved"], (
+            f"{sid}: alert {al['expected']!r} still firing after "
+            f"restore + recovery: {al['after']}"
+        )
     if sid == "churn-storm":
         cc = rec.get("catchup")
         assert cc, (
@@ -452,7 +558,18 @@ def _assert_bars(rec: dict, tiny: bool) -> None:
 
 
 async def run_matrix(tiny: bool) -> dict:
+    from corrosion_tpu.runtime import tsdb as _tsdb
+
     saved = (syncer.RECV_TIMEOUT, syncer.OPEN_TIMEOUT)
+    # fresh global TSDB at the sim's sampling cadence: an in-suite
+    # replica must not inherit (or leave behind) another test's
+    # sampler config or ring history — agent setup's ensure() then
+    # adopts this instance for every sim node
+    _tsdb.configure(
+        sample_interval_secs=0.08 if tiny else 0.25,
+        slots=600,
+        max_series=4096,
+    )
     if tiny:
         # tiny-shape deadlines: the zombie window is ~1 s, so the sync
         # plane's deadlines must be proportionally tight for recovery
@@ -479,6 +596,7 @@ async def run_matrix(tiny: bool) -> dict:
     finally:
         await sim.stop_cluster()
         syncer.RECV_TIMEOUT, syncer.OPEN_TIMEOUT = saved
+        _tsdb.configure()  # uninstall: later tests ensure() their own
     return {
         "metric": "traffic_sim",
         "mode": "tier1" if tiny else "full",

@@ -1,0 +1,258 @@
+"""runtime/alerts.py: the declarative rule engine (r20).
+
+Fake clocks throughout: the pending→firing→resolved lifecycle, the
+Lifeguard-style for-duration widening, the drill mark, the firing side
+effects (flight incident + exemplar trace ids), rule parsing, and the
+digest wire form of the cluster merge.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from corrosion_tpu.runtime.alerts import (
+    DEFAULT_RULES,
+    AlertEngine,
+    AlertRule,
+)
+from corrosion_tpu.runtime.config import AlertsConfig
+from corrosion_tpu.runtime.digest import (
+    NodeDigest,
+    decode_digest,
+    encode_digest,
+)
+from corrosion_tpu.runtime.metrics import Registry
+from corrosion_tpu.runtime.tsdb import MetricsTSDB
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def mk_engine(cfg=None, rules=None, reg=None):
+    reg = reg or Registry()
+    clock = Clock()
+    db = MetricsTSDB(
+        registry=reg, sample_interval_secs=1.0, clock=clock, wall=clock
+    )
+    if cfg is None:
+        cfg = AlertsConfig()
+        if rules is not None:
+            cfg.default_pack = False
+            cfg.rules = rules
+    eng = AlertEngine(
+        tsdb=db, cfg=cfg, registry=reg, clock=clock, wall=clock
+    )
+    return reg, clock, db, eng
+
+
+RATE_RULE = {
+    "name": "faults", "kind": "rate",
+    "series": "x.errors.total",
+    "op": ">", "value": 0.5, "for_secs": 3.0, "window_secs": 5.0,
+    "severity": "page",
+}
+
+
+def drive(reg, clock, db, eng, ticks, inc=0.0):
+    """Advance tick-by-tick: optional counter increment, sample, eval."""
+    out = []
+    c = reg.counter("x.errors.total")
+    for _ in range(ticks):
+        if inc:
+            c.inc(inc)
+        db.sample_once()
+        out.append(eng.evaluate())
+        clock.t += 1.0
+    return out
+
+
+def test_lifecycle_pending_firing_resolved():
+    reg, clock, db, eng = mk_engine(rules=[RATE_RULE])
+    rounds = drive(reg, clock, db, eng, 2, inc=5.0)
+    # condition true but young: pending, not firing
+    assert eng.census()["pending"] == ["faults"]
+    assert not any(r["fired"] for r in rounds)
+    rounds = drive(reg, clock, db, eng, 4, inc=5.0)
+    assert any(r["fired"] == ["faults"] for r in rounds)
+    assert eng.census()["firing"] == ["faults"]
+    # stop the faults: the rate window drains, the alert resolves
+    rounds = drive(reg, clock, db, eng, 10, inc=0.0)
+    assert any(r["resolved"] == ["faults"] for r in rounds)
+    assert eng.census()["firing"] == []
+    hist = eng.report()["history"]
+    assert [h["event"] for h in hist] == ["fired", "resolved"]
+    assert hist[1]["duration_secs"] is not None
+    assert reg.counter("corro.alerts.fired.total", rule="faults").value == 1
+    assert (
+        reg.counter("corro.alerts.resolved.total", rule="faults").value == 1
+    )
+
+
+def test_for_duration_widens_when_node_is_sick():
+    """Lifeguard: the same fault pattern fires LATER on a node whose
+    own loop is lagging — it distrusts its timers, not its rules."""
+
+    def fire_tick(sick: bool) -> int:
+        reg, clock, db, eng = mk_engine(rules=[RATE_RULE])
+        if sick:
+            # loop lag at 4x the sick threshold -> +1 health point
+            reg.gauge("corro.runtime.loop.lag.max.seconds").set(1.0)
+        c = reg.counter("x.errors.total")
+        for i in range(20):
+            c.inc(5.0)
+            db.sample_once()
+            if eng.evaluate()["fired"]:
+                return i
+            clock.t += 1.0
+        return 99
+
+    healthy, sick = fire_tick(False), fire_tick(True)
+    assert healthy < sick < 99  # widened, NOT silenced
+
+
+def test_widening_caps_at_health_widen_max():
+    cfg = AlertsConfig(default_pack=False, rules=[RATE_RULE],
+                       health_widen_max=2.0)
+    reg, clock, db, eng = mk_engine(cfg=cfg)
+    reg.gauge("corro.runtime.loop.lag.max.seconds").set(100.0)
+    c = reg.counter("corro.store.write.errors.total", kind="busy")
+    db.sample_once()
+    c.inc(1000.0)
+    clock.t += 1.0
+    db.sample_once()
+    assert eng.health_score() > 1.0  # both components saturated
+    assert eng._widen() == 2.0
+
+
+def test_firing_attaches_drill_mark_traces_and_incident(tmp_path,
+                                                        monkeypatch):
+    from corrosion_tpu.chaos.faults import CENSUS
+    from corrosion_tpu.runtime import tracestore
+    from corrosion_tpu.runtime.records import FLIGHT
+
+    monkeypatch.setenv("CORRO_FLIGHT_DIR", str(tmp_path))
+    # the flight recorder needs at least one frame for a dump
+    FLIGHT.record_host_frame("test_alerts", {"x": 1})
+    st = tracestore.configure(
+        targets={}, lottery_n=1, auto_sweep=False
+    )
+    st.add_span({
+        "trace_id": "cafe1234aaaa", "span_id": "1", "parent_span_id": None,
+        "name": "write.local", "start_ns": 0, "end_ns": 5_000_000,
+        "attrs": {"stage": "write"},
+    })
+    st.sweep(now=1e9)  # close -> kept by the 1/1 lottery
+    reg, clock, db, eng = mk_engine(rules=[RATE_RULE])
+    CENSUS.begin("drill-scenario")
+    try:
+        drive(reg, clock, db, eng, 8, inc=5.0)
+    finally:
+        CENSUS.end()
+        tracestore.configure()
+    (active,) = eng.report()["active"]
+    assert active["state"] == "firing"
+    assert active["drill"] == "drill-scenario"
+    assert active["trace_ids"] == ["cafe1234aaaa"]
+    assert active["incident"] and "alert_faults" in active["incident"]
+
+
+def test_threshold_and_absent_kinds():
+    rules = [
+        {"name": "lag", "kind": "threshold",
+         "series": "x.level", "op": ">", "value": 0.5,
+         "for_secs": 0.0, "window_secs": 5.0, "agg": "max"},
+        {"name": "silent", "kind": "absent",
+         "series": "x.level", "for_secs": 0.0, "window_secs": 5.0},
+    ]
+    reg, clock, db, eng = mk_engine(rules=rules)
+    g = reg.gauge("x.level")
+    g.set(0.9)
+    db.sample_once()
+    # for_secs=0: pending and firing collapse into one evaluation
+    assert eng.evaluate()["fired"] == ["lag"]
+    clock.t += 1.0
+    # series vanishes: threshold resolves (no data), absent fires
+    clock.t += 50.0
+    r = eng.evaluate()
+    assert "lag" in r["resolved"]
+    assert "silent" in r["fired"]
+
+
+def test_default_pack_parses_and_operator_override_wins():
+    cfg = AlertsConfig(rules=[{
+        "name": "loop-lag", "kind": "threshold",
+        "series": "corro.runtime.loop.lag.max.seconds",
+        "op": ">", "value": 9.0, "for_secs": 1.0, "severity": "page",
+    }])
+    _reg, _clock, _db, eng = mk_engine(cfg=cfg)
+    names = [r.name for r in eng.rules]
+    assert len(names) == len(set(names)) == len(DEFAULT_RULES)
+    ll = next(r for r in eng.rules if r.name == "loop-lag")
+    assert ll.value == 9.0 and ll.severity == "page"
+
+
+def test_rule_validation_fails_fast():
+    with pytest.raises(ValueError):
+        AlertRule.from_dict({"name": "x", "kind": "nope", "series": "s"})
+    with pytest.raises(ValueError):
+        AlertRule.from_dict({"name": "x", "kind": "rate", "series": "s",
+                             "op": "~"})
+    with pytest.raises(ValueError):
+        AlertRule.from_dict({"name": "x", "kind": "rate", "series": "s",
+                             "severity": "meh"})
+    with pytest.raises(ValueError):
+        AlertRule.from_dict({"name": "x", "kind": "rate", "series": "s",
+                             "bogus_key": 1})
+    # for_scale scales both durations
+    r = AlertRule.from_dict(dict(RATE_RULE), for_scale=0.5)
+    assert r.for_secs == 1.5 and r.window_secs == 2.5
+
+
+def test_active_summaries_are_bounded_and_firing_first():
+    rules = [
+        {"name": f"r{i}", "kind": "threshold", "series": "x.level",
+         "op": ">", "value": 0.0, "for_secs": (0.0 if i % 2 else 99.0),
+         "window_secs": 5.0}
+        for i in range(6)
+    ]
+    reg, clock, db, eng = mk_engine(rules=rules)
+    reg.gauge("x.level").set(1.0)
+    db.sample_once()
+    eng.evaluate()
+    clock.t += 1.0
+    eng.evaluate()
+    rows = eng.active_summaries(cap=4)
+    assert len(rows) == 4
+    assert rows[0]["state"] == "firing"
+    states = [r["state"] for r in rows]
+    assert states == sorted(states, key=lambda s: s != "firing")
+
+
+def test_alert_summaries_ride_the_digest_wire():
+    alerts = [
+        {"rule": "store-faults", "severity": "page", "state": "firing",
+         "since": 123.25, "value": 7.5, "drill": True},
+        {"rule": "loop-lag", "severity": "warn", "state": "pending",
+         "since": 124.0, "value": 0.6, "drill": False},
+    ]
+    d = NodeDigest(
+        actor_id=b"\x07" * 16, seq=2, wall=200.0, view_hash=9,
+        view_size=3, heads_total=17, alerts=alerts,
+    )
+    d2 = decode_digest(encode_digest(d))
+    assert d2.heads_total == 17
+    assert d2.alerts == alerts
+    # pre-r20 bytes (no trailing alert block) decode to no alerts —
+    # the heads_total eof-tolerance pattern, one field further
+    d3 = NodeDigest(
+        actor_id=b"\x08" * 16, seq=1, wall=1.0, view_hash=1, view_size=1,
+        heads_total=5,
+    )
+    old_bytes = encode_digest(d3)[:-1]  # strip the alert-count uvarint
+    d4 = decode_digest(old_bytes)
+    assert d4.heads_total == 5 and d4.alerts == []

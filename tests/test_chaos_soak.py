@@ -104,10 +104,35 @@ async def run_soak(seed: int) -> dict:
                 count_rows(ag) == n_rows for ag in agents.values()
             )
 
+        def sync_diag() -> dict:
+            """Why is a node short?  Per-agent bookie state: heads by
+            origin, open gaps, incomplete partials — the difference
+            between 'lost and unnoticed' and 'known-missing but never
+            repaired' (r20: the rare in-suite phase-1 stall needs this
+            to be attributable post-hoc)."""
+            out = {}
+            for name, ag in agents.items():
+                rows = {}
+                for aid, booked in ag.bookie.items().items():
+                    with booked.read() as bv:
+                        rows[str(aid)[:8]] = {
+                            "head": bv.last() or 0,
+                            "needed": list(bv.needed)[:4],
+                            "partials": sum(
+                                1 for p in bv.partials.values()
+                                if not p.is_complete()
+                            ),
+                        }
+                out[name] = rows
+            return out
+
         assert await wait_progress(
             all_converged(want),
             lambda: tuple(count_rows(ag) for ag in agents.values()),
-        ), f"phase1 rows: {[count_rows(ag) for ag in agents.values()]}"
+        ), (
+            f"phase1 rows: {[count_rows(ag) for ag in agents.values()]}\n"
+            f"bookie: {sync_diag()}"
+        )
         summary["phases"].append({"phase": "concurrent-writers", "rows": want})
 
         # phase 2: partition d from everyone; write on both sides; heal;

@@ -171,6 +171,30 @@ def test_admin_over_cli(live_agent):
     assert r.returncode == 0, r.stderr
 
 
+def test_alerts_over_cli(live_agent):
+    """r20: `corrosion alerts` renders the live agent's rule-state
+    table (GET /v1/alerts), raw JSON with --json, and the any-node
+    cluster rollup with --cluster."""
+    cfg = live_agent["cfg"]
+    r = run_cli(["-c", cfg, "alerts"])
+    assert r.returncode == 0, r.stderr
+    assert "health score" in r.stdout
+    for rule in ("slo-burn", "loop-lag", "view-divergence",
+                 "store-faults"):
+        assert rule in r.stdout, r.stdout
+
+    r = run_cli(["-c", cfg, "alerts", "--json"])
+    assert r.returncode == 0, r.stderr
+    import json as _json
+
+    body = _json.loads(r.stdout)
+    assert body["enabled"] and len(body["rules"]) >= 7
+
+    r = run_cli(["-c", cfg, "alerts", "--cluster"])
+    assert r.returncode == 0, r.stderr
+    assert "cluster alerts" in r.stdout
+
+
 def test_snapshot_dump_then_install_roundtrip(tmp_path):
     """r17 catch-up plane parity with the backup/restore block:
     `snapshot dump` builds the compressed container, `snapshot install`

@@ -241,6 +241,115 @@ def test_flight_endpoint_serves_tick_resolved_frames():
     asyncio.run(main())
 
 
+def test_alerts_endpoint_local_and_cluster_scope():
+    """GET /v1/alerts (r20): the local rule-state view over a live
+    agent's engine — a synthetic store-fault burst walks the
+    store-faults rule through pending→firing and the endpoint reports
+    it (with /v1/status's census in agreement) — and ?scope=cluster
+    merges a REMOTE node's digest-carried alerts from the observatory
+    store."""
+    import aiohttp
+
+    from corrosion_tpu.runtime import tsdb as tsdb_mod
+    from corrosion_tpu.runtime.alerts import AlertEngine
+    from corrosion_tpu.runtime.config import AlertsConfig
+    from corrosion_tpu.runtime.digest import NodeDigest, encode_digest
+    from corrosion_tpu.runtime.metrics import METRICS
+
+    async def main():
+        net = MemNetwork(seed=47)
+        a, api, client = await boot_with_api(net, "agent-a")
+        # deterministic plumbing: hand the agent an engine over a
+        # hand-driven TSDB (agent setup's ensure() may have adopted an
+        # earlier test's sampler config — this test owns its own)
+        db = tsdb_mod.MetricsTSDB(
+            registry=METRICS, sample_interval_secs=0.01
+        )
+        # for_secs near-zero but WINDOWS wide: under full-suite load
+        # the gap between sample and evaluate can exceed a tiny
+        # scaled-down window, and an empty window reads as "no data"
+        cfg = AlertsConfig(for_scale=1.0)
+        cfg.rules = [{
+            "name": "store-faults", "kind": "rate",
+            "series": "corro.store.write.errors.total",
+            "op": ">", "value": 0.5, "for_secs": 0.0,
+            "window_secs": 30.0, "severity": "page",
+        }]
+        a.alerts = AlertEngine(tsdb=db, cfg=cfg, agent=a, registry=METRICS)
+        try:
+            async with aiohttp.ClientSession() as s:
+                r = await s.get(f"http://{api.addrs[0]}/v1/alerts")
+                assert r.status == 200
+                body = await r.json()
+            assert body["enabled"] and body["actor_id"] == str(a.actor_id)
+            rules = {x["rule"]: x for x in body["rules"]}
+            assert "store-faults" in rules and "slo-burn" in rules
+            assert all(x["state"] == "ok" for x in rules.values())
+
+            # synthetic sick disk: rate points for the store-faults rule
+            # (retry loop — on a loaded 1-core host a single
+            # sample/evaluate pair can straddle a deschedule)
+            c = METRICS.counter(
+                "corro.store.write.errors.total", kind="busy"
+            )
+            db.sample_once()
+            deadline = asyncio.get_event_loop().time() + 10.0
+            row = None
+            while asyncio.get_event_loop().time() < deadline:
+                await asyncio.sleep(0.02)
+                c.inc(50.0)
+                db.sample_once()
+                a.alerts.evaluate()
+                if "store-faults" in a.alerts.census()["firing"]:
+                    break
+            async with aiohttp.ClientSession() as s:
+                r = await s.get(
+                    f"http://{api.addrs[0]}/v1/alerts?history=0"
+                )
+                body = await r.json()
+            row = next(
+                x for x in body["rules"] if x["rule"] == "store-faults"
+            )
+            assert row["state"] == "firing"
+            assert "history" not in body
+            # /v1/status census agrees
+            async with aiohttp.ClientSession() as s:
+                r = await s.get(f"http://{api.addrs[0]}/v1/status")
+                status = await r.json()
+            assert "store-faults" in status["alerts"]["firing"]
+
+            # cluster scope: a remote node's digest carries ITS alerts
+            remote = NodeDigest(
+                actor_id=b"\x42" * 16, seq=1, wall=1e12, view_hash=1,
+                view_size=2,
+                alerts=[{
+                    "rule": "loop-lag", "severity": "warn",
+                    "state": "firing", "since": 1e12, "value": 0.9,
+                    "drill": False,
+                }],
+            )
+            assert a.observatory.receive(encode_digest(remote)) is not None
+            async with aiohttp.ClientSession() as s:
+                r = await s.get(
+                    f"http://{api.addrs[0]}/v1/alerts?scope=cluster"
+                )
+                cluster = await r.json()
+            assert cluster["scope"] == "cluster"
+            assert cluster["coverage"]["known"] >= 2
+            assert "loop-lag" in cluster["rollup"]
+            assert "store-faults" in cluster["rollup"]  # own digest rode
+            ll = cluster["rollup"]["loop-lag"]
+            assert ll["firing"] and not ll["drill"]
+        finally:
+            await client.close()
+            await api.stop()
+            from corrosion_tpu.agent.run import shutdown
+
+            await shutdown(a)
+
+    asyncio.run(main())
+
+
 def test_http_write_gossips_to_peer():
     async def main():
         net = MemNetwork(seed=37)

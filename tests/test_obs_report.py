@@ -69,3 +69,11 @@ def test_obs_report_renders_event_counters(tmp_path):
     assert re.search(r"^episode trend [▁▂▃▄▅▆▇█]+$", text, re.M)
     # both nodes' coverage rows rendered fresh
     assert len(re.findall(r"^\S+\s+True\s+\d+\s+", text, re.M)) == 2
+    # r20: the alerting plane renders the default rule pack's states
+    # over a live TSDB sample of this run's registry
+    assert "## alerting plane" in text
+    for rule in ("slo-burn", "loop-lag", "view-divergence", "store-faults"):
+        assert re.search(rf"^{rule}\s+\w+\s+\w+\s+", text, re.M), (
+            f"rule {rule} not rendered"
+        )
+    assert re.search(r"tsdb: \d+ series / \d+ points", text)

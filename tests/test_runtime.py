@@ -67,9 +67,52 @@ def test_metrics_label_values_escaped():
     # exactly one physical line for the sample (the \n stayed escaped)
     lines = [ln for ln in text.splitlines() if ln.startswith("x_count")]
     assert len(lines) == 1
-    # snapshot() returns the raw (unescaped) labels
-    (row,) = r.snapshot()
+    # snapshot() returns the raw (unescaped) labels (the registry's
+    # own corro.metrics.series gauge rides along since r20)
+    (row,) = [r_ for r_ in r.snapshot() if r_[0] == "counter"]
     assert row == ("counter", "x.count", {"table": 'we"ird\ntbl\\v'}, 1.0)
+
+
+def test_metrics_cardinality_guard():
+    """r20: a runaway label value must not grow the registry without
+    bound — per-name label sets cap at Registry.max_label_sets, excess
+    mints are refused TYPED (corro.metrics.cardinality.dropped.total)
+    and handed a shared detached instrument, and the registry's own
+    size rides corro.metrics.series."""
+    r = Registry()
+    r.max_label_sets = 16
+    insts = [r.counter("runaway.series", pk=str(i)) for i in range(30)]
+    # the first 16 label sets minted; the rest share ONE detached sink
+    minted = {id(c) for c in insts[:16]}
+    assert len(minted) == 16
+    assert len({id(c) for c in insts[16:]}) == 1
+    assert insts[16] not in insts[:16]
+    # drops are typed per kind
+    dropped = r.counter(
+        "corro.metrics.cardinality.dropped.total", kind="counter"
+    )
+    assert dropped.value == 14
+    # detached writes land nowhere visible: the exposition still holds
+    # exactly the admitted label sets
+    insts[20].inc(99)
+    rows = [
+        row for row in r.snapshot()
+        if row[1] == "runaway.series"
+    ]
+    assert len(rows) == 16
+    assert all(v == 0.0 for *_x, v in rows)
+    # the series gauge tracks the registry's true size (admitted series
+    # + the gauge itself + the drop counter)
+    g = r.gauge("corro.metrics.series")
+    with r._lock:
+        expect = r._series_total_locked()
+    assert g.value == expect
+    # other kinds cap independently of counters but share the name pool
+    hh = [r.histogram("runaway.hist", pk=str(i)) for i in range(20)]
+    assert len({id(h) for h in hh[:16]}) == 16
+    assert r.counter(
+        "corro.metrics.cardinality.dropped.total", kind="histogram"
+    ).value == 4
 
 
 def test_metrics_instruments_are_thread_safe():

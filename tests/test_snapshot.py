@@ -152,12 +152,15 @@ def test_digest_heads_total_rides_and_tolerates_eof():
     )
     enc = encode_digest(d)
     assert decode_digest(enc).heads_total == 12345
-    # a pre-r17 encoder never writes the trailing field: strip exactly
-    # the trailing uvarint(12345) and the decoder must default to 0
+    # a pre-r17 encoder never writes the trailing fields: strip exactly
+    # the trailing uvarint(12345) PLUS the r20 empty-alert-block count
+    # (uvarint(0), one byte) that now follows it, and the decoder must
+    # default both (heads_total=0, alerts=[])
     w = Writer()
     w.uvarint(12345)
-    old_bytes = enc[: -len(w.bytes())]
-    assert decode_digest(old_bytes).heads_total == 0
+    old_bytes = enc[: -(len(w.bytes()) + 1)]
+    old = decode_digest(old_bytes)
+    assert old.heads_total == 0 and old.alerts == []
 
 
 # -- build + install --------------------------------------------------------

@@ -10,7 +10,7 @@ Three layers, mirroring what the suite promises:
    `# corro: noqa[rule]` comment suppresses (proving the whole
    driver-side filter chain, not just the checker).
 3. THE FOLD IS LOSSLESS: the metrics lint folded into the framework
-   still reports the same 218 literal series + 2 wildcard sites in both
+   still reports the same 233 literal series + 2 wildcard sites in both
    directions, and the `scripts/lint_metrics.py` shim keeps its API.
 
 All pure-AST: no jax tracing, no sqlite, no network — the gate must
@@ -785,16 +785,18 @@ def test_timeout_discipline_real_tree_is_clean():
 
 
 def test_metrics_fold_reports_same_inventory():
-    """The lint_metrics fold is lossless: same 218 literal series (213
-    at r18 + the 5 r19 tail-sampler series — corro.trace.*), same 2
-    wildcard sites, both directions clean, via BOTH the framework
-    checker and the back-compat shim."""
+    """The lint_metrics fold is lossless: same 233 literal series (218
+    at r19 + the 15 r20 alerting-plane series — corro.tsdb.*,
+    corro.alerts.*, corro.metrics.{series,cardinality.dropped.total},
+    corro.store.write.errors.total), same 2 wildcard sites, both
+    directions clean, via BOTH the framework checker and the
+    back-compat shim."""
     import lint_metrics
 
     assert MetricsDocChecker().run(AnalysisContext(REPO)) == []
     assert lint_metrics.lint() == []
     literals, wildcards = lint_metrics.scan_call_sites()
-    assert len(literals) == 218
+    assert len(literals) == 233
     assert len(wildcards) == 2
     names = lint_metrics.parse_components_table()
     assert len(names) == len(set(names))

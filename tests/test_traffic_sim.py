@@ -145,6 +145,24 @@ def test_churn_storm_banks_catchup_census(by_id):
     assert cc["held_versions"] > 0
 
 
+def test_alert_proof_banked_for_fault_scenarios(by_id):
+    """r20: the drill-vs-outage proof — sick-disk's store-faults and
+    zombie-node's view-divergence alerts each reached FIRING while the
+    fault was injected (carrying the scenario as the drill mark, since
+    the chaos census was live) and RESOLVED after restore()."""
+    for sid, rule in (
+        ("sick-disk", "store-faults"),
+        ("zombie-node", "view-divergence"),
+    ):
+        al = by_id[sid].get("alerts")
+        assert al, f"{sid}: no alert observation banked"
+        assert al["expected"] == rule
+        assert al["raised"], f"{sid}: {rule} never fired: {al['during']}"
+        assert al["drill"] == sid, f"{sid}: drill mark {al['drill']!r}"
+        assert al["resolved"], f"{sid}: {rule} stuck firing: {al['after']}"
+        assert al["during"]["severity"] == "page"
+
+
 def test_injected_store_faults_surface_typed(by_id):
     """sick-disk: the injected SQLITE_BUSY/IO errors must appear as
     COUNTED typed refusals (the cluster answered; nothing hung)."""
@@ -177,4 +195,13 @@ def test_tier1_replica_serves_under_faults():
     # typed refusals are deterministic, not a rate coin-flip
     sick = next(s for s in record["scenarios"] if s["scenario"] == "sick-disk")
     assert sick["stages"]["write"]["refusals"] > 0
+    # r20: the injected store faults ALSO surfaced on the alerting
+    # plane — the store-faults rule fired drill-marked while the sick
+    # disk was live and resolved after restore (the same bar
+    # _assert_bars holds inside run_matrix; re-stated here as the
+    # replica's headline)
+    al = sick["alerts"]
+    assert al["expected"] == "store-faults"
+    assert al["raised"] and al["resolved"]
+    assert al["drill"] == "sick-disk"
     assert elapsed < 15.0, f"tiny replica took {elapsed:.1f}s (budget 10s)"
