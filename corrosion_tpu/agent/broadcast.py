@@ -100,10 +100,20 @@ async def broadcast_loop(agent: Agent) -> None:
             except ChannelClosed:
                 return
             batch.append(item)
-            cs = item.change.changeset
-            batch_bytes += sum(
-                c.estimated_byte_size() for c in getattr(cs, "changes", ())
-            )
+            # r21: a local commit (or decoded relay) arrives with its
+            # chunk body already stamped — the cutoff accounting reads
+            # ONE cached length instead of re-walking every change's
+            # field sizes; only a body-less changeset (hand-built in
+            # tests) still pays the per-change estimate
+            wb = item.change.wire_body
+            if wb is not None:
+                batch_bytes += len(wb)
+            else:
+                cs = item.change.changeset
+                batch_bytes += sum(
+                    c.estimated_byte_size()
+                    for c in getattr(cs, "changes", ())
+                )
 
         now = time.monotonic()
         for item in batch:

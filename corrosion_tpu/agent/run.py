@@ -18,6 +18,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import logging
+import os
 import random
 from collections import deque
 from dataclasses import dataclass
@@ -679,6 +680,17 @@ def _pending_row_bytes(r) -> int:
     )
 
 
+def _group_fanout_enabled(perf) -> bool:
+    """r21 per-group fanout gate: `[perf] group_fanout` config, with the
+    CORRO_GROUP_FANOUT env var overriding for bench A/B axes (mirrors
+    CORRO_CAPTURE / CORRO_FINALIZE — the pre rung runs the per-tx
+    post-commit path in the same process tree)."""
+    env = os.environ.get("CORRO_GROUP_FANOUT")
+    if env is not None:
+        return env.strip().lower() not in ("0", "false", "no", "off")
+    return getattr(perf, "group_fanout", True)
+
+
 @dataclass
 class _GroupItem:
     """One writer's slot in a commit group."""
@@ -692,6 +704,13 @@ class _GroupItem:
     db_version: int = 0
     last_seq: int = 0
     error: Optional[BaseException] = None
+    # r21 per-group fanout: the writer's trace context rides the item
+    # so the LEADER can run the whole batch's post-commit block
+    traceparent: Optional[str] = None
+    write_span: Optional[object] = None
+    # True once the leader's single fanout pass covered this tx's
+    # hooks+chunk+broadcast (the caller must then skip its own block)
+    fanned: bool = False
 
 
 class GroupCommitter:
@@ -718,8 +737,19 @@ class GroupCommitter:
         self.agent = agent
         self._pending: "deque[_GroupItem]" = deque()
         self._leader = False
+        # the in-flight r21 fanout task: at most ONE batch's post-commit
+        # fanout runs detached (overlapping the NEXT batch's commit
+        # thread); the leader awaits it before scheduling another, so a
+        # slow broadcast plane backpressures commits instead of piling
+        # unfinished fanouts
+        self._fanout_job: Optional[asyncio.Future] = None
 
-    async def submit(self, fn: Callable) -> _GroupItem:
+    async def submit(
+        self,
+        fn: Callable,
+        traceparent: Optional[str] = None,
+        write_span=None,
+    ) -> _GroupItem:
         """Enqueue one writer; returns its completed item (or raises its
         own sub-tx failure).  Runs on the agent's event loop.
 
@@ -736,6 +766,8 @@ class GroupCommitter:
             ts=self.agent.clock.new_timestamp(),
             fut=loop.create_future(),
             enq=_time.monotonic(),
+            traceparent=traceparent,
+            write_span=write_span,
         )
         self._pending.append(item)
         if not self._leader:
@@ -763,7 +795,20 @@ class GroupCommitter:
     async def _lead(self) -> None:
         agent = self.agent
         perf = agent.config.perf
+        amortized = _group_fanout_enabled(perf)
         while self._pending:
+            if amortized:
+                # one loop pass before gathering (r21, gated with the
+                # rest of the per-group amortization): writers settled
+                # by the previous batch have their wakeups queued
+                # BEHIND this coroutine (the new leader is simply
+                # whichever of them ran first), and without the yield
+                # the leader commits a batch of one while its
+                # just-woken peers re-enqueue a batch too late — steady
+                # state alternates full and size-1 batches.  An
+                # actually-solo writer pays one ready-queue pass (~µs),
+                # not a timed wait.
+                await asyncio.sleep(0)
             batch: List[_GroupItem] = []
             commit_job = None
             try:
@@ -788,11 +833,11 @@ class GroupCommitter:
                     await asyncio.shield(commit_job)
             except asyncio.CancelledError:
                 if commit_job is not None:
-                    # the thread finishes on its own; settle the batch
+                    # the thread finishes on its own; finish the batch
                     # from its outcome so no follower ever strands
                     commit_job.add_done_callback(
-                        lambda job, b=batch: self._settle(
-                            b, job.exception()
+                        lambda job, b=batch: asyncio.ensure_future(
+                            self._finish_batch(b, job.exception())
                         )
                     )
                 else:
@@ -805,7 +850,90 @@ class GroupCommitter:
                     batch = [self._pending.popleft()]
                 self._settle(batch, e)
                 continue
-            self._settle(batch, None)
+            await self._finish_batch(batch, None)
+
+    async def _finish_batch(
+        self, batch: List[_GroupItem], error: Optional[BaseException]
+    ) -> None:
+        """Post-commit half on the event loop: settle every writer's
+        future FIRST (marking committed items fanned, so their callers
+        return without any per-tx post-commit block), then run the
+        group's single fanout pass as a one-deep pipelined task — it
+        executes on the loop while the NEXT batch's commit occupies the
+        worker thread, preserving the thread/loop overlap the per-tx
+        path had (settling after an inline fanout serialized the two
+        and LOST throughput at w16)."""
+        committed: List[_GroupItem] = []
+        if error is None and _group_fanout_enabled(self.agent.config.perf):
+            committed = [
+                it for it in batch if it.error is None and it.changes
+            ]
+            for it in committed:
+                it.fanned = True
+        self._settle(batch, error)
+        if committed:
+            prev, self._fanout_job = self._fanout_job, None
+            if prev is not None:
+                await prev
+            self._fanout_job = asyncio.ensure_future(
+                self._fanout(committed)
+            )
+
+    async def _fanout(self, committed: List[_GroupItem]) -> None:
+        """ONE post-commit loop re-entry for the whole group (r21): a
+        single origin stamp, one amortized hooks flush, one chunk pass
+        over the wire cells finalize already stamped, and one channel
+        round — instead of every follower paying its own hooks + chunk
+        + per-chunk `tx_bcast.send` block after its future resolves
+        (~0.4 ms/tx of loop bookkeeping at w16 in the r15 profile).
+        Runs detached after the writers' futures settled: a failure
+        here (realistically ChannelClosed at shutdown) cannot reach the
+        callers — their commits stand — so it is logged, not raised."""
+        import time as _time
+
+        agent = self.agent
+        try:
+            from corrosion_tpu.runtime import tracestore
+            from corrosion_tpu.runtime.trace import make_meta
+
+            st = tracestore.store()
+            origin_wall = _time.time()
+            hook_batches: List[tuple] = []
+            inputs: List[BroadcastInput] = []
+            for it in committed:
+                trace_meta = None
+                if it.write_span is not None and st is not None:
+                    it.write_span.attrs["table"] = it.changes[0].table
+                    trace_meta = make_meta(
+                        forced=st.head_forced(it.write_span.ctx.trace_id)
+                    )
+                hook_batches.append(
+                    (it.changes, it.traceparent, trace_meta)
+                )
+                inputs.extend(
+                    BroadcastInput(change=cv, is_local=True)
+                    for cv in chunked_change_v1(
+                        agent.actor_id, it.db_version, it.changes,
+                        it.last_seq, it.ts, origin_ts=origin_wall,
+                        traceparent=it.traceparent, trace_meta=trace_meta,
+                    )
+                )
+            agent.notify_change_hooks_group(hook_batches, origin_wall)
+            await agent.tx_bcast.send_many(inputs)
+            METRICS.counter(
+                "corro.write.group.amortized.flush.total"
+            ).inc()
+            METRICS.counter("corro.write.group.amortized.txs.total").inc(
+                len(committed)
+            )
+        except asyncio.CancelledError:
+            raise
+        except BaseException:
+            log.warning(
+                "group fanout failed for %d committed tx(s); commits "
+                "stand, broadcast/hooks for the batch were lost",
+                len(committed), exc_info=True,
+            )
 
     def _settle(
         self, batch: List[_GroupItem], error: Optional[BaseException]
@@ -946,9 +1074,18 @@ async def _make_broadcastable_changes_inner(
 
     gc = agent.commit_group
     if gc is not None and agent.config.perf.group_commit:
-        item = await gc.submit(fn)
+        item = await gc.submit(fn, traceparent=traceparent,
+                               write_span=write_span)
         results, changes = item.results, item.changes
         db_version, last_seq, ts = item.db_version, item.last_seq, item.ts
+        if item.fanned:
+            # r21: the group leader's single fanout pass already ran
+            # this tx's hooks + chunk + broadcast block — return
+            # straight to the caller with zero per-tx loop work
+            rows = sum(r for r in _int_results(results))
+            return ExecResult(
+                rows_affected=rows, results=results, version=db_version
+            )
     else:
         # solo path (group commit disabled): per-writer gate + commit —
         # local client writes take the PRIORITY lane (agent.rs:586)

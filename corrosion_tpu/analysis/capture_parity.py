@@ -23,10 +23,19 @@ Mechanics (pure AST, no imports of the checked modules):
   builder; the insert/update builders must reference the same
   `non_pk_cols` column source the DDL iterates; `DELETE_MARKER` must be
   the `SENTINEL + "X"` expression matching the DDL marker.
+- FINALIZE SIDE (r21): the columnar phase B is a THIRD consumer of the
+  same conventions — `_dedupe_pending` must still recognize the
+  `SENTINEL + "X"` marker the DDL emits (it is how captured deletes
+  reach the finalize at all), and `_phase_b_columnar` must reference
+  `SENTINEL` (the sentinel-kind decision batch) and the
+  `write_change_cells` batch encoder so the columnar builders cannot
+  drift away from the trigger/capture cell conventions unnoticed.
 
-Findings anchor on the capture module (CAPTURED_KINDS / DELETE_MARKER /
-the drifting `_cells_*` def), where a `# corro: noqa[capture-parity]`
-belongs next to the contract being waived.
+Findings anchor on the module owning the drifted contract — the capture
+module (CAPTURED_KINDS / DELETE_MARKER / the drifting `_cells_*` def)
+or the store module (`_dedupe_pending` / `_phase_b_columnar`) — where a
+`# corro: noqa[capture-parity]` belongs next to the contract being
+waived.
 """
 
 from __future__ import annotations
@@ -241,5 +250,72 @@ class CaptureParityChecker(Checker):
                     "trigger DDL emits the '{SENTINEL}X' row-delete "
                     "marker — deletes would fork between the paths",
                     "delete-marker-drift",
+                )
+
+        # -- finalize side (r21 columnar phase B lockstep) ------------------
+        def crdt_finding(line, symbol, message, snippet):
+            findings.append(
+                Finding(
+                    rule=self.rule, path=self.crdt, line=line,
+                    symbol=symbol, message=message, snippet=snippet,
+                )
+            )
+
+        def _has_marker_binop(fn) -> bool:
+            return any(
+                isinstance(n, ast.BinOp)
+                and isinstance(n.op, ast.Add)
+                and isinstance(n.left, ast.Name)
+                and n.left.id == "SENTINEL"
+                and isinstance(n.right, ast.Constant)
+                and n.right.value == "X"
+                for n in ast.walk(fn)
+            )
+
+        if ddl_marker:
+            dedupe = _find_function(crdt_sf.tree, "_dedupe_pending")
+            if dedupe is not None and not _has_marker_binop(dedupe):
+                crdt_finding(
+                    dedupe.lineno, "_dedupe_pending",
+                    "`_dedupe_pending` no longer recognizes the "
+                    "`SENTINEL + \"X\"` marker the trigger DDL emits — "
+                    "captured row deletes would never reach finalize",
+                    "finalize-marker-drift",
+                )
+
+        engine_fn = _find_function(crdt_sf.tree, "_finalize_engine")
+        columnar = _find_function(crdt_sf.tree, "_phase_b_columnar")
+        declares_columnar = engine_fn is not None and "columnar" in set(
+            _string_constants(engine_fn)
+        )
+        if declares_columnar and columnar is None:
+            crdt_finding(
+                engine_fn.lineno, "_finalize_engine",
+                "`_finalize_engine` accepts 'columnar' but no "
+                "`_phase_b_columnar` builder exists — the default "
+                "finalize engine would be undefined",
+                "missing-columnar-builder",
+            )
+        if columnar is not None:
+            names = {
+                n.id for n in ast.walk(columnar)
+                if isinstance(n, ast.Name)
+            }
+            if "SENTINEL" not in names:
+                crdt_finding(
+                    columnar.lineno, "_phase_b_columnar",
+                    "`_phase_b_columnar` never references SENTINEL — "
+                    "the sentinel-kind decision batch has drifted away "
+                    "from the trigger/capture row-lifecycle convention",
+                    "columnar-sentinel-drift",
+                )
+            if "write_change_cells" not in names:
+                crdt_finding(
+                    columnar.lineno, "_phase_b_columnar",
+                    "`_phase_b_columnar` does not encode through "
+                    "`write_change_cells` — cell bytes would fork from "
+                    "the `write_change_fields` single-cell truth the "
+                    "equivalence pins assume",
+                    "columnar-encoder-drift",
                 )
         return findings

@@ -41,6 +41,35 @@ class Sender(Generic[T]):
             "corro.channel.message.send.delay.seconds", channel=self._ch.name
         ).observe(time.monotonic() - start)
 
+    async def send_many(self, items) -> None:
+        """Enqueue a whole batch with ONE metrics round (r21 group
+        fanout): the sent counter bumps by the batch size and the
+        depth/delay series are touched once, instead of a counter inc +
+        gauge set + histogram observe per item.  Queue puts still
+        happen item-by-item so a bounded channel's backpressure keeps
+        its per-item semantics."""
+        items = list(items)
+        if not items:
+            return
+        if self._ch.closed:
+            METRICS.counter(
+                "corro.channel.message.send.failed", channel=self._ch.name
+            ).inc(len(items))
+            raise ChannelClosed(self._ch.name)
+        start = time.monotonic()
+        put = self._ch.queue.put
+        for item in items:
+            await put(item)
+        METRICS.counter(
+            "corro.channel.message.sent", channel=self._ch.name
+        ).inc(len(items))
+        METRICS.gauge(
+            "corro.channel.queue.depth", channel=self._ch.name
+        ).set(self._ch.queue.qsize())
+        METRICS.histogram(
+            "corro.channel.message.send.delay.seconds", channel=self._ch.name
+        ).observe(time.monotonic() - start)
+
     def try_send(self, item: T) -> bool:
         try:
             self._ch.queue.put_nowait(item)

@@ -107,6 +107,16 @@ class PerfConfig:
     group_commit_wait: float = 0.0
     group_commit_max_writers: int = 64
     group_commit_max_bytes: int = 1 << 20
+    # per-group fanout (r21): the group leader runs ONE post-commit
+    # loop re-entry for the whole batch — one origin stamp, one hooks
+    # flush, one chunk pass over the stamped wire cells, one channel
+    # round — instead of each follower paying its own hooks+chunk+send
+    # block after its future resolves — plus the leader's pre-gather
+    # loop yield that lets just-settled writers join the next batch
+    # (full occupancy instead of alternating full/size-1 batches).
+    # false (or env CORRO_GROUP_FANOUT=0) restores the r15 per-tx
+    # post-commit path and gathering behavior.
+    group_fanout: bool = True
     # direct change capture (r15): WriteTx parses recognized INSERT/
     # UPDATE/DELETE statement shapes and records the written cells in
     # memory, bypassing the AFTER-trigger → __crdt_pending round-trip

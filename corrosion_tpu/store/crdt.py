@@ -46,7 +46,11 @@ from corrosion_tpu.store.bookkeeping import (
 )
 from corrosion_tpu.store import capture as _capture
 from corrosion_tpu.store.schema import Schema, SchemaError, diff_schemas, parse_sql
-from corrosion_tpu.types.codec import Writer, write_change_fields
+from corrosion_tpu.types.codec import (
+    Writer,
+    write_change_cells,
+    write_change_fields,
+)
 from corrosion_tpu.types.actor import ActorId
 from corrosion_tpu.types.base import Timestamp
 from corrosion_tpu.types.change import Change, SENTINEL
@@ -176,20 +180,23 @@ def _native_batch_enabled() -> bool:
 
 
 def _finalize_engine() -> str:
-    """Engine for `WriteTx._finalize_pending` (the local-commit clock
-    bookkeeping).  "vector" (default, r14): bulk-probe current cl/clock
-    state for every pending pk with chunked IN(...) reads, run the
-    dedupe/sentinel/col_version decisions as pure in-memory passes, and
-    flush with a handful of prepared executemany statements — the
-    `_apply_batch` shape applied to the write side.  "percell": the
-    per-cell reference loop (one SELECT+upsert round-trip per pending
-    cell), kept as the semantic reference for the randomized
-    equivalence pin (tests/test_finalize_batch.py) and the ingest
-    bench's pre mode."""
-    eng = os.environ.get("CORRO_FINALIZE", "vector")
-    if eng not in ("vector", "percell"):
+    """Engine for the local-commit clock bookkeeping
+    (`WriteTx._finalize_pending` / `CrdtStore.finalize_group`).
+    "columnar" (default, r21): the r14 bulk IN(...) probes and grouped
+    executemany flush, with the phase-B decisions computed over
+    per-kind arrays and EVERY cell's wire bytes built in one batched
+    encode pass (`types/codec.py write_change_cells`) instead of a
+    per-cell emit/encode loop.  "vector" (r14): same probes and flush,
+    per-cell in-memory emit loop — the pre-r21 path, kept bit-for-bit
+    as the ingest bench's r21 pre mode.  "percell": the per-cell
+    reference loop (one SELECT+upsert round-trip per pending cell),
+    the semantic reference for the randomized equivalence pin
+    (tests/test_finalize_batch.py)."""
+    eng = os.environ.get("CORRO_FINALIZE", "columnar")
+    if eng not in ("columnar", "vector", "percell"):
         raise ValueError(
-            f"unknown CORRO_FINALIZE {eng!r} (expected 'vector' or 'percell')"
+            f"unknown CORRO_FINALIZE {eng!r} "
+            "(expected 'columnar', 'vector' or 'percell')"
         )
     return eng
 
@@ -313,6 +320,11 @@ def _clock_entry(ch: Change, col_version: int) -> tuple:
     """One `__crsql_clock`-equivalent row plan: (col_version, db_version,
     seq, site_id, ts)."""
     return (col_version, ch.db_version, ch.seq, ch.site_id, ch.ts.ntp64)
+
+
+# shared read-only default for the columnar phase B's batched
+# col_version reads (never mutated — writes go through setdefault)
+_EMPTY_CV: Dict[str, int] = {}
 
 
 def _encode_value(v: SqliteValue, i: int, types, ints, reals, offs, lens,
@@ -1063,6 +1075,258 @@ class CrdtStore:
         start_dv = self.db_version_for(site)
         next_dv = start_dv + 1
 
+        if _finalize_engine() == "columnar":
+            next_dv = self._phase_b_columnar(
+                deduped, items, cur_cl, cv_state, rows_up, clock_clear,
+                clock_put, out, next_dv,
+            )
+        else:
+            next_dv = self._phase_b_percell_emit(
+                deduped, items, cur_cl, cv_state, rows_up, clock_clear,
+                clock_put, out, next_dv,
+            )
+
+        # -- phase C: ONE bulk flush for the whole batch -------------------
+        for tbl in {
+            t for d in (rows_up, clock_clear, clock_put) for t in d
+        }:
+            rt, ct = _rows_table(tbl), _clock_table(tbl)
+            if rows_up.get(tbl):
+                conn.executemany(
+                    f'INSERT INTO "{rt}" (pk, cl) VALUES (?, ?)'
+                    " ON CONFLICT (pk) DO UPDATE SET cl = excluded.cl",
+                    list(rows_up[tbl].items()),
+                )
+            if clock_clear.get(tbl):
+                conn.executemany(
+                    f'DELETE FROM "{ct}" WHERE pk = ? AND cid != ?',
+                    [(pk, SENTINEL) for pk in clock_clear[tbl]],
+                )
+            if clock_put.get(tbl):
+                conn.executemany(
+                    f'INSERT INTO "{ct}" (pk, cid, col_version, db_version,'
+                    " seq, site_id, ts) VALUES (?,?,?,?,?,?,?)"
+                    " ON CONFLICT (pk, cid) DO UPDATE SET"
+                    " col_version = excluded.col_version,"
+                    " db_version = excluded.db_version,"
+                    " seq = excluded.seq, site_id = excluded.site_id,"
+                    " ts = excluded.ts",
+                    [
+                        (pk, cid, cv, dbv, sq, st, ts)
+                        for pk, entries in clock_put[tbl].items()
+                        for cid, (cv, dbv, sq, st, ts) in entries.items()
+                    ],
+                )
+
+        if next_dv > start_dv + 1:
+            self._bump_db_version(site, next_dv - 1)
+        results: List[Tuple[List[Change], int, int]] = []
+        for changes in out:
+            if changes:
+                dv = changes[0].db_version
+                last_seq = changes[-1].seq
+                self.record_last_seq(site, dv, last_seq)
+                results.append((changes, dv, last_seq))
+            else:
+                results.append(([], 0, 0))
+        return results
+
+    def _phase_b_columnar(
+        self, deduped, items, cur_cl, cv_state, rows_up, clock_clear,
+        clock_put, out, next_dv,
+    ) -> int:
+        """Columnar finalize phase B (r21): decisions per (table × kind)
+        batch, encode in ONE pass.
+
+        The r14/r15 loop paid a Writer allocation, a 4-call field encode
+        and a frozen-dataclass construction PER CELL inside the decision
+        walk (~180 µs of a 10-row commit).  Here each item's decisions
+        run over per-kind arrays — delete-kind causal lengths in one
+        comprehension over the deleted-row array, sentinel
+        creation/resurrection decisions as their own pass, column-kind
+        cl/col_version reads as array comprehensions over the deduped
+        keys (unique per item, so the batched reads see exactly the
+        sequential state) — producing compact spec tuples; then the
+        WHOLE GROUP's wire cells are built by one `write_change_cells`
+        batch-encode call and the Change objects materialize in a tight
+        zip loop.  Emission order, seq numbering, clock rows and cell
+        bytes are pinned identical to `_finalize_pending_percell` /
+        CORRO_FINALIZE=vector by tests/test_finalize_batch.py.
+
+        Kind-splitting is only equivalent while every SENTINEL precedes
+        its own row's column cells in `order` (true for everything the
+        capture planes emit: insert-like statements log sentinel-first,
+        updates log no sentinel); a violating item falls back to the
+        in-order sequential walk so correctness never rides on the
+        capture convention."""
+        site = self.site_id
+        site_bytes = site.bytes16
+        all_specs: List[tuple] = []
+        item_slices: List[tuple] = []  # (start, end, ts)
+        for (cells, order, deleted_rows), (_pending, ts) in zip(
+            deduped, items
+        ):
+            db_version = next_dv
+            ts_ntp = ts.ntp64
+            specs: List[tuple] = []
+            add = specs.append
+
+            def clear_clocks(tbl, pk):
+                clock_clear.setdefault(tbl, {})[pk] = None
+                cv_state.pop((tbl, pk), None)
+                puts = clock_put.get(tbl, {}).get(pk)
+                if puts:
+                    for c in [c for c in puts if c != SENTINEL]:
+                        del puts[c]
+
+            # delete kind: bumped-even causal lengths over the whole
+            # deleted-row array in one pass
+            if deleted_rows:
+                dr = list(deleted_rows)
+                del_cls = [cur_cl.get(k, 1) + 1 for k in dr]
+                del_cls = [c + (c & 1) for c in del_cls]
+                for (tbl, pk), cl in zip(dr, del_cls):
+                    cur_cl[(tbl, pk)] = cl
+                    rows_up.setdefault(tbl, {})[pk] = cl
+                    clear_clocks(tbl, pk)
+                    seq = len(specs)
+                    add((tbl, pk, SENTINEL, None, cl, db_version, seq, cl))
+                    clock_put.setdefault(tbl, {}).setdefault(pk, {})[
+                        SENTINEL
+                    ] = (cl, db_version, seq, site_bytes, ts_ntp)
+
+            hazard = False
+            col_rows: set = set()
+            for tbl, pk, cid in order:
+                if cid == SENTINEL:
+                    if (tbl, pk) in col_rows:
+                        hazard = True
+                        break
+                else:
+                    col_rows.add((tbl, pk))
+
+            if not hazard:
+                slots: List[Optional[tuple]] = [None] * len(order)
+                # sentinel kind: creation/resurrection over its array
+                for i, (tbl, pk, cid) in enumerate(order):
+                    if cid != SENTINEL:
+                        continue
+                    k2 = (tbl, pk)
+                    exists = k2 in cur_cl
+                    prev_cl = cur_cl.get(k2, 0)
+                    cl = prev_cl + 1 if prev_cl % 2 == 0 else prev_cl
+                    if not exists or prev_cl % 2 == 0:
+                        cur_cl[k2] = cl
+                        rows_up.setdefault(tbl, {})[pk] = cl
+                        if prev_cl % 2 == 0 and prev_cl > 0:
+                            clear_clocks(tbl, pk)
+                        slots[i] = (tbl, pk, SENTINEL, None, cl, cl)
+                # column kind: cl / col_version reads as one array
+                # comprehension each over the (unique) deduped keys
+                col_idx = [
+                    i for i, key in enumerate(order) if key[2] != SENTINEL
+                ]
+                cl_get = cur_cl.get
+                cv_get = cv_state.get
+                col_cls = [
+                    cl_get((order[i][0], order[i][1]), 1) for i in col_idx
+                ]
+                col_cvs = [
+                    cv_get((order[i][0], order[i][1]), _EMPTY_CV).get(
+                        order[i][2], 0
+                    )
+                    + 1
+                    for i in col_idx
+                ]
+                for i, cl, cv in zip(col_idx, col_cls, col_cvs):
+                    key = order[i]
+                    tbl, pk, cid = key
+                    cv_state.setdefault((tbl, pk), {})[cid] = cv
+                    slots[i] = (tbl, pk, cid, cells[key], cv, cl)
+                # compact in emission order; clock rows keyed off the
+                # final seqs (put order within an item is upsert-keyed,
+                # so deferring past the decisions is state-identical)
+                for sl in slots:
+                    if sl is None:
+                        continue
+                    tbl, pk, cid, val, cv, cl = sl
+                    seq = len(specs)
+                    add((tbl, pk, cid, val, cv, db_version, seq, cl))
+                    clock_put.setdefault(tbl, {}).setdefault(pk, {})[
+                        cid
+                    ] = (cv, db_version, seq, site_bytes, ts_ntp)
+            else:
+                # in-order sequential fallback: same arithmetic with
+                # immediate effects (a later sentinel may clear this
+                # item's own earlier column puts here)
+                for key in order:
+                    tbl, pk, cid = key
+                    k2 = (tbl, pk)
+                    if cid == SENTINEL:
+                        exists = k2 in cur_cl
+                        prev_cl = cur_cl.get(k2, 0)
+                        cl = prev_cl + 1 if prev_cl % 2 == 0 else prev_cl
+                        if not exists or prev_cl % 2 == 0:
+                            cur_cl[k2] = cl
+                            rows_up.setdefault(tbl, {})[pk] = cl
+                            if prev_cl % 2 == 0 and prev_cl > 0:
+                                clear_clocks(tbl, pk)
+                            seq = len(specs)
+                            add((
+                                tbl, pk, SENTINEL, None, cl, db_version,
+                                seq, cl,
+                            ))
+                            clock_put.setdefault(tbl, {}).setdefault(
+                                pk, {}
+                            )[SENTINEL] = (
+                                cl, db_version, seq, site_bytes, ts_ntp,
+                            )
+                        continue
+                    cl = cur_cl.get(k2, 1)
+                    cv = cv_state.get(k2, {}).get(cid, 0) + 1
+                    cv_state.setdefault(k2, {})[cid] = cv
+                    seq = len(specs)
+                    add((tbl, pk, cid, cells[key], cv, db_version, seq, cl))
+                    clock_put.setdefault(tbl, {}).setdefault(pk, {})[
+                        cid
+                    ] = (cv, db_version, seq, site_bytes, ts_ntp)
+
+            if specs:
+                next_dv += 1
+            item_slices.append((len(all_specs), len(all_specs) + len(specs), ts))
+            all_specs.extend(specs)
+
+        # ONE vectorized pack pass for every cell in the group
+        blobs = write_change_cells(all_specs, site_bytes)
+        if all_specs:
+            from corrosion_tpu.runtime.metrics import METRICS
+
+            METRICS.counter("corro.write.finalize.columnar.total").inc(
+                len(all_specs)
+            )
+        new_change = Change.__new__
+        for a, b, ts in item_slices:
+            changes: List[Change] = []
+            for spec, cell in zip(all_specs[a:b], blobs[a:b]):
+                tbl, pk, cid, val, cv, dbv, seq, cl = spec
+                ch = new_change(Change)
+                ch.__dict__.update(
+                    table=tbl, pk=pk, cid=cid, val=val, col_version=cv,
+                    db_version=dbv, seq=seq, site_id=site_bytes, cl=cl,
+                    ts=ts, wire_cell=cell,
+                )
+                changes.append(ch)
+            out.append(changes)
+        return next_dv
+
+    def _phase_b_percell_emit(
+        self, deduped, items, cur_cl, cv_state, rows_up, clock_clear,
+        clock_put, out, next_dv,
+    ) -> int:
+        """The r14/r15 per-cell emit loop, kept bit-for-bit as the
+        CORRO_FINALIZE=vector engine (the columnar phase B's A/B
+        baseline and second semantic witness)."""
+        site = self.site_id
         site_bytes = site.bytes16
         new_change = Change.__new__
         for (cells, order, deleted_rows), (_pending, ts) in zip(
@@ -1147,51 +1411,7 @@ class CrdtStore:
             if changes:
                 next_dv += 1
             out.append(changes)
-
-        # -- phase C: ONE bulk flush for the whole batch -------------------
-        for tbl in {
-            t for d in (rows_up, clock_clear, clock_put) for t in d
-        }:
-            rt, ct = _rows_table(tbl), _clock_table(tbl)
-            if rows_up.get(tbl):
-                conn.executemany(
-                    f'INSERT INTO "{rt}" (pk, cl) VALUES (?, ?)'
-                    " ON CONFLICT (pk) DO UPDATE SET cl = excluded.cl",
-                    list(rows_up[tbl].items()),
-                )
-            if clock_clear.get(tbl):
-                conn.executemany(
-                    f'DELETE FROM "{ct}" WHERE pk = ? AND cid != ?',
-                    [(pk, SENTINEL) for pk in clock_clear[tbl]],
-                )
-            if clock_put.get(tbl):
-                conn.executemany(
-                    f'INSERT INTO "{ct}" (pk, cid, col_version, db_version,'
-                    " seq, site_id, ts) VALUES (?,?,?,?,?,?,?)"
-                    " ON CONFLICT (pk, cid) DO UPDATE SET"
-                    " col_version = excluded.col_version,"
-                    " db_version = excluded.db_version,"
-                    " seq = excluded.seq, site_id = excluded.site_id,"
-                    " ts = excluded.ts",
-                    [
-                        (pk, cid, cv, dbv, sq, st, ts)
-                        for pk, entries in clock_put[tbl].items()
-                        for cid, (cv, dbv, sq, st, ts) in entries.items()
-                    ],
-                )
-
-        if next_dv > start_dv + 1:
-            self._bump_db_version(site, next_dv - 1)
-        results: List[Tuple[List[Change], int, int]] = []
-        for changes in out:
-            if changes:
-                dv = changes[0].db_version
-                last_seq = changes[-1].seq
-                self.record_last_seq(site, dv, last_seq)
-                results.append((changes, dv, last_seq))
-            else:
-                results.append(([], 0, 0))
-        return results
+        return next_dv
 
     @contextlib.contextmanager
     def group_tx(self):

@@ -228,6 +228,53 @@ def write_change_fields(
     buf += struct.pack("<q", cl)
 
 
+_LEN32 = struct.Struct("<I")
+# the whole fixed-width cell suffix in one pack: col_version/db_version/
+# seq (<qQQ, _CHANGE_TAIL) + 16 raw site-id bytes + <q cl — "<" packing
+# has no alignment, so the bytes equal _CHANGE_TAIL.pack + site + pack
+_CELL_SUFFIX = struct.Struct("<qQQ16sq")
+
+
+def write_change_cells(cells, site_id: bytes) -> List[bytes]:
+    """Batch form of `write_change_fields` (r21 columnar finalize
+    phase B): encode MANY change cells in one pass over a shared
+    buffer — length-prefixed table/cid headers are interned once per
+    distinct string (a 10-row commit repeats each cid 10 times), the
+    fixed-width tail is a single struct pack per cell, and the per-cell
+    Writer allocation + 4-call encode sequence of the per-cell path
+    disappears.  ``cells`` yields
+    ``(table, pk, cid, val, col_version, db_version, seq, cl)`` tuples
+    sharing one ``site_id``; returns the per-cell wire bytes in order,
+    byte-identical to `write_change_fields` (pinned in test_codec.py
+    and by the finalize equivalence suite)."""
+    w = Writer()
+    buf = w.buf
+    pack_len = _LEN32.pack
+    pack_suffix = _CELL_SUFFIX.pack
+    # tables and cids share the cache: both encode as u32 len + utf-8
+    hdrs: Dict[str, bytes] = {}
+    bounds = [0]
+    mark = bounds.append
+    for table, pk, cid, val, col_version, db_version, seq, cl in cells:
+        h = hdrs.get(table)
+        if h is None:
+            raw = table.encode("utf-8")
+            hdrs[table] = h = pack_len(len(raw)) + raw
+        buf += h
+        buf += pack_len(len(pk))
+        buf += pk
+        h = hdrs.get(cid)
+        if h is None:
+            raw = cid.encode("utf-8")
+            hdrs[cid] = h = pack_len(len(raw)) + raw
+        buf += h
+        write_value(w, val)
+        buf += pack_suffix(col_version, db_version, seq, site_id, cl)
+        mark(len(buf))
+    mv = memoryview(buf)
+    return [bytes(mv[a:b]) for a, b in zip(bounds, bounds[1:])]
+
+
 def write_change(w: Writer, c: Change) -> None:
     # hot path (every broadcast/sync encode walks one of these per cell
     # when no wire_body is cached): a change carrying its r15 cached

@@ -20,16 +20,22 @@ transactions + vectorized `_finalize_pending` + encode-once broadcast):
                     against `GET /v1/slo`.
 
 `--ab` measures pre AND post in one run; nothing leaks into
-`os.environ` afterwards (scoped_env).  Since r15 the A/B axis is the
-CHANGE-CAPTURE engine: pre = `CORRO_CAPTURE=trigger` (the AFTER-trigger
-→ `__crdt_pending` round-trip, the r14 path) vs post = direct in-memory
-capture (store/capture.py), with group commit / vectorized finalize /
-encode-once identical on both sides.  Run with `--tag r15` so the new
-rungs land NEXT TO the banked r14 records (`ingest-local-*-{pre,post}`)
-instead of overwriting them — tests/test_ingest_bench.py compares the
-r15 post both against its own pre and against the banked r14 post.
-Records merge by rung into INGEST_BENCH.json, `code_sha`-stamped over
-the measured write-path files (bench.py replay-gate discipline).
+`os.environ` afterwards (scoped_env).  The A/B axis is TAG-AWARE:
+- `--tag r15` (and the untagged r14 rungs): the CHANGE-CAPTURE engine —
+  pre = `CORRO_CAPTURE=trigger` (the AFTER-trigger → `__crdt_pending`
+  round-trip) vs post = direct in-memory capture (store/capture.py),
+  with group commit / vectorized finalize / encode-once identical.
+- `--tag r21*`: the write-path round-3 pair — pre =
+  `CORRO_FINALIZE=vector` (the r14/r15 per-cell emit loop, kept
+  bit-for-bit) + `CORRO_GROUP_FANOUT=0` (per-tx post-commit
+  hooks/chunk/send) vs post = columnar finalize phase B + per-group
+  fanout, with capture / group commit / encode-once identical.
+Tagged rungs land NEXT TO the banked earlier records
+(`ingest-local-*-{pre,post}[-tag]`) instead of overwriting them —
+tests/test_ingest_bench.py compares each round's post both against its
+own pre and against the banked prior-round post.  Records merge by
+rung into INGEST_BENCH.json, `code_sha`-stamped over the measured
+write-path files (bench.py replay-gate discipline).
 
 Usage:
   python scripts/bench_ingest.py [--mode pre|post|ab] [--tag T]
@@ -65,7 +71,9 @@ _MEASURED_FILES = (
     "corrosion_tpu/store/crdt.py",
     "corrosion_tpu/store/capture.py",
     "corrosion_tpu/agent/run.py",
+    "corrosion_tpu/agent/handle.py",
     "corrosion_tpu/agent/broadcast.py",
+    "corrosion_tpu/runtime/channels.py",
     "corrosion_tpu/types/codec.py",
     "scripts/bench_ingest.py",
 )
@@ -111,11 +119,20 @@ def scoped_env(**kv):
                 os.environ[k] = v
 
 
-def _pre_env(mode: str) -> dict:
+def _pre_env(mode: str, tag: str = "") -> dict:
+    if mode != "pre":
+        return {}
+    if tag.startswith("r21"):
+        # r21 A/B: pre restores the per-cell emit-loop finalize (the
+        # r14/r15 "vector" engine, kept bit-for-bit) AND the per-tx
+        # post-commit hooks/chunk/send path, so the delta isolates
+        # columnar phase B + per-group fanout; capture, group commit
+        # and encode-once are identical on both sides
+        return {"CORRO_FINALIZE": "vector", "CORRO_GROUP_FANOUT": "0"}
     # r15 A/B: pre restores the trigger/__crdt_pending capture path
     # (everything else — group commit, vectorized finalize, encode-once
     # — identical), so the delta isolates direct capture itself
-    return {"CORRO_CAPTURE": "trigger"} if mode == "pre" else {}
+    return {"CORRO_CAPTURE": "trigger"}
 
 
 def _record(rung: str, mode: str, tag: str, **fields) -> dict:
@@ -345,8 +362,8 @@ async def _e2e(mode: str, tag: str) -> dict:
 # -- driver ----------------------------------------------------------------
 
 
-def _mode_env(mode: str):
-    env = _pre_env(mode)
+def _mode_env(mode: str, tag: str = ""):
+    env = _pre_env(mode, tag)
     return scoped_env(**env) if env else contextlib.nullcontext()
 
 
@@ -354,7 +371,7 @@ def run_mode(mode: str, tag: str) -> list:
     import tempfile
 
     recs = []
-    with _mode_env(mode):
+    with _mode_env(mode, tag):
         for n in (1, 4, 16):
             recs.append(asyncio.run(_local_write(n, mode, tag)))
         for n in (1, 4, 16):
@@ -372,37 +389,54 @@ def run_mode(mode: str, tag: str) -> list:
     return recs
 
 
+AB_REPS = 3
+
+
 def run_ab(tag: str) -> list:
-    """A/B with pre and post ADJACENT per rung: the 1-core bench host's
-    throughput drifts over a multi-minute run, and the old
-    all-pre-then-all-post order systematically biased whichever half
-    ran second."""
+    """A/B with pre and post INTERLEAVED per rung, banking the median
+    of `AB_REPS` repetitions per mode: the 1-core bench host's
+    throughput drifts ±30% over a multi-minute run, so a single
+    adjacent pre/post pair still hands whichever side lands on a slow
+    minute a phantom (de)regression — r21's re-bank showed the same
+    build measuring 0.78x and 1.31x at w16 minutes apart.  Repetitions
+    alternate pre,post,pre,post so both modes sample the same drift,
+    and the banked record is the median by throughput (by write→event
+    p50 for the e2e rung), a real measured run — never an average of
+    runs that never happened."""
     import tempfile
 
     recs = []
+
+    def _score(rec: dict) -> float:
+        if "rows_per_s" in rec:
+            return rec["rows_per_s"]
+        return -rec["total_p50_s"]
+
+    def ab(run_one) -> None:
+        per_mode = {"pre": [], "post": []}
+        for _ in range(AB_REPS):
+            for mode in ("pre", "post"):
+                with _mode_env(mode, tag):
+                    per_mode[mode].append(run_one(mode))
+        for mode in ("pre", "post"):
+            ranked = sorted(per_mode[mode], key=_score)
+            recs.append(ranked[len(ranked) // 2])
+
     for durable in (False, True):
         for n in (1, 4, 16):
-            for mode in ("pre", "post"):
-                with _mode_env(mode):
-                    recs.append(asyncio.run(
-                        _local_write(n, mode, tag, durable=durable)
-                    ))
+            ab(lambda mode, n=n, durable=durable: asyncio.run(
+                _local_write(n, mode, tag, durable=durable)
+            ))
     with tempfile.TemporaryDirectory() as tmp:
-        for mode in ("pre", "post"):
-            with _mode_env(mode):
-                recs.append(_apply_rung(
-                    "ingest-remote", _gen_uniform(20_000, 400), 500,
-                    mode, tag, tmp,
-                ))
-        for mode in ("pre", "post"):
-            with _mode_env(mode):
-                recs.append(_apply_rung(
-                    "ingest-conflict", _gen_conflict(20_000), 500,
-                    mode, tag, tmp,
-                ))
-    for mode in ("pre", "post"):
-        with _mode_env(mode):
-            recs.append(asyncio.run(_e2e(mode, tag)))
+        uniform = _gen_uniform(20_000, 400)
+        conflict = _gen_conflict(20_000)
+        ab(lambda mode: _apply_rung(
+            "ingest-remote", uniform, 500, mode, tag, tmp,
+        ))
+        ab(lambda mode: _apply_rung(
+            "ingest-conflict", conflict, 500, mode, tag, tmp,
+        ))
+    ab(lambda mode: asyncio.run(_e2e(mode, tag)))
     return recs
 
 

@@ -197,9 +197,15 @@ class TrafficSim:
         self.recovery_cap = 8.0 if tiny else 45.0
         # alert settle caps: how long after the workload window (faults
         # still injected) the expected typed alert may take to reach
-        # FIRING, and how long after recovery it may take to resolve
-        self.alert_fire_cap = 3.0 if tiny else 10.0
-        self.alert_resolve_cap = 4.0 if tiny else 12.0
+        # FIRING, and how long after recovery it may take to resolve.
+        # The tiny caps are sized for FULL-SUITE load, not a quiet
+        # host: the 0.08 s TSDB/eval cadence is an asyncio task that a
+        # loaded 1-core runner starves, so the rate rule can need
+        # several extra seconds to see the error samples (r21 — the
+        # poll exits early on success, so the nominal wall is
+        # unchanged; only a genuinely late alert spends the headroom).
+        self.alert_fire_cap = 8.0 if tiny else 10.0
+        self.alert_resolve_cap = 8.0 if tiny else 12.0
         self.nodes: Dict[str, SimNode] = {}
 
         def tune(cfg):

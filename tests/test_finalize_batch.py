@@ -1,17 +1,27 @@
-"""Pins for the r14 vectorized local-commit finalize.
+"""Pins for the vectorized local-commit finalize (r14 batch, r21
+columnar).
 
-1. Randomized equivalence: `WriteTx._finalize_pending_vector` must emit
+1. Randomized equivalence: BOTH non-reference engines — the r14/r15
+   per-cell emit loop (`CORRO_FINALIZE=vector`) and the r21 columnar
+   phase B (`CORRO_FINALIZE=columnar`, the default) — must emit
    byte/clock-identical changes AND leave byte-identical data/rows/clock
    tables vs the per-cell reference `_finalize_pending_percell` for ANY
    statement mix — delete/reinsert chains inside one tx, dedupe
    (last-write-wins per cell), pk changes (delete+create), resurrections
-   across transactions, multi-table transactions.
+   across transactions, multi-table transactions, and affinity
+   coercions (numeric-looking TEXT into INTEGER columns, ints/floats
+   into TEXT columns: the captured cell must carry the value sqlite
+   STORED, not the bound parameter).
 2. Statement-shape pin (test_pubsub_perf.py style, via the sqlite trace
    callback): the finalize's READ side is a fixed number of chunked
    IN(...) probes — the SELECT count is EQUAL at 100 and 2000 pending
    cells — and the old per-cell probe shapes (`SELECT cl ... WHERE
    pk = ?`, `SELECT col_version ...`) never execute.  No DDL anywhere
    in the commit path.
+3. Per-GROUP shape pin (r21): `finalize_group` over a 4-writer group
+   issues exactly the probe/flush statement profile of ONE tx touching
+   the same rows — the group pays one chunked probe round and one
+   executemany flush round total, not one per member tx.
 """
 
 from __future__ import annotations
@@ -82,13 +92,24 @@ def random_txs(rng: random.Random, n_txs: int) -> list:
                 ))
             elif kind < 0.7:
                 ops.append(("DELETE FROM kv WHERE id = ?", (kv_id,)))
-            elif kind < 0.8:
+            elif kind < 0.78:
                 # pk change: modeled as delete(old)+create(new)
                 ops.append((
                     "UPDATE kv SET id = ? WHERE id = ?",
                     (rng.randint(6, 9), kv_id),
                 ))
-            elif kind < 0.9:
+            elif kind < 0.86:
+                # affinity mix (r21): an int bound to TEXT-affinity `a`
+                # is stored as text, a numeric-looking string or float
+                # bound to INTEGER-affinity `b` is stored as an integer
+                # — the captured cell must carry the STORED value in
+                # every engine
+                ops.append((
+                    "INSERT OR REPLACE INTO kv (id, a, b) VALUES (?, ?, ?)",
+                    (kv_id, rng.randint(100, 999),
+                     rng.choice([str(rng.randint(0, 9)), 3.0, 7])),
+                ))
+            elif kind < 0.92:
                 ops.append((
                     "INSERT OR REPLACE INTO pair (k, g, v) VALUES (?, ?, ?)",
                     (rng.choice(["a", "b"]), rng.randint(1, 3),
@@ -125,17 +146,49 @@ def run_engine(monkeypatch, engine: str, txs) -> tuple:
     return all_changes, dump
 
 
+@pytest.mark.parametrize("engine", ["vector", "columnar"])
 @pytest.mark.parametrize("seed", [1, 7, 23, 99])
-def test_vector_finalize_equivalent_to_percell(monkeypatch, seed):
+def test_finalize_engines_equivalent_to_percell(monkeypatch, seed, engine):
     rng = random.Random(seed)
     txs = random_txs(rng, 30)
     ch_ref, dump_ref = run_engine(monkeypatch, "percell", txs)
-    ch_vec, dump_vec = run_engine(monkeypatch, "vector", txs)
-    assert ch_vec == ch_ref
-    assert dump_vec == dump_ref
+    ch_eng, dump_eng = run_engine(monkeypatch, engine, txs)
+    assert ch_eng == ch_ref
+    assert dump_eng == dump_ref
 
 
-def test_delete_reinsert_same_tx_equivalence(monkeypatch):
+def test_columnar_wire_cells_identical_to_percell(monkeypatch):
+    """The columnar batch encoder must produce the exact per-cell wire
+    bytes of the reference path, not just equal field tuples (the
+    percell engine leaves wire_cell unstamped; `_cell_bytes` backfills
+    it through `write_change_fields`, the single-cell source of
+    truth)."""
+    from corrosion_tpu.types.codec import _cell_bytes
+
+    rng = random.Random(42)
+    txs = random_txs(rng, 20)
+
+    def wire(engine):
+        monkeypatch.setenv("CORRO_FINALIZE", engine)
+        st = mk_store()
+        cells = []
+        for i, ops in enumerate(txs):
+            with st.write_tx(Timestamp.from_unix(i + 1)) as tx:
+                for sql, params in ops:
+                    try:
+                        tx.execute(sql, params)
+                    except Exception:
+                        pass
+                changes, _v, _ls = tx.commit()
+            cells.append([_cell_bytes(c) for c in changes])
+        st.close()
+        return cells
+
+    assert wire("columnar") == wire("percell")
+
+
+@pytest.mark.parametrize("engine", ["vector", "columnar"])
+def test_delete_reinsert_same_tx_equivalence(monkeypatch, engine):
     """The trickiest dedupe path, pinned explicitly: delete + re-insert
     (and insert + delete + re-insert) of the same pk inside ONE tx."""
     txs = [
@@ -154,9 +207,9 @@ def test_delete_reinsert_same_tx_equivalence(monkeypatch):
         [("INSERT INTO kv (id, a) VALUES (1, 'back')", ())],  # resurrection
     ]
     ch_ref, dump_ref = run_engine(monkeypatch, "percell", txs)
-    ch_vec, dump_vec = run_engine(monkeypatch, "vector", txs)
-    assert ch_vec == ch_ref
-    assert dump_vec == dump_ref
+    ch_eng, dump_eng = run_engine(monkeypatch, engine, txs)
+    assert ch_eng == ch_ref
+    assert dump_eng == dump_ref
 
 
 def _commit_trace(n_rows: int) -> list:
@@ -205,3 +258,61 @@ def test_finalize_statement_shape_independent_of_cell_count():
         return sorted({s.split("(")[0] for s in stmts})
 
     assert shapes(small) == shapes(large)
+
+
+def _finalize_group_trace(n_txs: int, rows_per_tx: int) -> list:
+    """Trace EXACTLY the finalize_group call for a group of `n_txs`
+    sub-transactions updating `rows_per_tx` distinct pre-seeded rows
+    each (the r14 leader shape: savepointed sub-txs inside group_tx,
+    deferred pendings finalized in one call)."""
+    st = mk_store()
+    total = n_txs * rows_per_tx
+    with st.write_tx(Timestamp.from_unix(1)) as tx:
+        for i in range(total):
+            tx.execute(
+                "INSERT INTO kv (id, a, b) VALUES (?, ?, ?)", (i, "s", 0)
+            )
+        tx.commit()
+    stmts: list = []
+    with st.group_tx():
+        items = []
+        for j in range(n_txs):
+            ts = Timestamp.from_unix(2 + j)
+            with st.write_tx(ts, nested=True, savepoint=n_txs > 1) as tx:
+                lo = j * rows_per_tx
+                tx.execute(
+                    "UPDATE kv SET a = a || 'x', b = b + 1"
+                    " WHERE id >= ? AND id < ?",
+                    (lo, lo + rows_per_tx),
+                )
+                items.append((tx.commit_deferred(), ts))
+        st._conn.set_trace_callback(stmts.append)
+        st.finalize_group(items)
+        st._conn.set_trace_callback(None)
+    st.close()
+    return stmts
+
+
+def test_group_finalize_statement_profile_is_per_group():
+    """r21 amortization pin: a 4-writer group finalizing 2 rows per tx
+    must issue EXACTLY the statement profile of one tx over the same 8
+    rows — one chunked probe round and one executemany flush round for
+    the whole group, nothing repeated per member tx.  The only allowed
+    per-version statements are the `__corro_state` last-seq rows (one
+    per committed db_version by design)."""
+    from collections import Counter
+
+    grouped = _finalize_group_trace(4, 2)
+    solo = _finalize_group_trace(1, 8)
+
+    def profile(stmts):
+        out: Counter = Counter()
+        for s in stmts:
+            if "__corro_state" in s:
+                continue  # per-db_version bookkeeping, excluded above
+            out[s.split("(")[0].strip()] += 1
+        return out
+
+    assert profile(grouped) == profile(solo), (grouped, solo)
+    n_state = sum("__corro_state" in s for s in grouped)
+    assert n_state == sum("__corro_state" in s for s in solo) * 4

@@ -10,7 +10,7 @@ Three layers, mirroring what the suite promises:
    `# corro: noqa[rule]` comment suppresses (proving the whole
    driver-side filter chain, not just the checker).
 3. THE FOLD IS LOSSLESS: the metrics lint folded into the framework
-   still reports the same 233 literal series + 2 wildcard sites in both
+   still reports the same 236 literal series + 2 wildcard sites in both
    directions, and the `scripts/lint_metrics.py` shim keeps its API.
 
 All pure-AST: no jax tracing, no sqlite, no network — the gate must
@@ -695,6 +695,56 @@ def test_capture_parity_real_tree_is_clean():
     assert CaptureParityChecker().run(AnalysisContext(REPO)) == []
 
 
+# r21: the columnar finalize is a third consumer of the capture
+# conventions — fixture crdt module carrying the finalize-side symbols
+_FINALIZE_OK = _TRIG_OK + """
+
+    def _dedupe_pending(pending):
+        marker = SENTINEL + "X"
+        return [p for p in pending if p[1] != marker]
+
+    def _finalize_engine():
+        return "columnar"
+
+    def _phase_b_columnar(self, specs):
+        cells = [s for s in specs if s[2] != SENTINEL]
+        return write_change_cells(cells, b"site")
+"""
+
+
+def test_capture_parity_clean_with_columnar_finalize(tmp_path):
+    checker = _parity_capture_fixture(tmp_path, trig_body=_FINALIZE_OK)
+    assert checker.run(AnalysisContext(str(tmp_path))) == []
+
+
+def test_capture_parity_fires_on_finalize_marker_drift(tmp_path):
+    body = _FINALIZE_OK.replace(
+        'marker = SENTINEL + "X"', 'marker = SENTINEL + "D"'
+    )
+    checker = _parity_capture_fixture(tmp_path, trig_body=body)
+    fs = checker.run(AnalysisContext(str(tmp_path)))
+    assert any(f.snippet == "finalize-marker-drift" for f in fs), fs
+    assert all(f.path == "store/crdt.py" for f in fs), fs
+
+
+def test_capture_parity_fires_on_columnar_encoder_drift(tmp_path):
+    body = _FINALIZE_OK.replace(
+        'return write_change_cells(cells, b"site")', "return cells"
+    )
+    checker = _parity_capture_fixture(tmp_path, trig_body=body)
+    fs = checker.run(AnalysisContext(str(tmp_path)))
+    assert any(f.snippet == "columnar-encoder-drift" for f in fs), fs
+
+
+def test_capture_parity_fires_on_missing_columnar_builder(tmp_path):
+    body = _FINALIZE_OK.replace(
+        "def _phase_b_columnar", "def _phase_b_other"
+    )
+    checker = _parity_capture_fixture(tmp_path, trig_body=body)
+    fs = checker.run(AnalysisContext(str(tmp_path)))
+    assert any(f.snippet == "missing-columnar-builder" for f in fs), fs
+
+
 # -- 8. timeout-discipline --------------------------------------------------
 
 _UNBOUNDED_NET_AWAITS = """
@@ -785,10 +835,13 @@ def test_timeout_discipline_real_tree_is_clean():
 
 
 def test_metrics_fold_reports_same_inventory():
-    """The lint_metrics fold is lossless: same 233 literal series (218
+    """The lint_metrics fold is lossless: same 236 literal series (218
     at r19 + the 15 r20 alerting-plane series — corro.tsdb.*,
     corro.alerts.*, corro.metrics.{series,cardinality.dropped.total},
-    corro.store.write.errors.total), same 2 wildcard sites, both
+    corro.store.write.errors.total — + the 3 r21 write-path series:
+    corro.write.finalize.columnar.total and the two
+    corro.write.group.amortized.{flush,txs}.total), same 2 wildcard
+    sites, both
     directions clean, via BOTH the framework checker and the
     back-compat shim."""
     import lint_metrics
@@ -796,7 +849,7 @@ def test_metrics_fold_reports_same_inventory():
     assert MetricsDocChecker().run(AnalysisContext(REPO)) == []
     assert lint_metrics.lint() == []
     literals, wildcards = lint_metrics.scan_call_sites()
-    assert len(literals) == 233
+    assert len(literals) == 236
     assert len(wildcards) == 2
     names = lint_metrics.parse_components_table()
     assert len(names) == len(set(names))

@@ -179,7 +179,9 @@ def test_tier1_replica_serves_under_faults():
     sick-disk} through the REAL HTTP/subscription surfaces.  Every bar
     (`_assert_bars`) runs inside `run_matrix`; this test re-states the
     headline ones and bounds the wall with a wide backstop (nominal
-    ~5 s — the ≤10 s replica budget — backstop 3× for host drift)."""
+    ~5 s — the ≤10 s replica budget — backstop for host drift plus the
+    r21 load-tolerant alert-settle caps, which only spend their
+    headroom when suite load starves the 0.08 s alert-eval cadence)."""
     import traffic_sim
 
     t0 = time.monotonic()
@@ -204,4 +206,4 @@ def test_tier1_replica_serves_under_faults():
     assert al["expected"] == "store-faults"
     assert al["raised"] and al["resolved"]
     assert al["drill"] == "sick-disk"
-    assert elapsed < 15.0, f"tiny replica took {elapsed:.1f}s (budget 10s)"
+    assert elapsed < 28.0, f"tiny replica took {elapsed:.1f}s (budget 10s)"
