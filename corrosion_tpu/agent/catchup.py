@@ -150,6 +150,14 @@ async def _serve_snapshot_inner(
     if req.schema_sha != local_sha:
         await reject(REJECT_SCHEMA)
         return
+    if agent.bulk_refuse_until > time.monotonic():
+        # r22 remediation refuse-bulk: this node's store is faulting —
+        # a multi-second VACUUM+stream against a sick disk is the last
+        # thing to add.  BUSY is the right wire answer: the requester
+        # already treats it as "try another peer", a typed degradation
+        # instead of a doomed transfer
+        await reject(REJECT_BUSY)
+        return
     if agent.snapshot_serve_sem.locked():
         await reject(REJECT_BUSY)
         return
@@ -476,6 +484,12 @@ async def maybe_snapshot_bootstrap(agent: Agent, peers: List[Actor]) -> bool:
     failure is a counted fallback to the round's normal delta sync."""
     cfg = agent.config.sync
     if not cfg.snapshot or not peers or agent.store._is_memory:
+        return False
+    if agent.bulk_refuse_until > time.monotonic():
+        # r22 remediation refuse-bulk: a store-faulting node must not
+        # START a bulk transfer either — installing a snapshot through
+        # a sick disk fails mid-swap at best; the delta plane keeps the
+        # node converging at retail size until the revert clears this
         return False
     # post-install cooldown: one bootstrap per cold start — under live
     # fire the freshly-installed node still trails by however many

@@ -87,6 +87,15 @@ class Agent:
     # the metrics TSDB with a pending→firing→resolved lifecycle;
     # serves /v1/alerts, summaries ride the observatory digests
     alerts: Optional[object] = None
+    # r22 remediation plane (agent/remediation.py): the supervisor
+    # that turns alert firings into typed, cooldown-gated actuator
+    # runs; serves GET /v1/remediation
+    remediation: Optional[object] = None
+    # r22 refuse-bulk deadline (monotonic): while in the future this
+    # node refuses to SERVE bulk snapshot transfers (catchup.py rejects
+    # BUSY) and to START one as a bootstrap client — armed by the
+    # store-faults actuator, cleared by its revert hook (or expiry)
+    bulk_refuse_until: float = 0.0
     # r14 write-path group commit (agent/run.py GroupCommitter):
     # concurrent local writers coalesce into shared sqlite transactions
     commit_group: Optional[object] = None

@@ -190,9 +190,32 @@ class Observatory:
 
     def build_and_store(self) -> NodeDigest:
         """Refresh the local digest and queue it for dissemination with
-        a full infection-style transmission budget."""
+        a full infection-style transmission budget.
+
+        The digest must FIT the gossip plane or it never ships: pick_ext
+        skips anything over the frame's leftover budget, and since the
+        stage histograms are cumulative the overflow is permanent once
+        crossed — with an open divergence episode inflating the alert
+        block, oversize is self-sustaining (no digests → silence →
+        episode stays open → alert block stays on).  Degrade tiers keep
+        the view/census core shipping: drop the non-total stage
+        histograms first, then all stages/events and the alert tail."""
         d = self.snapshot_local()
         enc = encode_digest(d)
+        if len(enc) > self.cfg.max_wire_bytes:
+            d.stages = {k: v for k, v in d.stages.items() if k == "total"}
+            enc = encode_digest(d)
+            METRICS.counter(
+                "corro.digest.degraded.total", level="stages"
+            ).inc()
+            if len(enc) > self.cfg.max_wire_bytes:
+                d.stages = {}
+                d.events = {}
+                d.alerts = d.alerts[:3]
+                enc = encode_digest(d)
+                METRICS.counter(
+                    "corro.digest.degraded.total", level="census"
+                ).inc()
         with self._lock:
             self._store[d.actor_id] = _Held(
                 digest=d,

@@ -276,6 +276,15 @@ async def setup(
         agent.alerts = AlertEngine(
             tsdb=db, cfg=config.alerts, agent=agent
         )
+        # r22 remediation plane: always built beside the alert engine —
+        # [remediation] enabled=false (the default kill-switch) keeps
+        # it observe-only (typed "would_act" events, no actions), so
+        # GET /v1/remediation audits the plane before anyone arms it
+        from corrosion_tpu.agent.remediation import RemediationSupervisor
+
+        agent.remediation = RemediationSupervisor(
+            agent, cfg=config.remediation
+        )
 
     # r12 cluster observatory: telemetry digests piggyback the gossip
     # datagrams (hooks below) + broadcast envelopes (broadcast_loop);
@@ -390,6 +399,11 @@ async def run(agent: Agent) -> None:
         from corrosion_tpu.runtime.alerts import alerts_loop
 
         t.spawn(alerts_loop(agent))
+    if agent.remediation is not None:
+        # r22: the acting half — consume firings, drive actuators
+        from corrosion_tpu.agent.remediation import remediation_loop
+
+        t.spawn(remediation_loop(agent))
     # db maintenance: WAL truncate ladder + incremental vacuum
     # (handlers.rs:379-547) — this is what makes perf.wal_threshold_gb live
     from corrosion_tpu.store.maintenance import vacuum_loop, wal_maintenance_loop

@@ -856,6 +856,33 @@ def choose_sync_peers(agent: Agent, rng: random.Random) -> List[Actor]:
     return [info.actor for info in chosen]
 
 
+async def targeted_sync(
+    agent: Agent, timeout: float = 30.0,
+    rng: Optional[random.Random] = None,
+) -> int:
+    """One immediate anti-entropy round OUTSIDE the sync_loop cadence —
+    the r22 view-divergence actuator (agent/remediation.py).  The
+    steady loop backs off toward `sync_interval_max_secs` exactly when
+    nothing has been arriving — i.e. exactly when a divergence episode
+    opens — so a firing alert would otherwise wait out the whole
+    backoff before the next repair attempt.  Same peer choice (digest-
+    freshest first, circuits deprioritized) and the same resumable
+    `parallel_sync`; bounded by `timeout` so a wedged round degrades to
+    a counted zero instead of pinning the supervisor.  Returns changes
+    received."""
+    peers = choose_sync_peers(agent, rng or random.Random())
+    if not peers:
+        return 0
+    try:
+        received = await asyncio.wait_for(
+            parallel_sync(agent, peers), timeout
+        )
+    except asyncio.TimeoutError:
+        received = 0
+    METRICS.counter("corro.sync.targeted.rounds.total").inc()
+    return received
+
+
 async def sync_loop(agent: Agent, rng: Optional[random.Random] = None) -> None:
     """Periodic anti-entropy with exponential backoff 1–15 s
     (agent/util.rs:359-405)."""

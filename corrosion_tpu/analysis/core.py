@@ -220,6 +220,7 @@ def save_baseline(
 
 def all_checkers() -> List[Checker]:
     # imported lazily so `core` has no checker-module dependencies
+    from corrosion_tpu.analysis.actuators import ActuatorDisciplineChecker
     from corrosion_tpu.analysis.blocking import AsyncBlockingChecker
     from corrosion_tpu.analysis.capture_parity import CaptureParityChecker
     from corrosion_tpu.analysis.codecext import CodecExtChecker
@@ -238,6 +239,7 @@ def all_checkers() -> List[Checker]:
         CaptureParityChecker(),
         MetricsDocChecker(),
         TimeoutDisciplineChecker(),
+        ActuatorDisciplineChecker(),
     ]
 
 

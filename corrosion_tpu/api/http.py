@@ -106,6 +106,7 @@ class ApiServer:
         app.router.add_get("/v1/cluster", self.h_cluster)
         app.router.add_get("/v1/traces", self.h_traces)
         app.router.add_get("/v1/alerts", self.h_alerts)
+        app.router.add_get("/v1/remediation", self.h_remediation)
         return app
 
     async def start(self) -> None:
@@ -520,6 +521,14 @@ class ApiServer:
                 agent.alerts.census()
                 if agent.alerts is not None else {"enabled": False}
             ),
+            # r22 remediation census: is the plane armed (vs observe-
+            # only) and what it has done (full actuator table + typed
+            # action history live at GET /v1/remediation)
+            "remediation": (
+                agent.remediation.census()
+                if agent.remediation is not None
+                else {"enabled": False}
+            ),
             # r11 SLO plane pointer: the canary's live numbers (full
             # per-stage percentiles live at GET /v1/slo)
             "slo": {
@@ -751,6 +760,23 @@ class ApiServer:
                 {"enabled": False, "rules": [], "active": []}
             )
         report = eng.report(
+            history=request.query.get("history") != "0"
+        )
+        report["actor_id"] = str(self.agent.actor_id)
+        return web.json_response(report)
+
+    async def h_remediation(self, request: web.Request) -> web.Response:
+        """Remediation plane (r22): the actuator census (alert rule →
+        action → cooldown → revert, with live cooldown remainders) and
+        the typed action history — every acted / would_act / deferred /
+        refused / reverted decision with its wall stamp, drill mark and
+        detail.  `?history=0` trims the history."""
+        sup = self.agent.remediation
+        if sup is None:
+            return web.json_response(
+                {"enabled": False, "actuators": [], "history": []}
+            )
+        report = sup.report(
             history=request.query.get("history") != "0"
         )
         report["actor_id"] = str(self.agent.actor_id)

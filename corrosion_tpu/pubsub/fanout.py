@@ -277,13 +277,28 @@ class StreamSink:
             self.pending_bytes > self.max_lag_bytes
             or data_batches > self.max_lag_batches
         ):
-            METRICS.counter("corro.subs.shed.total").inc()
-            shed = SubLagging(self.pending_bytes, data_batches)
-            self.pending.clear()
-            self.pending_bytes = 0
-            self._resolve(shed)
+            self.shed()
             return True
         return False
+
+    def shed(self) -> bool:
+        """Drop this sink NOW with the typed `SubLagging` terminal the
+        r16 client resume path already handles.  Two callers: `flush`
+        when the lag bounds trip, and the r22 slo-burn remediation
+        actuator (agent/remediation.py) shedding the laggard tier
+        before clients time out — same typed degradation either way,
+        never a stall.  Returns False when the sink already ended."""
+        if self.closed or self.done.done():
+            return False
+        METRICS.counter("corro.subs.shed.total").inc()
+        shed = SubLagging(
+            self.pending_bytes,
+            sum(1 for p, _ in self.pending if p is not None),
+        )
+        self.pending.clear()
+        self.pending_bytes = 0
+        self._resolve(shed)
+        return True
 
     def _note_stats(self, wrote: int, shipped: int) -> None:
         w = self.writer
@@ -331,6 +346,23 @@ class FanoutWriter:
         if self._task is not None:
             self._task.cancel()
             self._task = None
+
+    def clogged_count(self) -> int:
+        return len(self._clogged)
+
+    def shed_clogged(self) -> int:
+        """Shed the CURRENT laggard tier (every clogged sink) with the
+        typed `SubLagging` terminal; returns how many went.  The r22
+        slo-burn actuator's lever: laggards are exactly the sinks whose
+        sockets stopped draining, the ones soon to trip the lag bounds
+        anyway — shedding them early frees writer rounds for the
+        healthy tier before clients time out."""
+        n = 0
+        for key, sink in list(self._clogged.items()):
+            if sink.shed():
+                n += 1
+            self._clogged.pop(key, None)
+        return n
 
     # -- the writer task ---------------------------------------------------
 

@@ -38,6 +38,7 @@ import asyncio
 import contextlib
 import shutil
 import sqlite3
+import time
 import uuid
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Set, Tuple
@@ -101,6 +102,12 @@ class SubsManager:
         self._router: Router = {}
         self.executor = DiffExecutor(self.cfg.diff_workers)
         self.fanout = FanoutWriter(self.cfg.writer_tick_secs)
+        # r22 refuse-bulk deadline (monotonic): while set in the future,
+        # NEW streams get the typed admission 503 — the store-faults
+        # remediation actuator (agent/remediation.py) arms it so a sick
+        # node stops taking on serving work it will only shed; existing
+        # reads and the matchers' own queries are untouched
+        self.refuse_until: float = 0.0
 
     def _rebuild_router(self) -> None:
         idx: Dict[str, Dict[str, Set[MatcherHandle]]] = {}
@@ -140,6 +147,12 @@ class SubsManager:
     def admission_reject(self) -> Optional[str]:
         """None = admit; otherwise the typed rejection reason.  Counted
         so a fleet hitting its admission ceiling is visible."""
+        if self.refuse_until and time.monotonic() < self.refuse_until:
+            METRICS.counter("corro.subs.admission.rejected.total").inc()
+            return (
+                "node refusing new streams"
+                " (remediation refuse-bulk; store faulting)"
+            )
         mx = self.cfg.max_streams
         if mx and self.stream_count() >= mx:
             METRICS.counter("corro.subs.admission.rejected.total").inc()
@@ -368,6 +381,19 @@ class SubsManager:
         asyncio.ensure_future(self.remove(sub_id, purge=True))
 
     # -- teardown ----------------------------------------------------------
+
+    async def drain(self) -> int:
+        """Drain every matcher home off this node (r22 store-faults
+        actuator): each handle stops CLEANLY — attached streams get the
+        bare-None terminal frame, so clients end with a typed stop and
+        re-subscribe elsewhere (or here, post-revert) via the resume
+        path.  Sub dbs are NOT purged: a recovered node re-attaches
+        them through `restore()`.  Returns how many homes drained."""
+        n = 0
+        for sid in list(self._by_id):
+            await self.remove(sid)
+            n += 1
+        return n
 
     async def remove(self, sub_id: str, purge: bool = False) -> None:
         async with self._lock:

@@ -135,6 +135,18 @@ DEFAULT_RULES = (
 )
 
 
+# rule → actuator bindings for the r22 remediation plane
+# (agent/remediation.py): which registered actuator a FIRING rule
+# drives.  Kept here beside DEFAULT_RULES so adding a rule forces the
+# "should the cluster act on this?" question in the same diff; rules
+# absent from the map page a human and nothing else.
+DEFAULT_ACTIONS = {
+    "view-divergence": "targeted-sync",
+    "store-faults": "drain-refuse-bulk",
+    "slo-burn": "shed-laggards",
+}
+
+
 @dataclass
 class AlertRule:
     name: str
@@ -461,6 +473,27 @@ class AlertEngine:
             ]
         rows.sort(key=lambda a: (a["state"] != "firing", a["rule"]))
         return rows[:cap]
+
+    def firing_snapshot(self) -> List[dict]:
+        """The remediation supervisor's consumption point
+        (agent/remediation.py): every FIRING rule with how long it has
+        been firing — enough to gate sustain windows and cooldowns
+        without re-deriving lifecycle state."""
+        now = self._clock()
+        rules = {r.name: r for r in self.rules}
+        with self._lock:
+            return [
+                {
+                    "rule": name,
+                    "severity": rules[name].severity,
+                    "firing_secs": max(0.0, now - st.since_mono),
+                    "since_wall": st.since_wall,
+                    "value": st.value,
+                    "drill": st.drill,
+                }
+                for name, st in self._states.items()
+                if st.state == "firing"
+            ]
 
     def census(self) -> dict:
         """The /v1/status block."""

@@ -240,6 +240,43 @@ class AlertsConfig:
 
 
 @dataclass
+class RemediationConfig:
+    """[remediation] — the supervised remediation plane
+    (agent/remediation.py, r22) that closes the observe→act loop: a
+    supervisor tick consumes `[alerts]` firings and drives typed,
+    cooldown-gated actuators (view-divergence → targeted anti-entropy
+    sync, store-faults → matcher-home drain + refuse-bulk, sustained
+    slo-burn → laggard-tier shed).
+
+    `enabled=false` is the GLOBAL KILL-SWITCH and the default: the
+    supervisor still runs, evaluates every gate, and records typed
+    "would_act" events (flight-recorded, served by GET
+    /v1/remediation) — observe-only mode, so operators audit exactly
+    what the plane WOULD have done before arming it.  `defer_health`
+    is the Lifeguard self-distrust bar (arXiv:1707.00788): when the
+    local `AlertEngine.health_score()` is at/above it, local impulses
+    defer to the digest-merged cluster-scope alert rollup — the node
+    acts only when another node's digest confirms the same rule is
+    firing.  `slo_sustain_secs` keeps the shed actuator off transient
+    slo-burn blips (Prime CCL: shrink capacity, never convert requests
+    into stalls); per-actuator cooldowns stop act storms; and
+    `refuse_bulk_secs` bounds how long a store-faulting node refuses
+    bulk snapshot serves + new stream admissions before the flag
+    self-expires (revert clears it sooner on alert resolve)."""
+
+    enabled: bool = False
+    tick_secs: float = 2.0
+    act_timeout_secs: float = 30.0
+    history_max: int = 256
+    defer_health: float = 0.5
+    sync_cooldown_secs: float = 30.0
+    drain_cooldown_secs: float = 60.0
+    shed_cooldown_secs: float = 30.0
+    slo_sustain_secs: float = 5.0
+    refuse_bulk_secs: float = 60.0
+
+
+@dataclass
 class PubsubConfig:
     """[pubsub] — live-query matcher knobs.  `candidate_batch_wait` is
     the matcher's candidate-batching window in seconds: the PR-6 SLO
@@ -350,6 +387,17 @@ class ClusterObsConfig:
 
     digests: bool = True
     digest_interval_secs: float = 2.0
+    # r22: hard ceiling on the ENCODED digest.  The digest is cumulative
+    # (histograms only grow), and the gossip plane offers pick_ext only
+    # the bytes a SWIM frame has left (~1135 quiet, less with piggyback)
+    # — so a digest that outgrows the quiet frame is skipped by EVERY
+    # datagram and the view/census core (the split-brain signal) starves
+    # cluster-wide.  Worse, an open divergence episode ADDS an alert
+    # block to every digest, so the starvation is self-sustaining.
+    # build_and_store degrades an over-ceiling digest (drop non-total
+    # stage histograms, then stages/events/alert tail) instead: shed
+    # telemetry richness, never liveness.
+    max_wire_bytes: int = 896
     stale_after_secs: float = 20.0
     silent_after_secs: float = 0.0  # 0 → silent_after_mult × interval
     # the silence threshold must undercut the SWIM suspicion window
@@ -415,6 +463,7 @@ class Config:
     trace: TraceConfig = field(default_factory=TraceConfig)
     tsdb: TsdbConfig = field(default_factory=TsdbConfig)
     alerts: AlertsConfig = field(default_factory=AlertsConfig)
+    remediation: RemediationConfig = field(default_factory=RemediationConfig)
 
 
 _ENV_PREFIX = "CORRO_"

@@ -80,6 +80,22 @@ def merge_records(
     return merged
 
 
+def cleanup_record_locks(*paths: str) -> None:
+    """Remove the flock sidecars (``<path>.lock``) `merge_records`
+    leaves behind.  The sidecar is only a cross-process mutex while a
+    merge cycle is in flight — it carries no state — but it used to
+    strand in the working tree whenever a bench/sim entry point exited
+    (normally OR abnormally).  Entry points call this from a
+    ``finally`` over the record files they merge; a lock currently
+    held by a concurrent merger is safe to unlink (flock follows the
+    open file description, not the name)."""
+    for p in paths:
+        try:
+            os.remove(p + ".lock")
+        except OSError:
+            pass
+
+
 # ---------------------------------------------------------------------------
 # flight recorder (r8): the host timeline plane over the device ring
 

@@ -60,7 +60,10 @@ from corrosion_tpu.runtime import jaxenv  # noqa: E402
 jaxenv.force_cpu_inprocess()
 
 from corrosion_tpu.net.mem import MemNetwork  # noqa: E402
-from corrosion_tpu.runtime.records import merge_records  # noqa: E402
+from corrosion_tpu.runtime.records import (  # noqa: E402
+    cleanup_record_locks,
+    merge_records,
+)
 from corrosion_tpu.store.crdt import CrdtStore  # noqa: E402
 from corrosion_tpu.types.actor import ActorId  # noqa: E402
 from corrosion_tpu.types.base import Timestamp  # noqa: E402
@@ -454,17 +457,23 @@ def main() -> None:
         del args[i : i + 2]
     if "--ab" in args:
         mode = "ab"
-    if mode == "ab":
-        all_recs = run_ab(tag)
-        for r in all_recs:
-            print(json.dumps(r), flush=True)
-    else:
-        all_recs = run_mode(mode, tag)
-        for r in all_recs:
-            print(json.dumps(r), flush=True)
-    merge_records(os.path.join(REPO, "INGEST_BENCH.json"), all_recs)
+    bank = os.path.join(REPO, "INGEST_BENCH.json")
+    try:
+        if mode == "ab":
+            all_recs = run_ab(tag)
+            for r in all_recs:
+                print(json.dumps(r), flush=True)
+        else:
+            all_recs = run_mode(mode, tag)
+            for r in all_recs:
+                print(json.dumps(r), flush=True)
+        merge_records(bank, all_recs)
+    finally:
+        # the merge's flock sidecar must not strand in the working
+        # tree — on ANY exit, including a rung crashing mid-run
+        cleanup_record_locks(bank)
     # headline: the banked acceptance ratios when both halves exist
-    with open(os.path.join(REPO, "INGEST_BENCH.json")) as f:
+    with open(bank) as f:
         banked = {r["rung"]: r for r in json.load(f)}
 
     def ratio(rung: str) -> str:

@@ -66,7 +66,10 @@ jaxenv.force_cpu_inprocess()
 
 from corrosion_tpu.client import CorrosionApiClient  # noqa: E402
 from corrosion_tpu.net.mem import MemNetwork  # noqa: E402
-from corrosion_tpu.runtime.records import merge_records  # noqa: E402
+from corrosion_tpu.runtime.records import (  # noqa: E402
+    cleanup_record_locks,
+    merge_records,
+)
 
 from tests.test_http_api import boot_with_api  # noqa: E402
 
@@ -446,37 +449,45 @@ if __name__ == "__main__":
     distinct = "--distinct" in args
     if distinct:
         args.remove("--distinct")
-    if "--scale" in args:
-        _run_scale(tag, ab)
-        sys.exit(0)
-    if "--streams" in args:
-        i = args.index("--streams")
-        n_streams = int(args[i + 1])
-        del args[i : i + 2]
-        n_queries = 10
-        if "--queries" in args:
-            i = args.index("--queries")
-            n_queries = int(args[i + 1])
+    # whatever path runs (including a rung crashing mid-run or a
+    # sys.exit), the merge flock sidecars must not strand in the tree
+    try:
+        if "--scale" in args:
+            _run_scale(tag, ab)
+            sys.exit(0)
+        if "--streams" in args:
+            i = args.index("--streams")
+            n_streams = int(args[i + 1])
             del args[i : i + 2]
-        n_rows = 100
-        if "--rows" in args:
-            i = args.index("--rows")
-            n_rows = int(args[i + 1])
+            n_queries = 10
+            if "--queries" in args:
+                i = args.index("--queries")
+                n_queries = int(args[i + 1])
+                del args[i : i + 2]
+            n_rows = 100
+            if "--rows" in args:
+                i = args.index("--rows")
+                n_rows = int(args[i + 1])
+                del args[i : i + 2]
+            rec = asyncio.run(
+                streams_rung(n_streams, n_queries, n_rows, tag, distinct)
+            )
+            print(json.dumps(rec), flush=True)
+            merge_records(os.path.join(REPO, "SUBS_SCALE.json"), [rec])
+            sys.exit(0)
+        if "--all" in args:
+            _run_and_merge(ALL_RUNGS, tag)
+            sys.exit(0)
+        n_subs = 1
+        if "--subs" in args:
+            i = args.index("--subs")
+            n_subs = int(args[i + 1])
             del args[i : i + 2]
-        rec = asyncio.run(
-            streams_rung(n_streams, n_queries, n_rows, tag, distinct)
+        n_rows = int(args[0]) if args else 20_000
+        batch = int(args[1]) if len(args) > 1 else 50
+        _run_and_merge([(n_rows, batch, n_subs, distinct)], tag)
+    finally:
+        cleanup_record_locks(
+            os.path.join(REPO, "SUBS_SCALE.json"),
+            os.path.join(REPO, "PUBSUB_BENCH.json"),
         )
-        print(json.dumps(rec), flush=True)
-        merge_records(os.path.join(REPO, "SUBS_SCALE.json"), [rec])
-        sys.exit(0)
-    if "--all" in args:
-        _run_and_merge(ALL_RUNGS, tag)
-        sys.exit(0)
-    n_subs = 1
-    if "--subs" in args:
-        i = args.index("--subs")
-        n_subs = int(args[i + 1])
-        del args[i : i + 2]
-    n_rows = int(args[0]) if args else 20_000
-    batch = int(args[1]) if len(args) > 1 else 50
-    _run_and_merge([(n_rows, batch, n_subs, distinct)], tag)

@@ -27,11 +27,23 @@ Prime CCL, arXiv:2505.14065), availability floors, recovery under the
 cap, zero divergence at close.  Scenario shapes follow Potato
 (arXiv:2308.12698): geo-latency matrices, slow/heterogeneous nodes.
 
+r22 adds the remediation A/B axis: `--remediation` runs the full
+matrix TWICE at steady (production-shaped) sync cadence — once
+observe-only (the `[remediation]` kill-switch default) and once armed
+— and banks the per-scenario recovery walls side by side under the
+`remediation_ab` key (`r22`-tagged, BESIDE the preserved r18 top-level
+records, not over them).  The bar: the armed side strictly improves
+recovery-to-zero-divergence on ≥3 faulted scenarios, with zero
+regressions (timeouts==0 everywhere, availability floors held) and
+every fired action a typed cooldown-stamped flight-recorded event
+served by `GET /v1/remediation`.
+
 Usage:
     python scripts/traffic_sim.py            # full matrix → TRAFFIC_SIM.json
     python scripts/traffic_sim.py --tier1    # tiny-shape subset, no banking
                                              # (what tests/test_traffic_sim.py
                                              # runs in-suite, ≤10 s)
+    python scripts/traffic_sim.py --remediation   # A/B → remediation_ab key
 """
 
 from __future__ import annotations
@@ -93,6 +105,7 @@ _MEASURED_FILES = (
     "corrosion_tpu/chaos/workload.py",
     "corrosion_tpu/net/mem.py",
     "corrosion_tpu/agent/syncer.py",
+    "corrosion_tpu/agent/remediation.py",
     "scripts/traffic_sim.py",
 )
 
@@ -173,8 +186,11 @@ class SimNode:
 class TrafficSim:
     """The harness: cluster lifecycle + one scenario run at a time."""
 
-    def __init__(self, tiny: bool = False, seed: int = 31):
+    def __init__(self, tiny: bool = False, seed: int = 31,
+                 remediation: bool = False, steady_sync: bool = False):
         self.tiny = tiny
+        self.remediation = remediation
+        self.steady_sync = steady_sync
         self.net = MemNetwork(seed=seed)
         self.engine = ChaosEngine()
         n = 3 if tiny else 4
@@ -219,6 +235,26 @@ class TrafficSim:
             cfg.tsdb.sample_interval_secs = 0.08 if tiny else 0.25
             cfg.alerts.eval_interval_secs = 0.08 if tiny else 0.2
             cfg.alerts.for_scale = 0.04 if tiny else 0.15
+            if self.steady_sync:
+                # the A/B axis runs at production-shaped anti-entropy
+                # cadence on BOTH sides (same config, only the arming
+                # bit differs): recovery-off is then dominated by the
+                # sync backoff — exactly the gap the view-divergence
+                # actuator exists to close
+                cfg.perf.sync_interval_min_secs = 1.0
+                cfg.perf.sync_interval_max_secs = 4.0
+            if self.remediation:
+                # r22: arm the plane, cooldowns/sustain scaled to the
+                # scenario-window timescale (the same scaling the
+                # alerting plane above gets)
+                cfg.remediation.enabled = True
+                cfg.remediation.tick_secs = 0.1 if tiny else 0.25
+                cfg.remediation.act_timeout_secs = 0.8 if tiny else 1.5
+                cfg.remediation.sync_cooldown_secs = 0.4 if tiny else 0.75
+                cfg.remediation.drain_cooldown_secs = 1.0 if tiny else 2.0
+                cfg.remediation.shed_cooldown_secs = 0.5 if tiny else 1.0
+                cfg.remediation.slo_sustain_secs = 0.3 if tiny else 1.0
+                cfg.remediation.refuse_bulk_secs = 1.5 if tiny else 3.0
 
         names = [f"n{i}" for i in range(n)]
         for name in names:
@@ -368,6 +404,7 @@ class TrafficSim:
         self, scenario_id: str, injections: List[Injection]
     ) -> dict:
         self._id_base += 1_000_000  # fresh pk range per scenario
+        scenario_wall0 = time.time()  # to window remediation events
         workload = MixedWorkload(
             self.live_nodes,
             op_timeout_secs=3.0 if self.tiny else 5.0,
@@ -411,6 +448,29 @@ class TrafficSim:
             "duration_secs": self.duration,
             "recovery": recovery,
             **summary,
+        }
+        # r22: scrape every live node's GET /v1/remediation and bank
+        # THIS scenario's decision trail (the supervisor history is
+        # process-lived, so window it by the scenario's wall start) —
+        # armed runs bank acted/reverted events, observe-only runs
+        # bank the kill-switch's would_act audit trail
+        rem_events: List[dict] = []
+        rem_counts: Dict[str, dict] = {}
+        for name in self.nodes:
+            wn = self.nodes[name].workload_node
+            if wn is None:
+                continue
+            rep = await workload.scrape(wn, "/v1/remediation")
+            if not rep:
+                continue
+            rem_counts[name] = rep.get("counts", {})
+            for ev in rep.get("history", []):
+                if ev.get("wall", 0.0) >= scenario_wall0:
+                    rem_events.append({"node": name, **ev})
+        rec["remediation"] = {
+            "armed": self.remediation,
+            "events": rem_events,
+            "counts": rem_counts,
         }
         if expected_alert is not None:
             during_row = self._alert_row(alerts_during, expected_alert)
@@ -561,9 +621,31 @@ def _assert_bars(rec: dict, tiny: bool) -> None:
             "census was not scraped into the record"
         )
         assert "held_versions" in cc and "bootstrap" in cc, cc
+    # r22: every remediation event the scenario banked is fully typed
+    # — action, rule, outcome mode, wall stamp, cooldown stamp, drill
+    # mark (the flight-record contract GET /v1/remediation serves)
+    rem = rec.get("remediation")
+    if rem is not None:
+        for ev in rem["events"]:
+            missing = {
+                "node", "action", "rule", "mode", "wall",
+                "cooldown_secs", "drill", "detail",
+            } - set(ev)
+            assert not missing, f"{sid}: untyped remediation event {ev}"
+            assert ev["mode"] in (
+                "acted", "would_act", "deferred", "refused",
+                "failed", "reverted",
+            ), ev
+            assert ev["cooldown_secs"] > 0, ev
 
 
-async def run_matrix(tiny: bool) -> dict:
+async def run_matrix(
+    tiny: bool,
+    remediation: bool = False,
+    steady_sync: bool = False,
+    seed: int = 31,
+    only: Optional[Tuple[str, ...]] = None,
+) -> dict:
     from corrosion_tpu.runtime import tsdb as _tsdb
 
     saved = (syncer.RECV_TIMEOUT, syncer.OPEN_TIMEOUT)
@@ -584,17 +666,21 @@ async def run_matrix(tiny: bool) -> dict:
         # production constants untouched for later tests)
         syncer.RECV_TIMEOUT = 2.0
         syncer.OPEN_TIMEOUT = 1.0
-    sim = TrafficSim(tiny=tiny)
+    sim = TrafficSim(tiny=tiny, seed=seed, remediation=remediation,
+                     steady_sync=steady_sync)
     records: List[dict] = []
     t0 = time.monotonic()
     await sim.start_cluster()
     try:
         for scenario_id, injections in sim.scenario_matrix():
+            if only is not None and scenario_id not in only:
+                continue
             rec = await sim.run_scenario(scenario_id, injections)
             _assert_bars(rec, tiny)
             records.append(rec)
             print(json.dumps({
                 "scenario": scenario_id,
+                "remediation": remediation,
                 "write_avail": rec["stages"]["write"]["availability"],
                 "events": rec["events_delivered"],
                 "recovery_s": rec["recovery"]["secs"],
@@ -603,32 +689,160 @@ async def run_matrix(tiny: bool) -> dict:
         await sim.stop_cluster()
         syncer.RECV_TIMEOUT, syncer.OPEN_TIMEOUT = saved
         _tsdb.configure()  # uninstall: later tests ensure() their own
-    return {
+    out = {
         "metric": "traffic_sim",
         "mode": "tier1" if tiny else "full",
         "nodes": len(sim.nodes),
+        "remediation": remediation,
         "duration_per_scenario_secs": sim.duration,
         "wall_secs": round(time.monotonic() - t0, 2),
         "scenarios": records,
     }
+    if tiny and not remediation and only is None:
+        # the r22 tier-1 replica addendum: one remediation-ARMED
+        # zombie-node scenario on a fresh tiny cluster — the plane
+        # boots, ticks, serves GET /v1/remediation, and every bar
+        # (timeouts==0, recovery, zero divergence) holds with the
+        # actuators live
+        armed = await run_matrix(
+            True, remediation=True, seed=37, only=("zombie-node",)
+        )
+        rec = armed["scenarios"][0]
+        assert rec["remediation"]["armed"] is True
+        rec["scenario"] = "zombie-node-remediated"
+        out["scenarios"].append(rec)
+    return out
+
+
+def _stage_timeouts(rec: dict) -> int:
+    return sum(st["timeouts"] for st in rec["stages"].values())
+
+
+async def run_remediation_ab() -> dict:
+    """The r22 proof harness: the full matrix twice at steady sync
+    cadence — observe-only, then armed — returning the banked A/B
+    record.  Bars asserted here (the same ones
+    tests/test_traffic_sim.py guards against the banked artifact):
+    the armed side strictly improves recovery on ≥3 faulted scenarios,
+    zero regressions, every fired action typed."""
+    off = await run_matrix(False, remediation=False, steady_sync=True)
+    on = await run_matrix(False, remediation=True, steady_sync=True,
+                          seed=32)
+    by_off = {r["scenario"]: r for r in off["scenarios"]}
+    by_on = {r["scenario"]: r for r in on["scenarios"]}
+    scenarios: Dict[str, dict] = {}
+    improved: List[str] = []
+    for sid in by_off:
+        a, b = by_off[sid], by_on[sid]
+        row = {
+            "recovery_off_secs": a["recovery"]["secs"],
+            "recovery_on_secs": b["recovery"]["secs"],
+            "improved": b["recovery"]["secs"] < a["recovery"]["secs"],
+            "timeouts_off": _stage_timeouts(a),
+            "timeouts_on": _stage_timeouts(b),
+            "write_availability_off":
+                a["stages"]["write"]["availability"],
+            "write_availability_on":
+                b["stages"]["write"]["availability"],
+        }
+        scenarios[sid] = row
+        if row["improved"] and sid != "baseline":
+            improved.append(sid)
+    actions = [
+        ev
+        for rec in on["scenarios"]
+        for ev in rec["remediation"]["events"]
+    ]
+    would_act = sum(
+        1
+        for rec in off["scenarios"]
+        for ev in rec["remediation"]["events"]
+        if ev["mode"] == "would_act"
+    )
+    # the acceptance bars, asserted BEFORE banking
+    assert len(improved) >= 3, (
+        f"remediation improved recovery on only {improved} — "
+        "the A/B must show ≥3 faulted scenarios strictly better"
+    )
+    for sid, row in scenarios.items():
+        assert row["timeouts_on"] == 0 and row["timeouts_off"] == 0, (
+            f"{sid}: timeouts in the A/B run"
+        )
+    fired = [ev for ev in actions if ev["mode"] == "acted"]
+    assert fired, "armed run fired no actions at all"
+    assert would_act > 0, (
+        "observe-only run recorded no would_act events — the "
+        "kill-switch audit trail is empty"
+    )
+    return {
+        "tag": "r22",
+        "sync_profile": {
+            "sync_interval_min_secs": 1.0,
+            "sync_interval_max_secs": 4.0,
+        },
+        "scenarios": scenarios,
+        "improved_faulted": sorted(improved),
+        "actions": actions,
+        "observe_only_would_act": would_act,
+        "code_sha": _code_fingerprint(),
+        "measured_at": time.strftime(
+            "%Y-%m-%d %H:%M:%S", time.gmtime()
+        ),
+    }
 
 
 def main() -> None:
+    from corrosion_tpu.runtime.records import cleanup_record_locks
+
     tiny = "--tier1" in sys.argv
-    record = asyncio.run(run_matrix(tiny))
-    record["code_sha"] = _code_fingerprint()
-    record["measured_at"] = time.strftime(
-        "%Y-%m-%d %H:%M:%S", time.gmtime()
-    )
-    if tiny:
-        print(json.dumps(record, indent=1))
-        return
+    ab = "--remediation" in sys.argv
     out = os.path.join(REPO, "TRAFFIC_SIM.json")
-    with open(out, "w") as f:
-        json.dump(record, f, indent=1)
-        f.write("\n")
-    print(f"banked {out}: {len(record['scenarios'])} scenarios, "
-          f"wall {record['wall_secs']}s")
+    try:
+        if ab and not tiny:
+            # A/B axis: bank BESIDE the r18 top-level records — load,
+            # set the one key, write back
+            ab_rec = asyncio.run(run_remediation_ab())
+            try:
+                with open(out) as f:
+                    banked = json.load(f)
+            except (OSError, ValueError):
+                banked = {}
+            banked["remediation_ab"] = ab_rec
+            with open(out, "w") as f:
+                json.dump(banked, f, indent=1)
+                f.write("\n")
+            print(
+                f"banked {out} remediation_ab: improved="
+                f"{ab_rec['improved_faulted']}, "
+                f"{len(ab_rec['actions'])} action events"
+            )
+            return
+        record = asyncio.run(run_matrix(tiny))
+        record["code_sha"] = _code_fingerprint()
+        record["measured_at"] = time.strftime(
+            "%Y-%m-%d %H:%M:%S", time.gmtime()
+        )
+        if tiny:
+            print(json.dumps(record, indent=1))
+            return
+        try:
+            with open(out) as f:
+                prior = json.load(f)
+        except (OSError, ValueError):
+            prior = {}
+        if "remediation_ab" in prior:
+            # a full re-measure preserves the banked A/B axis
+            record["remediation_ab"] = prior["remediation_ab"]
+        with open(out, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+        print(f"banked {out}: {len(record['scenarios'])} scenarios, "
+              f"wall {record['wall_secs']}s")
+    finally:
+        # this script writes TRAFFIC_SIM.json directly (no merge), but
+        # shares the working tree with merge_records users — never
+        # leave a stranded flock sidecar behind on any exit
+        cleanup_record_locks(out)
 
 
 if __name__ == "__main__":

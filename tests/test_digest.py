@@ -326,3 +326,48 @@ def test_observatory_silence_requires_prior_report(monkeypatch):
     # ... but not when the local loop itself was late (lag suppression)
     obs._self_lagged = True
     assert not obs.check_divergence()["silent"]
+
+
+def test_observatory_oversize_digest_degrades_to_fit(monkeypatch):
+    """r22: the encoded digest must FIT the gossip frame or pick_ext
+    skips it on EVERY datagram and the split-brain signal starves
+    cluster-wide — and because an open divergence episode adds an alert
+    block to every node's digest, the overflow is self-sustaining.
+    Once the cumulative histograms cross `max_wire_bytes`,
+    build_and_store sheds the non-total stages (then events + the alert
+    tail), but never the view/census core."""
+    obs = _mk_obs()
+    cap = obs.cfg.max_wire_bytes
+    rng = random.Random(7)
+    fat = {}
+    for st in lat.E2E_STAGES:
+        h = lat.LatencyHistogram()
+        for _ in range(600):
+            h.observe(rng.lognormvariate(-6.0, 4.0))
+        fat[st] = h
+    d = NodeDigest(
+        actor_id=b"\x01" * 16,
+        seq=1,
+        wall=time.time(),
+        view_hash=1234,
+        view_size=4,
+        alive=4,
+        heads_total=755,
+        alerts=[
+            {"rule": "view-divergence", "severity": "page",
+             "state": "firing", "since": 1.0, "value": 1.0}
+        ],
+        stages=fat,
+    )
+    assert len(encode_digest(d)) > cap  # the pathological input
+    monkeypatch.setattr(obs, "snapshot_local", lambda: d)
+    obs.build_and_store()
+    enc = obs._store[b"\x01" * 16].encoded
+    assert len(enc) <= cap, f"degrade left {len(enc)}B > {cap}B"
+    got = decode_digest(enc)
+    # the core the divergence detector feeds on is intact
+    assert got.view_hash == 1234 and got.view_size == 4
+    assert got.heads_total == 755
+    assert set(got.stages) <= {"total"}
+    # and a quiet SWIM frame's leftover budget now carries it
+    assert obs.pick_ext(cap + 64) is not None

@@ -1,0 +1,435 @@
+"""agent/remediation.py: the supervised remediation plane (r22).
+
+Three layers:
+
+1. GATE PROTOCOL (fake clocks + fake engines): sustain, cooldown,
+   precondition, Lifeguard deferral-until-cluster-consensus, and the
+   `enabled=false` observe-only kill-switch — each produces its typed,
+   drill-stamped history event exactly once per firing episode.
+2. ACTUATOR UNITS: slo-burn sheds the clogged sink tier with the typed
+   `SubLagging` terminal the r16 client resume path already handles.
+3. INTEGRATION (real agents over MemNetwork): view-divergence →
+   targeted-sync actually converges a node that missed writes, and
+   store-faults → drain+refuse-bulk drains the matcher homes with
+   clean terminals while the node stays read-available — then the
+   revert clears the refuse flags when the rule resolves.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from types import SimpleNamespace
+
+from corrosion_tpu.agent.remediation import (
+    Actuator,
+    RemediationSupervisor,
+    default_actuators,
+)
+from corrosion_tpu.runtime.alerts import DEFAULT_ACTIONS
+from corrosion_tpu.runtime.config import RemediationConfig
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class FakeAlerts:
+    """Just the two reads the supervisor makes."""
+
+    def __init__(self, firing=(), health=0.0):
+        self.firing = list(firing)
+        self.health = health
+
+    def firing_snapshot(self):
+        return list(self.firing)
+
+    def health_score(self):
+        return self.health
+
+
+class FakeObs:
+    def __init__(self, rollup):
+        self.rollup = rollup
+
+    def cluster_alerts(self):
+        return {"rollup": self.rollup}
+
+
+def firing(rule, secs=60.0):
+    return {"rule": rule, "severity": "page", "firing_secs": secs,
+            "since_wall": 1.0, "value": 1.0, "drill": None}
+
+
+def fake_agent(**kw):
+    ns = SimpleNamespace(
+        actor_id="me-node", alerts=FakeAlerts(), observatory=None,
+        subs=None, bulk_refuse_until=0.0,
+    )
+    for k, v in kw.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def probe_supervisor(agent, cfg, cooldown=10.0, sustain=0.0):
+    """A supervisor with ONE synthetic actuator bound to
+    view-divergence, recording its runs in `runs`."""
+    runs = []
+
+    async def act(a):
+        runs.append(1)
+        return {"ok": len(runs)}
+
+    sup = RemediationSupervisor(
+        agent, cfg=cfg,
+        actuators={
+            "probe": Actuator(
+                name="probe", rule="view-divergence", summary="t",
+                cooldown_secs=cooldown, act=act, sustain_secs=sustain,
+            )
+        },
+        bindings={"view-divergence": "probe"},
+        clock=Clock(), wall=Clock(5000.0),
+    )
+    return sup, runs
+
+
+def modes(sup):
+    return [h["mode"] for h in sup.report()["history"]]
+
+
+# -- gate protocol ----------------------------------------------------------
+
+
+def test_kill_switch_records_would_act_once_per_episode():
+    agent = fake_agent(alerts=FakeAlerts([firing("view-divergence")]))
+    sup, runs = probe_supervisor(agent, RemediationConfig(enabled=False))
+
+    async def main():
+        await sup.tick()
+        await sup.tick()  # same episode: no duplicate row
+        assert runs == []
+        assert modes(sup) == ["would_act"]
+        ev = sup.report()["history"][0]
+        assert ev["action"] == "probe"
+        assert ev["rule"] == "view-divergence"
+        assert ev["cooldown_secs"] == 10.0
+        assert "kill_switch" in ev["detail"]
+        # episode ends and refires: a fresh would_act row
+        agent.alerts.firing = []
+        await sup.tick()
+        agent.alerts.firing = [firing("view-divergence")]
+        await sup.tick()
+        assert modes(sup) == ["would_act", "would_act"]
+        assert sup.census()["armed"] is False
+
+    asyncio.run(main())
+
+
+def test_cooldown_gates_repeat_acts():
+    agent = fake_agent(alerts=FakeAlerts([firing("view-divergence")]))
+    sup, runs = probe_supervisor(
+        agent, RemediationConfig(enabled=True), cooldown=10.0
+    )
+
+    async def main():
+        await sup.tick()
+        await sup.tick()  # inside the cooldown window
+        assert runs == [1]
+        sup._clock.t += 11.0  # past the cooldown
+        await sup.tick()
+        assert runs == [1, 1]
+        assert modes(sup) == ["acted", "acted"]
+
+    asyncio.run(main())
+
+
+def test_sustain_holds_young_firings():
+    agent = fake_agent(
+        alerts=FakeAlerts([firing("view-divergence", secs=1.0)])
+    )
+    sup, runs = probe_supervisor(
+        agent, RemediationConfig(enabled=True), sustain=5.0
+    )
+
+    async def main():
+        await sup.tick()
+        assert runs == [] and modes(sup) == []
+        agent.alerts.firing = [firing("view-divergence", secs=6.0)]
+        await sup.tick()
+        assert runs == [1]
+
+    asyncio.run(main())
+
+
+def test_bad_health_defers_until_cluster_consensus():
+    """The Lifeguard pin: a node whose local health score is past
+    `defer_health` must NOT act on its own telemetry — it records a
+    typed `deferred` event and holds until the digest-merged rollup
+    shows the same rule firing on ANOTHER node."""
+    agent = fake_agent(
+        alerts=FakeAlerts([firing("view-divergence")], health=0.9),
+        observatory=FakeObs(
+            {"view-divergence": {"firing": ["me-node"]}}
+        ),
+    )
+    sup, runs = probe_supervisor(agent, RemediationConfig(enabled=True))
+
+    async def main():
+        # only our own sick digest says so: defer, no action
+        await sup.tick()
+        await sup.tick()
+        assert runs == []
+        assert modes(sup) == ["deferred"]
+        assert sup.report()["history"][0]["detail"]["health_score"] == 0.9
+        # no observatory at all: same self-distrust
+        agent.observatory = None
+        await sup.tick()
+        assert runs == []
+        # a second node's digest confirms the rule: consensus — act
+        agent.observatory = FakeObs(
+            {"view-divergence": {"firing": ["me-node", "peer-node"]}}
+        )
+        await sup.tick()
+        assert runs == [1]
+        assert modes(sup) == ["deferred", "acted"]
+
+    asyncio.run(main())
+
+
+def test_default_registry_binds_every_ruled_action():
+    cfg = RemediationConfig()
+    acts = default_actuators(cfg)
+    assert set(DEFAULT_ACTIONS.values()) == set(acts)
+    for rule, name in DEFAULT_ACTIONS.items():
+        assert acts[name].rule == rule
+        assert acts[name].cooldown_secs > 0
+    # the drain actuator is the one with standing side effects: it
+    # must carry the revert hook
+    assert acts["drain-refuse-bulk"].revert is not None
+    assert acts["shed-laggards"].sustain_secs == cfg.slo_sustain_secs
+
+
+# -- actuator units ---------------------------------------------------------
+
+
+def test_slo_burn_sheds_laggards_with_typed_lagging_frame():
+    """slo-burn → shed: the clogged sink ends with the SAME typed
+    `SubLagging` terminal the lag bounds produce — the r16 client
+    resume path needs no new case."""
+    from corrosion_tpu.pubsub.fanout import FanoutWriter, StreamSink, SubLagging
+
+    async def main():
+        fan = FanoutWriter()
+        sink = StreamSink(1 << 20, 1024)
+        sink.hold = False
+        sink.pending.append((b"x" * 10, 0))
+        sink.pending_bytes = 10
+        fan._clogged[id(sink)] = sink
+        agent = fake_agent(
+            alerts=FakeAlerts([firing("slo-burn", secs=60.0)]),
+            subs=SimpleNamespace(fanout=fan),
+        )
+        cfg = RemediationConfig(enabled=True)
+        sup = RemediationSupervisor(agent, cfg=cfg)
+        await sup.tick()
+        assert sink.done.done()
+        shed = sink.done.result()
+        assert isinstance(shed, SubLagging)
+        assert shed.lag_bytes == 10 and shed.lag_batches == 1
+        (ev,) = sup.report()["history"]
+        assert ev["mode"] == "acted" and ev["action"] == "shed-laggards"
+        assert ev["detail"]["laggards_shed"] == 1
+        assert fan.clogged_count() == 0
+
+    asyncio.run(main())
+
+
+def test_shed_refuses_with_no_laggards():
+    from corrosion_tpu.pubsub.fanout import FanoutWriter
+
+    async def main():
+        agent = fake_agent(
+            alerts=FakeAlerts([firing("slo-burn", secs=60.0)]),
+            subs=SimpleNamespace(fanout=FanoutWriter()),
+        )
+        sup = RemediationSupervisor(
+            agent, cfg=RemediationConfig(enabled=True)
+        )
+        await sup.tick()
+        (ev,) = sup.report()["history"]
+        assert ev["mode"] == "refused"
+        assert "no laggard" in ev["detail"]["reason"]
+
+    asyncio.run(main())
+
+
+def test_acts_are_flight_recorded():
+    """Acted events ride the process flight recorder, so incident
+    dumps carry the remediation decision trail."""
+    from corrosion_tpu.pubsub.fanout import FanoutWriter, StreamSink
+    from corrosion_tpu.runtime.records import FLIGHT
+
+    async def main():
+        fan = FanoutWriter()
+        sink = StreamSink(1 << 20, 1024)
+        sink.hold = False
+        sink.pending.append((b"y" * 4, 0))
+        sink.pending_bytes = 4
+        fan._clogged[id(sink)] = sink
+        agent = fake_agent(
+            alerts=FakeAlerts([firing("slo-burn", secs=60.0)]),
+            subs=SimpleNamespace(fanout=fan),
+        )
+        sup = RemediationSupervisor(
+            agent, cfg=RemediationConfig(enabled=True)
+        )
+        before = len(FLIGHT.window(4096, kernel="remediation"))
+        await sup.tick()
+        frames = FLIGHT.window(4096, kernel="remediation")
+        assert len(frames) > before
+        assert frames[-1]["events"].get("shed") == 1
+
+    asyncio.run(main())
+
+
+# -- integration: the real alert→action paths -------------------------------
+
+
+def test_divergence_targeted_sync_converges():
+    """view-divergence → targeted-sync: a node that missed writes (its
+    periodic sync_loop backed off out of the test window) converges
+    after ONE supervisor tick drives the targeted round."""
+    from corrosion_tpu.agent.run import shutdown
+    from corrosion_tpu.net.mem import MemNetwork
+    from tests.test_agent import (
+        boot,
+        count_rows,
+        fast_config,
+        insert,
+        wait_until,
+    )
+
+    async def main():
+        net = MemNetwork(seed=22)
+        cfg_a = fast_config("agent-a")
+        a = await boot(net, "agent-a", cfg=cfg_a)
+        rows = 8
+        for i in range(rows):
+            await insert(a, i + 1, f"pre-join-{i}")
+        # A's broadcast backlog must DRAIN before B joins (the pending
+        # heap resends for ~1.4 s at the n=1 transmission budget) or
+        # the backlog floods B on join and the divergence premise dies
+        from corrosion_tpu.runtime.metrics import METRICS
+
+        def pending_count():
+            for _k, n, _l, v in METRICS.snapshot():
+                if n == "corro.broadcast.pending.count":
+                    return v
+            return 0.0
+
+        # settle nap first: a fresh change takes one broadcast-loop
+        # interval to even reach the pending heap's gauge
+        await asyncio.sleep(0.3)
+        assert await wait_until(lambda: pending_count() == 0)
+        # B joins AFTER the writes; its own sync loop is pushed out of
+        # the test window so only the actuator can repair the gap
+        cfg_b = fast_config("agent-b", bootstrap=["agent-a"])
+        cfg_b.perf.sync_interval_min_secs = 120.0
+        cfg_b.perf.sync_interval_max_secs = 120.0
+        cfg_b.remediation.enabled = True
+        b = await boot(net, "agent-b", cfg=cfg_b)
+        try:
+            assert await wait_until(
+                lambda: any(
+                    aid != b.actor_id for aid in b.members.states
+                )
+            )
+            # the divergence is real: B is missing rows (a straggler
+            # broadcast resend may have landed one — the premise only
+            # needs a gap for the actuator to close)
+            assert count_rows(b) < rows
+            assert b.remediation is not None
+            b.alerts.firing_snapshot = (
+                lambda: [firing("view-divergence")]
+            )
+            b.alerts.health_score = lambda: 0.0
+            await b.remediation.tick()
+            assert await wait_until(lambda: count_rows(b) == rows), (
+                count_rows(b)
+            )
+            # the supervisor LOOP also ticks (enabled=True) — in a slow
+            # window it may act a second time after the cooldown, so
+            # assert over every acted event instead of unpacking one
+            history = b.remediation.report()["history"]
+            acted = [e for e in history if e["mode"] == "acted"]
+            assert acted, history
+            for ev in acted:
+                assert ev["action"] == "targeted-sync"
+                assert ev["rule"] == "view-divergence"
+                assert ev["cooldown_secs"] > 0
+            assert any(
+                e["detail"]["changes_received"] > 0 for e in acted
+            ), acted
+        finally:
+            for ag in (a, b):
+                await shutdown(ag)
+
+    asyncio.run(main())
+
+
+def test_store_faults_drain_refuse_bulk_stays_read_available():
+    """store-faults → drain-refuse-bulk: matcher homes drain with the
+    clean typed terminal, new streams and bulk transfers are refused,
+    reads keep working — and the revert clears the flags when the rule
+    resolves."""
+    from corrosion_tpu.agent.run import shutdown
+    from corrosion_tpu.net.mem import MemNetwork
+    from tests.test_agent import boot, count_rows, fast_config, insert
+
+    async def main():
+        net = MemNetwork(seed=23)
+        cfg = fast_config("agent-a")
+        cfg.remediation.enabled = True
+        a = await boot(net, "agent-a", cfg=cfg)
+        try:
+            await insert(a, 1, "kept")
+            handle, created = await a.subs.get_or_insert(
+                "SELECT id, text FROM tests"
+            )
+            assert created
+            q = handle.attach()
+            assert a.remediation is not None
+            a.alerts.firing_snapshot = lambda: [firing("store-faults")]
+            a.alerts.health_score = lambda: 0.0
+            await a.remediation.tick()
+            # homes drained, subscriber released with the clean terminal
+            assert a.subs.handles() == []
+            assert await asyncio.wait_for(q.get(), 5) is None
+            # refuse-bulk armed on both planes, typed admission refusal
+            now = time.monotonic()
+            assert a.bulk_refuse_until > now
+            assert a.subs.refuse_until > now
+            reason = a.subs.admission_reject()
+            assert reason and "refuse-bulk" in reason
+            # Prime CCL: capacity shrank, reads did NOT stall
+            assert count_rows(a) == 1
+            (ev,) = a.remediation.report()["history"]
+            assert ev["mode"] == "acted"
+            assert ev["action"] == "drain-refuse-bulk"
+            assert ev["detail"]["homes_drained"] == 1
+            # rule resolves → revert clears the standing flags early
+            a.alerts.firing_snapshot = lambda: []
+            await a.remediation.tick()
+            assert a.bulk_refuse_until == 0.0
+            assert a.subs.refuse_until == 0.0
+            assert a.subs.admission_reject() is None
+            assert modes(a.remediation) == ["acted", "reverted"]
+        finally:
+            await shutdown(a)
+
+    asyncio.run(main())

@@ -42,6 +42,9 @@ from corrosion_tpu.analysis.lockcheck import (  # noqa: E402
 from corrosion_tpu.analysis.metricsdoc import MetricsDocChecker  # noqa: E402
 from corrosion_tpu.analysis.parity import LaneParityChecker  # noqa: E402
 from corrosion_tpu.analysis.purity import KernelPurityChecker  # noqa: E402
+from corrosion_tpu.analysis.actuators import (  # noqa: E402
+    ActuatorDisciplineChecker,
+)
 from corrosion_tpu.analysis.timeouts import (  # noqa: E402
     TimeoutDisciplineChecker,
 )
@@ -831,16 +834,133 @@ def test_timeout_discipline_real_tree_is_clean():
     assert TimeoutDisciplineChecker().run(AnalysisContext(REPO)) == []
 
 
-# -- 9. the metrics fold + baseline machinery -------------------------------
+# -- 9. actuator-discipline -------------------------------------------------
+
+_DISCIPLINED_ACTUATOR = """
+    from corrosion_tpu.chaos.faults import CENSUS
+    from corrosion_tpu.runtime.records import FLIGHT
+
+    async def _act_restart(agent):
+        drill = CENSUS.snapshot()
+        FLIGHT.record_host_frame("remediation", {"restart": 1})
+        return {"drill": drill.get("scenario")}
+
+    def registry(cfg):
+        return {
+            "restart": Actuator(
+                name="restart", rule="loop-lag", summary="s",
+                cooldown_secs=30.0, act=_act_restart,
+            )
+        }
+"""
+
+_SLOPPY_ACTUATORS = """
+    from corrosion_tpu.chaos.faults import CENSUS
+    from corrosion_tpu.runtime.records import FLIGHT
+
+    async def _act_no_census(agent):
+        FLIGHT.record_host_frame("remediation", {"x": 1})
+        return {}
+
+    async def _act_no_flight(agent):
+        CENSUS.snapshot()
+        return {}
+
+    def registry(cfg):
+        return {
+            # no cooldown at all: flaps every supervisor tick
+            "a": Actuator(name="a", rule="r", summary="s",
+                          act=_act_no_census),
+            # zero cooldown: same flap, dressed up
+            "b": Actuator(name="b", rule="r", summary="s",
+                          cooldown_secs=0, act=_act_no_flight),
+            # lambda act: body invisible to the discipline scan
+            "c": Actuator(name="c", rule="r", summary="s",
+                          cooldown_secs=5.0, act=lambda agent: None),
+        }
+"""
+
+
+def test_actuator_discipline_fires_on_seeded_violations(tmp_path):
+    _write(tmp_path, "corrosion_tpu/agent/remed.py", _SLOPPY_ACTUATORS)
+    ctx = AnalysisContext(str(tmp_path))
+    fs = ActuatorDisciplineChecker().run(ctx)
+    # a: no cooldown + act missing the CENSUS drill check;
+    # b: non-positive cooldown + act missing the FLIGHT emit;
+    # c: unresolvable lambda act
+    assert len(fs) == 5, "\n".join(f.render() for f in fs)
+    msgs = "\n".join(f.message for f in fs)
+    assert "without cooldown_secs" in msgs
+    assert "non-positive cooldown_secs=0" in msgs
+    assert "CENSUS.snapshot" in msgs
+    assert "FLIGHT.record_host_frame" in msgs
+    assert "lambda/imported callable" in msgs
+
+
+def test_actuator_discipline_minimal_fix_passes(tmp_path):
+    _write(tmp_path, "corrosion_tpu/agent/remed.py", _DISCIPLINED_ACTUATOR)
+    ctx = AnalysisContext(str(tmp_path))
+    assert ActuatorDisciplineChecker().run(ctx) == []
+
+
+def test_actuator_discipline_accepts_config_sourced_cooldown(tmp_path):
+    # `cooldown_secs=cfg.sync_cooldown_secs` is the idiom in the real
+    # registry — a non-literal expression is the config's contract,
+    # not a violation
+    body = _DISCIPLINED_ACTUATOR.replace(
+        "cooldown_secs=30.0", "cooldown_secs=cfg.sync_cooldown_secs"
+    )
+    _write(tmp_path, "corrosion_tpu/agent/remed.py", body)
+    ctx = AnalysisContext(str(tmp_path))
+    assert ActuatorDisciplineChecker().run(ctx) == []
+
+
+def test_actuator_discipline_ignores_out_of_scope_probes(tmp_path):
+    # tests build synthetic probe actuators on purpose — only the
+    # shipped tree is held to the discipline
+    _write(tmp_path, "tests/test_probe.py", _SLOPPY_ACTUATORS)
+    ctx = AnalysisContext(str(tmp_path))
+    assert ActuatorDisciplineChecker().run(ctx) == []
+
+
+def test_actuator_discipline_noqa_suppresses(tmp_path):
+    body = _SLOPPY_ACTUATORS.replace(
+        '"c": Actuator(name="c", rule="r", summary="s",',
+        '"c": Actuator(  # corro: noqa[actuator-discipline]\n'
+        '              name="c", rule="r", summary="s",',
+    )
+    _write(tmp_path, "corrosion_tpu/agent/remed.py", body)
+    ctx = AnalysisContext(str(tmp_path))
+    result = run_analysis(
+        ctx, [ActuatorDisciplineChecker()], baseline={}
+    )
+    assert len(result.suppressed) == 1
+    assert len(result.new) == 4
+
+
+def test_actuator_discipline_real_tree_is_clean():
+    """The shipped registry (agent/remediation.py) carries the full
+    discipline: positive config-sourced cooldowns, CENSUS drill checks
+    and FLIGHT emits in every act body — this pin keeps it that way."""
+    fs = ActuatorDisciplineChecker().run(AnalysisContext(REPO))
+    assert fs == [], "\n".join(f.render() for f in fs)
+
+
+# -- 10. the metrics fold + baseline machinery ------------------------------
 
 
 def test_metrics_fold_reports_same_inventory():
-    """The lint_metrics fold is lossless: same 236 literal series (218
+    """The lint_metrics fold is lossless: same 242 literal series (218
     at r19 + the 15 r20 alerting-plane series — corro.tsdb.*,
     corro.alerts.*, corro.metrics.{series,cardinality.dropped.total},
     corro.store.write.errors.total — + the 3 r21 write-path series:
     corro.write.finalize.columnar.total and the two
-    corro.write.group.amortized.{flush,txs}.total), same 2 wildcard
+    corro.write.group.amortized.{flush,txs}.total, + the 6 r22
+    remediation-plane series: corro.remediation.{actions.total,
+    skips.total, reverts.total, armed},
+    corro.sync.targeted.rounds.total and
+    corro.digest.degraded.total — the oversize-digest degrade the A/B
+    harness forced), same 2 wildcard
     sites, both
     directions clean, via BOTH the framework checker and the
     back-compat shim."""
@@ -849,7 +969,7 @@ def test_metrics_fold_reports_same_inventory():
     assert MetricsDocChecker().run(AnalysisContext(REPO)) == []
     assert lint_metrics.lint() == []
     literals, wildcards = lint_metrics.scan_call_sites()
-    assert len(literals) == 236
+    assert len(literals) == 242
     assert len(wildcards) == 2
     names = lint_metrics.parse_components_table()
     assert len(names) == len(set(names))

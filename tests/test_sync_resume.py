@@ -159,15 +159,33 @@ def test_cold_node_snapshot_bootstrap_converges():
         net = MemNetwork(seed=7)
         a = await boot(net, "agent-a")
         await load_versions(a, 30, rows_per=3)
-        await asyncio.sleep(0.7)  # let the broadcast backlog expire
+        # wait for the broadcast backlog to DRAIN, not a fixed sleep:
+        # the pending heap's decaying resend schedule (~1.4 s at the
+        # n=1 transmission budget) outlives a 0.7 s nap, and a
+        # surviving backlog floods the cold joiner with every version —
+        # rows converge by broadcast and the snapshot path never runs.
+        # The settle nap first: freshly-queued changes take one loop
+        # interval to even REACH the pending heap's gauge
+        await asyncio.sleep(0.3)
+        assert await wait_until(
+            lambda: peek("corro.broadcast.pending.count") == 0,
+            timeout=10,
+        )
         installs0 = peek("corro.snapshot.install.total")
         serves0 = peek("corro.snapshot.serve.total")
         cfg = fast_config("agent-c", bootstrap=("agent-a",))
         cfg.sync.snapshot_min_gap_versions = 10
         c = await boot(net, "agent-c", bootstrap=("agent-a",), cfg=cfg)
         try:
+            # the install is the thing under test — wait for IT, not
+            # for row convergence (the delta top-up can land the last
+            # rows while the swap is still mid-flight)
+            assert await wait_until(
+                lambda: peek("corro.snapshot.install.total")
+                == installs0 + 1,
+                timeout=60,
+            )
             assert await wait_until(lambda: count_rows(c) == 90, timeout=60)
-            assert peek("corro.snapshot.install.total") == installs0 + 1
             assert peek("corro.snapshot.serve.total") == serves0 + 1
             assert c.catchup_census.get("state") == "installed"
             assert c.catchup_census.get("watermark_versions", 0) >= 30
