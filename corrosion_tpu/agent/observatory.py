@@ -156,6 +156,15 @@ class Observatory:
         eng = getattr(self.agent, "alerts", None)
         alerts = eng.active_summaries() if eng is not None else []
 
+        # r23: the node's top self-time profile frames ride too — the
+        # cluster-scope hotspot table any node serves.  First tier shed
+        # under the wire-budget ladder (build_and_store): color, not
+        # core.
+        from corrosion_tpu.runtime import profiler as _profiler
+
+        prof = _profiler.get()
+        hotspots = prof.hotspots() if prof is not None else []
+
         with self._lock:
             self._seq += 1
             seq = self._seq
@@ -173,6 +182,7 @@ class Observatory:
             sync_backlog=backlog,
             heads_total=max(0, heads_total),
             alerts=alerts,
+            hotspots=hotspots,
             events=events,
             stages=lat.stage_hists(window_secs=None),
         )
@@ -198,10 +208,18 @@ class Observatory:
         crossed — with an open divergence episode inflating the alert
         block, oversize is self-sustaining (no digests → silence →
         episode stays open → alert block stays on).  Degrade tiers keep
-        the view/census core shipping: drop the non-total stage
-        histograms first, then all stages/events and the alert tail."""
+        the view/census core shipping: drop the profile hotspots first
+        (r23 — flamegraph color, never load-bearing), then the
+        non-total stage histograms, then all stages/events and the
+        alert tail."""
         d = self.snapshot_local()
         enc = encode_digest(d)
+        if len(enc) > self.cfg.max_wire_bytes and d.hotspots:
+            d.hotspots = []
+            enc = encode_digest(d)
+            METRICS.counter(
+                "corro.digest.degraded.total", level="profile"
+            ).inc()
         if len(enc) > self.cfg.max_wire_bytes:
             d.stages = {k: v for k, v in d.stages.items() if k == "total"}
             enc = encode_digest(d)
@@ -441,6 +459,53 @@ class Observatory:
         for row in rollup.values():
             row["firing"].sort()
             row["pending"].sort()
+        return {
+            "actor_id": str(self.agent.actor_id),
+            "scope": "cluster",
+            "coverage": {
+                "known": len(nodes),
+                "fresh": sum(1 for n in nodes.values() if n["fresh"]),
+                "stale_after_secs": stale_after,
+            },
+            "rollup": rollup,
+            "nodes": nodes,
+        }
+
+    def cluster_hotspots(self) -> dict:
+        """What `GET /v1/profile?scope=cluster` serves: every node's
+        digest-carried top self-time frames plus a cluster-merged
+        hotspot table — from ANY single node, over the anti-entropy
+        store.  Same rebuild-at-read + fresh-only-rollup discipline as
+        cluster_alerts; a node whose digest shed its hotspot block
+        under the wire-budget ladder simply contributes none."""
+        self.build_and_store()
+        now_mono = time.monotonic()
+        stale_after = self.cfg.stale_after_secs
+        nodes: Dict[str, dict] = {}
+        merged: Dict[str, int] = {}
+        with self._lock:  # snapshot vs the worker-thread builder
+            held_all = list(self._store.values())
+        for held in held_all:
+            d = held.digest
+            age = now_mono - held.received_mono
+            name = str(ActorId(d.actor_id))
+            nodes[name] = {
+                "age_secs": round(age, 3),
+                "fresh": age <= stale_after,
+                "hotspots": list(d.hotspots),
+            }
+            if age > stale_after:
+                continue  # stale digests list but never roll up
+            for h in d.hotspots:
+                merged[h["frame"]] = (
+                    merged.get(h["frame"], 0) + int(h["samples"])
+                )
+        rollup = [
+            {"frame": fr, "samples": n}
+            for fr, n in sorted(
+                merged.items(), key=lambda kv: (-kv[1], kv[0])
+            )
+        ]
         return {
             "actor_id": str(self.agent.actor_id),
             "scope": "cluster",

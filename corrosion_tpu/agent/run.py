@@ -41,6 +41,7 @@ from corrosion_tpu.agent.syncer import serve_sync, sync_loop
 from corrosion_tpu.net.mem import MemNetwork
 from corrosion_tpu.net.tcp import TcpListener, TcpTransport, split_addr
 from corrosion_tpu.net.transport import BiStream
+from corrosion_tpu.runtime import profiler as _rt_profiler
 from corrosion_tpu.runtime.channels import bounded
 from corrosion_tpu.runtime.config import Config
 from corrosion_tpu.runtime.metrics import METRICS
@@ -286,6 +287,20 @@ async def setup(
             agent, cfg=config.remediation
         )
 
+    # r23 continuous profiling plane: the wall-clock stack sampler is
+    # process-global like the TSDB (first agent's [profile] knobs win);
+    # the loop thread registers itself in run() so samples carry
+    # subsystem;task prefixes
+    if config.profile.enabled:
+        _rt_profiler.ensure(
+            hz=config.profile.hz,
+            shed_hz=config.profile.shed_hz,
+            max_overhead_pct=config.profile.max_overhead_pct,
+            window_secs=config.profile.window_secs,
+            slots=config.profile.slots,
+            max_stacks=config.profile.max_stacks,
+        )
+
     # r12 cluster observatory: telemetry digests piggyback the gossip
     # datagrams (hooks below) + broadcast envelopes (broadcast_loop);
     # received digests feed the anti-entropy store behind /v1/cluster
@@ -366,6 +381,12 @@ async def run(agent: Agent) -> None:
 
     agent.listener.serve(on_datagram, on_uni, on_bi)
     agent.membership.start(agent.tripwire)
+    # r23: register THIS loop thread with the continuous profiler so
+    # its samples resolve the running asyncio task name (runs here, on
+    # the loop thread, because the mapping is tid→loop)
+    _prof = _rt_profiler.get()
+    if _prof is not None:
+        _prof.register_loop_coldpath()
     if agent.subs is not None:
         await agent.subs.restore()  # setup.rs:296-349
     t = agent.tracker
@@ -725,6 +746,17 @@ class _GroupItem:
     # True once the leader's single fanout pass covered this tx's
     # hooks+chunk+broadcast (the caller must then skip its own block)
     fanned: bool = False
+    # r23 write-profile stamps (monotonic): the leader/commit thread
+    # fill these so submit() can attribute the full submit→resolve wall
+    # across {asyncio dispatch, write gate, to_thread hop, finalize,
+    # sqlite flush} (corro.write.profile.seconds → WRITE_PROFILE.json)
+    gate_start: float = 0.0
+    gate_acq: float = 0.0
+    dispatch: float = 0.0
+    thread_start: float = 0.0
+    thread_done: float = 0.0
+    finalize_secs: float = 0.0
+    flush_secs: float = 0.0
 
 
 class GroupCommitter:
@@ -790,7 +822,21 @@ class GroupCommitter:
                 await self._lead()
             finally:
                 self._release_leadership()
-        return await item.fut
+        res = await item.fut
+        # r23: bank the five-bucket wall attribution when the continuous
+        # profiler is installed (one global None-check otherwise)
+        if _rt_profiler.installed():
+            _rt_profiler.record_write_buckets(
+                enq=item.enq,
+                gate_start=item.gate_start,
+                gate_acq=item.gate_acq,
+                dispatch=item.dispatch,
+                thread_start=item.thread_start,
+                thread_done=item.thread_done,
+                resolved=_time.monotonic(),
+                finalize_secs=item.finalize_secs,
+            )
+        return res
 
     def _release_leadership(self) -> None:
         self._leader = False
@@ -807,6 +853,8 @@ class GroupCommitter:
             self._release_leadership()
 
     async def _lead(self) -> None:
+        import time as _time
+
         agent = self.agent
         perf = agent.config.perf
         amortized = _group_fanout_enabled(perf)
@@ -825,8 +873,10 @@ class GroupCommitter:
                 await asyncio.sleep(0)
             batch: List[_GroupItem] = []
             commit_job = None
+            t_gate = _time.monotonic()  # r23 write-profile stamp
             try:
                 async with agent.write_gate.priority():
+                    t_acq = _time.monotonic()
                     if (
                         perf.group_commit_wait > 0
                         and len(self._pending) == 1
@@ -838,6 +888,11 @@ class GroupCommitter:
                         and len(batch) < perf.group_commit_max_writers
                     ):
                         batch.append(self._pending.popleft())
+                    t_dispatch = _time.monotonic()
+                    for it in batch:
+                        it.gate_start = t_gate
+                        it.gate_acq = t_acq
+                        it.dispatch = t_dispatch
                     commit_job = asyncio.ensure_future(
                         asyncio.to_thread(self._commit_batch, batch)
                     )
@@ -976,6 +1031,7 @@ class GroupCommitter:
         max_bytes = agent.config.perf.group_commit_max_bytes
         booked = agent.bookie.ensure(agent.actor_id)
         committed: List[_GroupItem] = []
+        t_thread = _time.monotonic()  # r23: the to_thread hop landed
         # a SOLO batch skips the per-writer savepoint (r15): with one
         # writer there are no batchmates to isolate, and its failure
         # aborts the whole group tx below — the uncontended fast path
@@ -1017,15 +1073,18 @@ class GroupCommitter:
                         finalized = store.finalize_group(
                             [(p, it.ts) for it, p in group]
                         )
+                        fin_dur = _time.monotonic() - t0
                         METRICS.histogram(
                             "corro.write.finalize.seconds"
-                        ).observe(_time.monotonic() - t0)
+                        ).observe(fin_dur)
+                        fin_share = fin_dur / max(1, len(group))
                         for (it, _p), (changes, dv, last_seq) in zip(
                             group, finalized
                         ):
                             it.changes = changes
                             it.db_version = dv
                             it.last_seq = last_seq
+                            it.finalize_secs = fin_share
                 except BaseException as e:
                     # the shared finalize/COMMIT died: every sub-tx in
                     # this group rolled back with it (a failed
@@ -1039,6 +1098,11 @@ class GroupCommitter:
                         it.db_version = 0
                     continue
                 committed.extend(it for it, _p in group)
+                # r23: per-item share of the group's COMMIT flush wall
+                # (crdt.group_tx stamps last_flush_secs on exit)
+                flush_share = store.last_flush_secs / max(1, len(group))
+                for it, _p in group:
+                    it.flush_secs = flush_share
                 METRICS.histogram("corro.write.group.size").observe(
                     len(group)
                 )
@@ -1052,6 +1116,8 @@ class GroupCommitter:
                 bv.commit_snapshot(snap)
         now = _time.monotonic()
         for it in committed:
+            it.thread_start = t_thread
+            it.thread_done = now
             METRICS.histogram("corro.write.group.wait.seconds").observe(
                 now - it.enq
             )

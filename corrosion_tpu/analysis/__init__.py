@@ -23,6 +23,14 @@ Rules (see COMPONENTS.md "Static analysis" for the full table):
                     write path and a compat test
     metrics-doc     emitted series <-> COMPONENTS.md observability table
                     (both directions; the former scripts/lint_metrics.py)
+    capture-parity  trigger DDL <-> direct-capture lockstep (r15)
+    timeout-discipline  network awaits in agent//api/ carry wait_for
+                    deadlines (r18: the zombie-node hang class)
+    actuator-discipline  remediation actuators declare cooldown /
+                    max_per_hour / reversibility and honor dry-run (r22)
+    profiler-safety code reachable from the stack sampler's hot path
+                    takes no lock but _fold_lock, calls no asyncio and
+                    allocates nothing per sample (r23)
 """
 
 from corrosion_tpu.analysis.core import (  # noqa: F401

@@ -227,6 +227,7 @@ def all_checkers() -> List[Checker]:
     from corrosion_tpu.analysis.lockcheck import LockDisciplineChecker
     from corrosion_tpu.analysis.metricsdoc import MetricsDocChecker
     from corrosion_tpu.analysis.parity import LaneParityChecker
+    from corrosion_tpu.analysis.profiler_safety import ProfilerSafetyChecker
     from corrosion_tpu.analysis.purity import KernelPurityChecker
     from corrosion_tpu.analysis.timeouts import TimeoutDisciplineChecker
 
@@ -240,6 +241,7 @@ def all_checkers() -> List[Checker]:
         MetricsDocChecker(),
         TimeoutDisciplineChecker(),
         ActuatorDisciplineChecker(),
+        ProfilerSafetyChecker(),
     ]
 
 

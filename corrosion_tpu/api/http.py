@@ -79,6 +79,13 @@ class _Limit:
         self._sem.release()
 
 
+def _profile_census() -> dict:
+    from corrosion_tpu.runtime import profiler
+
+    prof = profiler.get()
+    return prof.census() if prof is not None else {"enabled": False}
+
+
 class ApiServer:
     def __init__(self, agent: Agent, subs=None, updates=None):
         self.agent = agent
@@ -107,6 +114,7 @@ class ApiServer:
         app.router.add_get("/v1/traces", self.h_traces)
         app.router.add_get("/v1/alerts", self.h_alerts)
         app.router.add_get("/v1/remediation", self.h_remediation)
+        app.router.add_get("/v1/profile", self.h_profile)
         return app
 
     async def start(self) -> None:
@@ -300,7 +308,7 @@ class ApiServer:
                         timer.daemon = True
                         timer.start()
                     try:
-                        with timed_query(stmt.query):
+                        with timed_query(stmt.query, shape="query:api"):
                             cur = conn.execute(
                                 stmt.query, _bind_params(stmt)
                             )
@@ -529,6 +537,10 @@ class ApiServer:
                 if agent.remediation is not None
                 else {"enabled": False}
             ),
+            # r23 continuous-profiling census: sampler rate/shed state,
+            # measured overhead, held windows (flamegraphs live at
+            # GET /v1/profile)
+            "profile": _profile_census(),
             # r11 SLO plane pointer: the canary's live numbers (full
             # per-stage percentiles live at GET /v1/slo)
             "slo": {
@@ -781,6 +793,44 @@ class ApiServer:
         )
         report["actor_id"] = str(self.agent.actor_id)
         return web.json_response(report)
+
+    async def h_profile(self, request: web.Request) -> web.Response:
+        """Continuous profiling plane (r23): the always-on wall-clock
+        stack sampler's folded output.  `?window=` bounds the lookback
+        in seconds (default 60); `?format=folded` serves the collapsed-
+        stack text every flamegraph tool ingests, `?format=speedscope`
+        a speedscope.app document, default JSON a summary (top self-time
+        frames, statement-shape table, overhead gauge, census).
+        `?scope=cluster` serves the digest-carried per-node hotspot
+        rollup — any node answers for the whole cluster."""
+        from corrosion_tpu.runtime import profiler
+
+        if request.query.get("scope") == "cluster":
+            obs = self.agent.observatory
+            if obs is None:
+                raise web.HTTPNotImplemented(
+                    text="cluster observatory disabled "
+                         "([cluster] digests=false)"
+                )
+            return web.json_response(obs.cluster_hotspots())
+        prof = profiler.get()
+        if prof is None:
+            return web.json_response({"enabled": False})
+        try:
+            window = float(request.query.get("window", "60"))
+        except ValueError:
+            raise web.HTTPBadRequest(text="window must be a number")
+        fmt = request.query.get("format", "json")
+        if fmt not in ("json", "folded", "speedscope"):
+            raise web.HTTPBadRequest(
+                text="format must be json|folded|speedscope"
+            )
+        out = prof.export(window_secs=window, fmt=fmt)
+        if fmt == "folded":
+            return web.Response(text=out, content_type="text/plain")
+        if isinstance(out, dict) and fmt == "json":
+            out["actor_id"] = str(self.agent.actor_id)
+        return web.json_response(out)
 
     async def h_cluster(self, request: web.Request) -> web.Response:
         """Cluster observatory plane (r12): the CLUSTER-wide answer any

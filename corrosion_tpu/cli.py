@@ -127,6 +127,22 @@ def _build_parser() -> argparse.ArgumentParser:
     al.add_argument("--json", action="store_true",
                     help="raw JSON instead of the table render")
 
+    pr = sub.add_parser(
+        "profile",
+        help="fetch the node's continuous profile (GET /v1/profile)",
+    )
+    pr.add_argument("--window", type=float, default=60.0,
+                    help="lookback seconds (default 60)")
+    pr.add_argument("--cluster", action="store_true",
+                    help="cluster scope: every node's digest-carried "
+                         "hotspot frames + merged rollup")
+    pr.add_argument("--speedscope", metavar="PATH", default=None,
+                    help="write a speedscope.app document to PATH")
+    pr.add_argument("--folded", action="store_true",
+                    help="print collapsed-stack text (flamegraph input)")
+    pr.add_argument("--json", action="store_true",
+                    help="raw JSON instead of the table render")
+
     actor = sub.add_parser("actor").add_subparsers(dest="sub", required=True)
     av = actor.add_parser("version")
     av.add_argument("actor_id")
@@ -642,6 +658,86 @@ async def _cmd_alerts(cfg: Config, args) -> int:
     return 0
 
 
+async def _cmd_profile(cfg: Config, args) -> int:
+    """Operator fetch of GET /v1/profile: the continuous profiler's
+    top self-time frames + statement-shape table (default render),
+    collapsed-stack text with --folded, a speedscope.app file with
+    --speedscope PATH, or the cluster hotspot rollup with --cluster."""
+    import aiohttp
+
+    params = {"window": str(args.window)}
+    if args.cluster:
+        params["scope"] = "cluster"
+    elif args.speedscope:
+        params["format"] = "speedscope"
+    elif args.folded:
+        params["format"] = "folded"
+    url = f"http://{_api_addr(cfg)}/v1/profile"
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                url, params=params, timeout=aiohttp.ClientTimeout(total=10)
+            ) as resp:
+                if params.get("format") == "folded":
+                    body = await resp.text()
+                else:
+                    body = await resp.json()
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        print(f"could not reach {url}: {e}", file=sys.stderr)
+        return 1
+    if args.cluster:
+        if args.json:
+            print(json.dumps(body, indent=2))
+            return 0
+        cov = body.get("coverage", {})
+        print(
+            f"cluster hotspots from {body.get('actor_id')}: "
+            f"{cov.get('known', 0)} node(s) known, "
+            f"{cov.get('fresh', 0)} fresh"
+        )
+        rollup = body.get("rollup", [])
+        if not rollup:
+            print("no hotspot frames cluster-wide")
+            return 0
+        print(f"{'samples':>8}  frame")
+        for row in rollup:
+            print(f"{row['samples']:>8}  {row['frame']}")
+        return 0
+    if args.speedscope:
+        with open(args.speedscope, "w") as f:
+            json.dump(body, f)
+        n = len(body.get("shared", {}).get("frames", []))
+        print(f"wrote speedscope profile ({n} frames) to {args.speedscope}")
+        return 0
+    if args.folded:
+        sys.stdout.write(body)
+        return 0
+    if args.json:
+        print(json.dumps(body, indent=2))
+        return 0
+    if not body.get("enabled"):
+        print("profiling plane disabled ([profile] enabled=false)")
+        return 0
+    shed = " SHED" if body.get("shed") else ""
+    print(
+        f"{body.get('samples', 0)} samples over {args.window:g}s at "
+        f"{body.get('hz', 0):g} Hz{shed}, overhead "
+        f"{body.get('overhead_pct', 0.0):.3f}%"
+    )
+    print(f"{'self':>8}  frame")
+    for row in body.get("top_self", []):
+        print(f"{row['samples']:>8}  {row['frame']}")
+    stmt = body.get("stmt", [])
+    if stmt:
+        print(f"\n{'count':>8} {'total_ms':>10}  statement shape")
+        for row in stmt:
+            print(
+                f"{row['count']:>8} {row['total_secs'] * 1e3:>10.3f}  "
+                f"{row['shape']}"
+            )
+    return 0
+
+
 async def _cmd_template(cfg: Config, args) -> int:
     from corrosion_tpu.tpl import render_specs, watch_specs
 
@@ -716,6 +812,8 @@ async def _amain(argv: Optional[List[str]] = None) -> int:
         return await _cmd_traces(cfg, args)
     if cmd == "alerts":
         return await _cmd_alerts(cfg, args)
+    if cmd == "profile":
+        return await _cmd_profile(cfg, args)
     if cmd == "actor":
         return await _admin_call(
             cfg,

@@ -245,6 +245,26 @@ class CorrosionApiClient:
                 raise ClientError(200, ev)
         return rows
 
+    async def profile(
+        self, window: Optional[float] = None, format: str = "json"
+    ) -> Any:
+        """Continuous-profiling plane (r23): the node's folded-stack
+        profile.  `format="json"` (default) returns the summary dict,
+        `"speedscope"` the speedscope.app document (dict),
+        `"folded"` collapsed-stack text (str)."""
+        s = await self._ensure()
+        params: Dict[str, str] = {"format": format}
+        if window is not None:
+            params["window"] = str(float(window))
+        async with s.get(
+            f"{self.base}/v1/profile", params=params
+        ) as resp:
+            if resp.status >= 400:
+                raise ClientError(resp.status, await resp.text())
+            if format == "folded":
+                return await resp.text()
+            return await resp.json()
+
     # -- streams -----------------------------------------------------------
 
     def subscribe(

@@ -609,12 +609,19 @@ class Matcher:
         only DML against `sub.query` is rowid-keyed.  A tier-1 trace
         pin (tests/test_pubsub_perf.py) holds the per-batch statement
         count equal across table sizes."""
+        from corrosion_tpu.runtime.trace import timed_query
+
         conn = self._conn
         assert conn is not None
         plans = self._plans
         events: List[SubEvent] = []
         start = time.monotonic()
-        with self._conn_lock:
+        # r23 statement profiler: the whole batch diff is ONE shape —
+        # its statements are precomputed plans, so per-statement keys
+        # would only split a fixed pipeline across meaningless rows
+        with self._conn_lock, timed_query(
+            "subs batch diff", shape="match:batch"
+        ):
             conn.execute("BEGIN")
             try:
                 for table, pks in candidates.items():

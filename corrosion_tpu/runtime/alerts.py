@@ -132,6 +132,15 @@ DEFAULT_RULES = (
         "summary": "local write transactions failing at the store "
                    "(sick disk)",
     },
+    {
+        "name": "commit-stall",
+        "kind": "rate",
+        "series": "corro.store.commit.stall.total",
+        "op": ">", "value": 0.5, "for_secs": 4.0,
+        "severity": "page",
+        "summary": "sqlite COMMIT walls stalling past the flush budget "
+                   "(slow disk)",
+    },
 )
 
 
@@ -193,7 +202,7 @@ class AlertRule:
 
 class _RuleState:
     __slots__ = ("state", "since_mono", "since_wall", "value", "drill",
-                 "trace_ids", "incident")
+                 "trace_ids", "incident", "profile")
 
     def __init__(self):
         self.state = "ok"  # ok | pending | firing
@@ -203,6 +212,7 @@ class _RuleState:
         self.drill: Optional[str] = None
         self.trace_ids: List[str] = []
         self.incident: Optional[str] = None
+        self.profile: Optional[dict] = None
 
 
 class AlertEngine:
@@ -337,6 +347,7 @@ class AlertEngine:
                     st.drill = None
                     st.trace_ids = []
                     st.incident = None
+                    st.profile = None
         for name in fired:
             self._on_fire(name, wall)
         for name in resolved:
@@ -355,6 +366,7 @@ class AlertEngine:
 
     def _on_fire(self, name: str, wall: float) -> None:
         from corrosion_tpu.chaos.faults import CENSUS
+        from corrosion_tpu.runtime import profiler
         from corrosion_tpu.runtime import tracestore
         from corrosion_tpu.runtime.records import FLIGHT
 
@@ -371,10 +383,22 @@ class AlertEngine:
         )
         # black-box dump for PAGES only: a warn-level alert flapping on
         # a loaded host (loop-lag on a busy 1-core box) must not write
-        # a multi-MB frame history per episode per node
+        # a multi-MB frame history per episode per node.  A page also
+        # grabs the continuous profiler's hot window (r23) — the
+        # incident answers "WHERE was the time going when this fired",
+        # not just "what were the lanes doing".
+        profile = None
+        if rule.severity == "page":
+            prof = profiler.get()
+            if prof is not None:
+                try:
+                    profile = prof.capture(f"alert_{name}")
+                except Exception:
+                    log.exception("profile capture failed for %s", name)
         incident = (
             FLIGHT.snapshot_incident(
-                f"alert_{name}", registry=self.registry
+                f"alert_{name}", registry=self.registry,
+                extra={"profile": profile} if profile else None,
             )
             if rule.severity == "page" else None
         )
@@ -383,6 +407,7 @@ class AlertEngine:
             st.drill = drill
             st.trace_ids = trace_ids
             st.incident = incident
+            st.profile = profile
             value = st.value
             self._history.append({
                 "rule": name, "event": "fired", "wall": wall,
@@ -434,6 +459,7 @@ class AlertEngine:
             "drill": st.drill,
             "trace_ids": list(st.trace_ids),
             "incident": st.incident,
+            "profile": dict(st.profile) if st.profile else None,
             "summary": rule.summary,
         }
 

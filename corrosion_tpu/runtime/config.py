@@ -213,6 +213,31 @@ class TsdbConfig:
 
 
 @dataclass
+class ProfileConfig:
+    """[profile] — the continuous profiling plane (runtime/profiler.py
+    + runtime/profstore.py, r23).  An always-on daemon-thread stack
+    sampler walks every thread at `hz` (wall-clock sampling), folding
+    classified stacks into a bounded ring of `slots` windows of
+    `window_secs` each; when the sampler's own measured duty cycle
+    exceeds `max_overhead_pct` it auto-sheds to `shed_hz`
+    (`corro.profile.shed.total`) and recovers with hysteresis — Prime
+    CCL discipline: the plane degrades itself, never the serving path.
+    The statement-shape profiler (`corro.store.stmt.seconds{shape=}`)
+    rides the same install.  Served as `GET /v1/profile?window=…&
+    format=folded|speedscope`; alert firings pin the hot window to
+    their flight-recorder incident.  Process-global: the first agent's
+    knobs win (the tsdb/tracestore contract)."""
+
+    enabled: bool = True
+    hz: float = 67.0
+    shed_hz: float = 11.0
+    max_overhead_pct: float = 1.0
+    window_secs: float = 5.0
+    slots: int = 24  # ring depth (24 × 5 s = 2 min of hot windows)
+    max_stacks: int = 512  # distinct folded stacks per window
+
+
+@dataclass
 class AlertsConfig:
     """[alerts] — declarative anomaly rules over the TSDB
     (runtime/alerts.py, r20).  `rules` is a list of
@@ -462,6 +487,7 @@ class Config:
     sync: SyncConfig = field(default_factory=SyncConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
     tsdb: TsdbConfig = field(default_factory=TsdbConfig)
+    profile: ProfileConfig = field(default_factory=ProfileConfig)
     alerts: AlertsConfig = field(default_factory=AlertsConfig)
     remediation: RemediationConfig = field(default_factory=RemediationConfig)
 

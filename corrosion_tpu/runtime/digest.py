@@ -93,6 +93,14 @@ class NodeDigest:
     # block after `heads_total` with the same eof tolerance: old
     # decoders stop before it, new decoders default to [] on eof.
     alerts: List[dict] = field(default_factory=list)
+    # r23: this node's top self-time profile frames
+    # (runtime/profiler.py `hotspots`: frame display name + sample
+    # count) — how `GET /v1/profile?scope=cluster` serves a
+    # cluster-scope hotspot table from ANY node.  Third TRAILING block
+    # after `alerts`, same eof tolerance; under the wire-budget ladder
+    # it is the FIRST tier shed (agent/observatory.py) — profile color
+    # yields to view/census core.
+    hotspots: List[dict] = field(default_factory=list)
     # device kernel event totals (corro.kernel.events.total), summed
     # across kernels — empty on agents that host no kernel sim
     events: Dict[str, int] = field(default_factory=dict)
@@ -171,6 +179,11 @@ def encode_digest(d: NodeDigest) -> bytes:
         )
         w.f64(float(a.get("since") or 0.0))
         w.f64(float(a.get("value") or 0.0))
+    # r23 trailing hotspot block (default_on_eof like the two above)
+    w.uvarint(len(d.hotspots))
+    for h in d.hotspots:
+        w.string(h["frame"])
+        w.uvarint(int(h.get("samples") or 0))
     return w.bytes()
 
 
@@ -213,6 +226,12 @@ def decode_digest(data: bytes) -> NodeDigest:
                 "drill": bool(state & 0x80),
                 "since": r.f64(),
                 "value": r.f64(),
+            })
+    if not r.eof():
+        for _ in range(r.uvarint()):
+            d.hotspots.append({
+                "frame": r.string(),
+                "samples": r.uvarint(),
             })
     return d
 

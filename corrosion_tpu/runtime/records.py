@@ -232,6 +232,7 @@ class FlightRecorder:
         reason: str,
         path: Optional[str] = None,
         registry: Registry = METRICS,
+        extra: Optional[dict] = None,
     ) -> Optional[str]:
         """Black-box dump: write the whole frame history to a JSON file
         and return its path (None when there is nothing to dump).
@@ -241,7 +242,12 @@ class FlightRecorder:
         the tripwire but is not an incident), so this must never raise.
         Files go to $CORRO_FLIGHT_DIR (default: a `corrosion_flight/`
         dir under the system tempdir) and the sequence wraps at 16 per
-        process — a bounded black box, like the real instrument."""
+        process — a bounded black box, like the real instrument.
+
+        `extra` merges caller-supplied JSON-safe keys into the record —
+        the alert engine pins the continuous profiler's hot-window
+        capture here (r23), so an incident carries WHERE the time went,
+        not just what the lanes recorded."""
         with self._lock:
             frames = list(self._frames)
             seq = self._incident_seq
@@ -256,6 +262,8 @@ class FlightRecorder:
             "crdt_lanes": list(CRDT_MERGE_EVENTS),
             "frames": frames,
         }
+        if extra:
+            record.update(extra)
         try:
             d = os.environ.get("CORRO_FLIGHT_DIR") or os.path.join(
                 tempfile.gettempdir(), "corrosion_flight"

@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Optional
 
 from corrosion_tpu.runtime import otel
+from corrosion_tpu.runtime import profiler as _profiler
 from corrosion_tpu.runtime.metrics import METRICS
 
 log = logging.getLogger(__name__)
@@ -268,10 +269,19 @@ SLOW_QUERY_S = 1.0
 class timed_query:
     """Logs any wrapped block slower than 1 s with its SQL — the analog of
     the reference's sqlite trace_v2 slow-query hook
-    (`klukai-types/src/sqlite.rs:55-65`)."""
+    (`klukai-types/src/sqlite.rs:55-65`).
 
-    def __init__(self, sql: str):
+    r23: this IS the statement profiler's tap.  A caller that knows its
+    statement's shape (the r15 capture-shape key on the write path,
+    class labels like "apply:batch" / "match:batch" / "query:api"
+    elsewhere) passes `shape=`, and when the continuous profiler is
+    installed every exit feeds `corro.store.stmt.seconds{shape=}` plus
+    the /v1/profile statement table — uninstalled, the hook is one
+    module-global read."""
+
+    def __init__(self, sql: str, shape: Optional[str] = None):
         self.sql = sql
+        self.shape = shape
         self._start = 0.0
 
     def __enter__(self) -> "timed_query":
@@ -280,6 +290,8 @@ class timed_query:
 
     def __exit__(self, *exc) -> None:
         elapsed = time.monotonic() - self._start
+        if self.shape is not None:
+            _profiler.record_stmt(self.shape, elapsed)
         if elapsed >= SLOW_QUERY_S:
             METRICS.counter("corro_slow_queries_total").inc()
             log.warning("slow query (%.3fs): %s", elapsed, self.sql[:500])

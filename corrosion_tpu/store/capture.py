@@ -42,7 +42,7 @@ statement runs through the triggers exactly as before this round.
 from __future__ import annotations
 
 import re
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from corrosion_tpu.types.change import SENTINEL
@@ -429,6 +429,10 @@ class Shape:
     # update / delete
     set_slots: Tuple[Tuple[str, object], ...] = ()
     pk_slots: Tuple[object, ...] = ()  # aligned to meta.pk_cols
+    # r23 statement-profiler key ("kind:table"), precomputed once per
+    # cached shape so the per-statement timed_query tap never builds a
+    # string on the hot write path
+    stmt_key: str = ""
 
 
 def parse_shape(sql: str, schema) -> Optional[Shape]:
@@ -451,7 +455,7 @@ def parse_shape(sql: str, schema) -> Optional[Shape]:
         return None
     if shape.uses_pos and shape.uses_named:
         return None  # mixed param styles — let sqlite sort it out
-    return shape
+    return replace(shape, stmt_key=f"{shape.kind}:{shape.meta.name}")
 
 
 def _schema_table(toks: _Cur, schema):

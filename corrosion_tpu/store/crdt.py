@@ -165,6 +165,23 @@ def _safe_rollback(conn: sqlite3.Connection) -> None:
         log.debug("rollback raced with auto-rollback: %s", e)
 
 
+# r23: COMMIT-wall observability.  The outer COMMIT (WAL flush) is the
+# write path's disk-bound tail; every commit observes its wall, and a
+# commit slower than _COMMIT_STALL_S counts a STALL EVENT — the monotone
+# counter the `commit-stall` page rule rates over.  (A flush-wall gauge
+# would thrash between fast and slow stores sharing the process-global
+# registry in the sim; a rate over a monotone counter cannot.)
+_COMMIT_STALL_S = 0.025
+
+
+def _observe_commit_flush(secs: float) -> None:
+    from corrosion_tpu.runtime.metrics import METRICS
+
+    METRICS.histogram("corro.store.commit.flush.seconds").observe(secs)
+    if secs >= _COMMIT_STALL_S:
+        METRICS.counter("corro.store.commit.stall.total").inc()
+
+
 def _clock_table(t: str) -> str:
     return f"{t}__crdt_clock"
 
@@ -492,6 +509,12 @@ class CrdtStore:
         # remote-apply touch points — None (the default) costs one
         # attribute check on each
         self.chaos = None
+        # r23: wall of the most recent outer COMMIT (chaos latency
+        # included — the injected sleep stands in for a slow fsync).
+        # Read by the group committer's write-profile bucket stamps;
+        # written under the store lock, so reads after group_tx exits
+        # see this group's value
+        self.last_flush_secs = 0.0
         # own/remote head-version cache: db_version_for is on every
         # commit's path, and the value only changes through
         # _bump_db_version (cache updated there) — cleared on rollback
@@ -1424,6 +1447,9 @@ class CrdtStore:
             self._conn.execute("BEGIN IMMEDIATE")
             try:
                 yield self
+                import time as _time
+
+                t0 = _time.monotonic()
                 if self.chaos is not None:
                     # r18 slow/sick-disk injection: commit latency and
                     # transient I/O errors land HERE, where a real disk
@@ -1431,6 +1457,8 @@ class CrdtStore:
                     # every writer gets a typed error
                     self.chaos.on_commit()
                 self._conn.execute("COMMIT")
+                self.last_flush_secs = _time.monotonic() - t0
+                _observe_commit_flush(self.last_flush_secs)
             except BaseException:
                 _safe_rollback(self._conn)
                 self._dv_cache.clear()  # bumps may have rolled back
@@ -1648,6 +1676,8 @@ class CrdtStore:
             # r18 slow-disk injection on the ingest path: a sick-disk
             # node falls behind the cluster, not just its own clients
             self.chaos.on_apply()
+        from corrosion_tpu.runtime.trace import timed_query
+
         with self._lock:
             self._conn.execute("BEGIN IMMEDIATE")
             # gate triggers off for the remote apply — a Python store,
@@ -1657,7 +1687,11 @@ class CrdtStore:
             # cannot fail)
             self._capture_flag[0] = 0
             try:
-                impactful = self._apply_batch(changes, changed_tables)
+                # r23 statement profiler: the batched merge is ONE
+                # shape — bulk reads + executemany flush, no useful
+                # per-statement split
+                with timed_query("apply batch", shape="apply:batch"):
+                    impactful = self._apply_batch(changes, changed_tables)
                 site_max: Dict[bytes, int] = {}
                 for ch in changes:
                     if ch.db_version > site_max.get(ch.site_id, 0):
@@ -2638,7 +2672,7 @@ class WriteTx:
         from corrosion_tpu.runtime.trace import timed_query
 
         self._ensure_capture(True)
-        with timed_query(sql):
+        with timed_query(sql, shape="raw"):
             cur = self.conn.execute(
                 sql, params if isinstance(params, dict) else tuple(params)
             )
@@ -2650,7 +2684,7 @@ class WriteTx:
         from corrosion_tpu.runtime.trace import timed_query
 
         self._ensure_capture(True)
-        with timed_query(sql):
+        with timed_query(sql, shape="raw"):
             cur = self.conn.executemany(sql, rows)
         self._pending_dirty = True
         self._n_trigger += 1
@@ -2815,7 +2849,7 @@ class WriteTx:
         if savepoint:
             conn.execute("SAVEPOINT __corro_cap")
             try:
-                with timed_query(sql):
+                with timed_query(sql, shape=shape.stmt_key):
                     cur = conn.executemany(sql, rows)
             except BaseException:
                 conn.execute("ROLLBACK TO __corro_cap")
@@ -2823,10 +2857,10 @@ class WriteTx:
                 raise
             conn.execute("RELEASE SAVEPOINT __corro_cap")
         elif many_rows is not None:
-            with timed_query(sql):
+            with timed_query(sql, shape=shape.stmt_key):
                 cur = conn.executemany(sql, rows)
         else:
-            with timed_query(sql):
+            with timed_query(sql, shape=shape.stmt_key):
                 cur = conn.execute(
                     sql,
                     params if isinstance(params, dict) else tuple(params),
@@ -2917,12 +2951,15 @@ class WriteTx:
                 if self._savepoint:
                     conn.execute("RELEASE SAVEPOINT __corro_wtx")
             else:
+                tc0 = _time.monotonic()
                 if self.store.chaos is not None:
                     # r18 slow/sick-disk injection on the solo
                     # (group-commit-off) path — the group path's hook
                     # lives in group_tx
                     self.store.chaos.on_commit()
                 conn.execute("COMMIT")
+                self.store.last_flush_secs = _time.monotonic() - tc0
+                _observe_commit_flush(self.store.last_flush_secs)
             self._done = True
             if changes:
                 db_version = changes[0].db_version

@@ -37,8 +37,21 @@ own pre and against the banked prior-round post.  Records merge by
 rung into INGEST_BENCH.json, `code_sha`-stamped over the measured
 write-path files (bench.py replay-gate discipline).
 
+`--profile` (r23) banks WRITE_PROFILE.json beside INGEST_BENCH.json
+instead: the solo-writer rung runs with the continuous profiler ON and
+the banked record attributes submit→resolve commit wall across the five
+`corro.write.profile.seconds` buckets (asyncio dispatch / write gate /
+to_thread hop / finalize / sqlite flush — the write-path round-4 work
+list), plus the sqlite COMMIT-flush wall and the top statement shapes;
+the w16 rung then measures what always-on sampling costs: the
+sampler's duty cycle read live under load (primary — it resolves
+fractions of a percent), corroborated by a position-balanced
+steady-state throughput A/B banked with its noise floor — the ≤2%
+acceptance bar `tests/test_write_profile.py` guards.
+
 Usage:
   python scripts/bench_ingest.py [--mode pre|post|ab] [--tag T]
+  python scripts/bench_ingest.py --profile
 """
 
 from __future__ import annotations
@@ -78,6 +91,7 @@ _MEASURED_FILES = (
     "corrosion_tpu/agent/broadcast.py",
     "corrosion_tpu/runtime/channels.py",
     "corrosion_tpu/types/codec.py",
+    "corrosion_tpu/runtime/profiler.py",
     "scripts/bench_ingest.py",
 )
 
@@ -153,7 +167,8 @@ def _record(rung: str, mode: str, tag: str, **fields) -> dict:
 
 
 async def _local_write(
-    n_writers: int, mode: str, tag: str, durable: bool = False
+    n_writers: int, mode: str, tag: str, durable: bool = False,
+    profile: bool = None,
 ) -> dict:
     from tests.test_agent import boot, fast_config
 
@@ -162,6 +177,10 @@ async def _local_write(
     name = f"bench-ingest-w{n_writers}{'d' if durable else ''}"
     net = MemNetwork(seed=11)
     cfg = fast_config(name)
+    if profile is not None:
+        # the --profile overhead A/B drives this explicitly; the normal
+        # rungs keep the config default (the sampler IS production load)
+        cfg.profile.enabled = profile
     agent = await boot(net, name, cfg=cfg)
     if durable:
         # the fsync-per-commit regime (PRAGMA synchronous=FULL on the
@@ -443,6 +462,253 @@ def run_ab(tag: str) -> list:
     return recs
 
 
+# -- continuous-profiler attribution + overhead (--profile, r23) -----------
+
+
+async def _overhead_phases(
+    n_writers: int = 16, pairs: int = 6, txs_per_phase: int = 576
+) -> dict:
+    """Measure what always-on sampling costs the w16 write plane.
+
+    The PRIMARY number is the sampler's own duty cycle — busy/wall per
+    32-sample block, the same accounting the adaptive governor sheds
+    on — read under the live w16 load from ONE long-lived profiler
+    whose governor has settled (warmup phases run sampler-ON, so the
+    shed ladder reaches its steady state before anything is banked).
+    That instrument resolves fractions of a percent exactly.
+
+    A throughput A/B rides along as corroboration, built as carefully
+    as the host allows: one booted agent (fresh boots swing ±20-30%
+    rows/s), steady-state `stop()`/`start()` toggles (shed state and
+    warm intern caches persist, so an on-phase is the production
+    sampler, not a cold restart), every phase REPLACEs the same id
+    range (constant btree size), and pair order cycles the ABBA square
+    — (off,on),(on,off),(on,off),(off,on) — so each side lands on
+    every position mod 4 and periodic host drift cannot alias into the
+    off/on split the way simple mirroring lets it.  Even so, on this
+    1-core host individual phases swing ±20-30%, far above a ≤1% duty
+    — the banked A/B carries its per-pair spread so a reader sees the
+    noise floor instead of mistaking the median for a measurement of
+    the sampler."""
+    from corrosion_tpu.runtime import profiler as prof_mod
+    from tests.test_agent import boot, fast_config
+
+    from corrosion_tpu.agent.run import make_broadcastable_changes, shutdown
+
+    prof_mod.configure()  # drop any prior install; this run owns it
+    net = MemNetwork(seed=17)
+    cfg = fast_config("bench-ingest-prof-ab")
+    cfg.profile.enabled = False  # the phases drive install explicitly
+    agent = await boot(net, "bench-ingest-prof-ab", cfg=cfg)
+    sql = "INSERT OR REPLACE INTO tests (id, text) VALUES (?, ?)"
+    txs_per_writer = txs_per_phase // n_writers
+
+    async def writer(w: int) -> None:
+        for t in range(txs_per_writer):
+            base = (w * txs_per_writer + t) * ROWS_PER_TX
+            rows = [(base + j, f"p{base + j}") for j in range(ROWS_PER_TX)]
+            await make_broadcastable_changes(
+                agent, lambda tx, rows=rows: [tx.executemany(sql, rows)]
+            )
+
+    async def phase() -> float:
+        t0 = time.monotonic()
+        await asyncio.gather(*(writer(w) for w in range(n_writers)))
+        return txs_per_writer * n_writers * ROWS_PER_TX / (
+            time.monotonic() - t0
+        )
+
+    import gc
+    import statistics
+
+    prof = prof_mod.configure(
+        hz=cfg.profile.hz,
+        shed_hz=cfg.profile.shed_hz,
+        max_overhead_pct=cfg.profile.max_overhead_pct,
+        window_secs=cfg.profile.window_secs,
+        slots=cfg.profile.slots,
+        max_stacks=cfg.profile.max_stacks,
+    )
+    prof.register_loop_coldpath()
+
+    abba = ((False, True), (True, False), (True, False), (False, True))
+    deltas = []
+    rates = {False: [], True: []}
+    phase_rows = txs_per_writer * n_writers * ROWS_PER_TX
+    on_busy = 0.0
+    on_wall = 0.0
+    duty_phase_max = 0.0
+    try:
+        # warmup with the sampler ON: schema caches, first-commit
+        # costs, and — the point — the governor settling under load
+        await phase()
+        await phase()
+        for i in range(pairs):
+            pair_rate = {}
+            for on in abba[i % 4]:
+                if on:
+                    prof.start()
+                else:
+                    prof.stop()
+                gc.collect()  # phases start from the same gc state
+                busy0 = prof.busy_secs_total
+                pair_rate[on] = await phase()
+                if on:
+                    busy = prof.busy_secs_total - busy0
+                    wall = phase_rows / pair_rate[on]
+                    on_busy += busy
+                    on_wall += wall
+                    duty_phase_max = max(
+                        duty_phase_max, 100.0 * busy / wall
+                    )
+            for on in (False, True):
+                rates[on].append(pair_rate[on])
+            deltas.append(
+                100.0 * (1.0 - pair_rate[True] / pair_rate[False])
+            )
+        census = prof.census()
+    finally:
+        prof_mod.configure()
+        await shutdown(agent)
+    return {
+        "rung": "ingest-local-w16-steady",
+        "overhead_pct": round(100.0 * on_busy / max(1e-9, on_wall), 3),
+        "method": (
+            "sampler duty: monotone busy accumulator differenced "
+            "across every sampler-on phase, aggregated over the full "
+            "on wall — exact accounting of sample-path time under the "
+            "live w16 load (an overestimate if anything: a sample "
+            "preempted mid-walk charges the preemption too); the "
+            "throughput A/B below is corroboration with its noise "
+            "floor attached"
+        ),
+        "duty_phase_max_pct": round(duty_phase_max, 3),
+        "hz_effective": (
+            census["shed_hz"] if census["shed"] else census["hz"]
+        ),
+        "shed": census["shed"],
+        "sheds_total": census["sheds_total"],
+        "ab": {
+            "reps": pairs,
+            "ordering": "ABBA, steady-state sampler stop/start",
+            "rows_per_s_off": round(statistics.median(rates[False]), 1),
+            "rows_per_s_on": round(statistics.median(rates[True]), 1),
+            "median_paired_delta_pct": round(
+                statistics.median(deltas), 2
+            ),
+            "pair_delta_spread_pct": [
+                round(min(deltas), 2), round(max(deltas), 2)
+            ],
+            "note": (
+                "1-core host: per-phase throughput noise exceeds the "
+                "sampler duty by an order of magnitude; the duty "
+                "accounting above is the load-bearing measurement"
+            ),
+        },
+    }
+
+
+def _hist_deltas(name: str, before=None):
+    """(sum, count) per label value for one histogram family — diffed
+    against `before` when given, so a rung's contribution is isolated
+    from whatever the process accumulated earlier."""
+    from corrosion_tpu.runtime.metrics import METRICS
+
+    sums: dict = {}
+    counts: dict = {}
+    for kind, nm, labels, val in METRICS.snapshot():
+        if kind != "histogram":
+            continue
+        key = labels.get("bucket") or labels.get("shape") or "-"
+        if nm == name + "_sum":
+            sums[key] = sums.get(key, 0.0) + val
+        elif nm == name + "_count":
+            counts[key] = counts.get(key, 0) + val
+    if before is not None:
+        b_sums, b_counts = before
+        sums = {
+            k: v - b_sums.get(k, 0.0) for k, v in sums.items()
+            if v - b_sums.get(k, 0.0) > 0
+        }
+        counts = {
+            k: v - b_counts.get(k, 0) for k, v in counts.items()
+            if v - b_counts.get(k, 0) > 0
+        }
+    return sums, counts
+
+
+# a multiple of 4 keeps the ABBA square balanced: each side of the
+# overhead A/B lands on every position mod 4 equally often
+PROFILE_OVERHEAD_REPS = 8
+
+
+def run_profile() -> dict:
+    """Bank WRITE_PROFILE.json: solo-writer bucket attribution with the
+    sampler ON, then the w16 steady-state sampler-overhead measurement
+    (duty accounting primary, position-balanced A/B corroborating)."""
+    from corrosion_tpu.runtime import profiler as prof_mod
+
+    # -- 1) w1 solo: where does one commit's wall actually go? -------------
+    prof_mod.configure()  # fresh install at boot (first agent wins)
+    wb_before = _hist_deltas("corro.write.profile.seconds")
+    fl_before = _hist_deltas("corro.store.commit.flush.seconds")
+    st_before = _hist_deltas("corro.store.stmt.seconds")
+    rec_w1 = asyncio.run(_local_write(1, "post", "profile", profile=True))
+    wb_sums, wb_counts = _hist_deltas(
+        "corro.write.profile.seconds", wb_before
+    )
+    fl_sums, fl_counts = _hist_deltas(
+        "corro.store.commit.flush.seconds", fl_before
+    )
+    st_sums, _ = _hist_deltas("corro.store.stmt.seconds", st_before)
+    prof = prof_mod.get()
+    sampler_census = prof.census() if prof is not None else {}
+    stmt_rows = prof.ring.stmt_rows()[:10] if prof is not None else []
+
+    from corrosion_tpu.runtime.profiler import WRITE_BUCKETS
+
+    buckets = {
+        b: round(wb_sums.get(b, 0.0), 6) for b in WRITE_BUCKETS
+    }
+    wall = wb_sums.get("wall", 0.0)
+
+    # -- 2) w16: what does always-on sampling cost the write plane? --------
+    overhead = asyncio.run(
+        _overhead_phases(pairs=PROFILE_OVERHEAD_REPS)
+    )
+
+    doc = {
+        "rung": "write-profile",
+        "buckets_secs": buckets,
+        "bucket_commits": wb_counts.get("wall", 0),
+        "wall_secs": round(wall, 6),
+        "coverage_pct": round(
+            100.0 * sum(buckets.values()) / wall, 2
+        ) if wall else 0.0,
+        "detail": {
+            "commit_fsync_secs": round(fl_sums.get("-", 0.0), 6),
+            "commit_fsync_count": fl_counts.get("-", 0),
+            "stmt_secs": {
+                k: round(v, 6)
+                for k, v in sorted(st_sums.items(), key=lambda kv: -kv[1])[:10]
+            },
+            "stmt_rows": stmt_rows,
+            "sampler": sampler_census,
+            "w1_rows_per_s": rec_w1["rows_per_s"],
+        },
+        "overhead": overhead,
+        "code_sha": _code_fingerprint(),
+        "measured_at": time.strftime(
+            "%Y-%m-%d %H:%M:%S", time.gmtime()
+        ),
+    }
+    path = os.path.join(REPO, "WRITE_PROFILE.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return doc
+
+
 def main() -> None:
     args = sys.argv[1:]
     mode = "post"
@@ -457,6 +723,18 @@ def main() -> None:
         del args[i : i + 2]
     if "--ab" in args:
         mode = "ab"
+    if "--profile" in args:
+        doc = run_profile()
+        ov = doc["overhead"]
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        print(
+            f"write profile: {doc['coverage_pct']}% of "
+            f"{doc['wall_secs']:.2f}s wall attributed across "
+            f"{len(doc['buckets_secs'])} buckets; sampler duty "
+            f"{ov['overhead_pct']}% at w16 (shed={ov['shed']}, "
+            f"A/B median {ov['ab']['median_paired_delta_pct']}%)"
+        )
+        return
     bank = os.path.join(REPO, "INGEST_BENCH.json")
     try:
         if mode == "ab":

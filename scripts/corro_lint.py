@@ -4,9 +4,12 @@ The one CLI for the AST-based checker suite
 (`corrosion_tpu/analysis/`): kernel-purity, lane-parity,
 async-blocking, lock-discipline, codec-ext, capture-parity (r15: the
 trigger DDL ↔ direct-capture lockstep), metrics-doc (the folded
-r7 metric-name lint) and timeout-discipline (r18: network awaits in
+r7 metric-name lint), timeout-discipline (r18: network awaits in
 agent//api/ must carry wait_for deadlines — the zombie-node hang
-class).  Wired into tier-1 via
+class), actuator-discipline (r22: remediation actuators declare their
+safety envelope) and profiler-safety (r23: the stack sampler's hot
+path stays lock-free, asyncio-free and allocation-free).  Wired into
+tier-1 via
 tests/test_static_analysis.py, so a NEW finding — or a STALE baseline
 entry — fails CI.
 

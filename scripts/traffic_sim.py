@@ -243,12 +243,26 @@ class TrafficSim:
                 # actuator exists to close
                 cfg.perf.sync_interval_min_secs = 1.0
                 cfg.perf.sync_interval_max_secs = 4.0
+            # r23 continuous profiler at scenario timescales: short
+            # fold windows so a capture's lookback is dominated by the
+            # scenario that triggered it, and a loosened overhead
+            # budget — the loaded 1-core tiny replica would otherwise
+            # shed to 11 Hz instantly and starve the fault window of
+            # samples (the production ≤2% budget is proven where it
+            # belongs, on the quiet ingest-bench rung)
+            cfg.profile.window_secs = 1.0 if tiny else 5.0
+            cfg.profile.max_overhead_pct = 4.0 if tiny else 1.0
+            # the supervisor TICK is scaled for observe-only runs too
+            # (the same timescale discipline the tsdb/alert cadences
+            # above get): a tiny-shape firing window is ~0.5 s, so the
+            # 2 s production tick would make the would_act audit trail
+            # a phase race instead of a recorded fact
+            cfg.remediation.tick_secs = 0.1 if tiny else 0.25
             if self.remediation:
                 # r22: arm the plane, cooldowns/sustain scaled to the
                 # scenario-window timescale (the same scaling the
                 # alerting plane above gets)
                 cfg.remediation.enabled = True
-                cfg.remediation.tick_secs = 0.1 if tiny else 0.25
                 cfg.remediation.act_timeout_secs = 0.8 if tiny else 1.5
                 cfg.remediation.sync_cooldown_secs = 0.4 if tiny else 0.75
                 cfg.remediation.drain_cooldown_secs = 1.0 if tiny else 2.0
@@ -362,6 +376,7 @@ class TrafficSim:
     # RESOLVE it after restore()
     EXPECTED_ALERTS = {
         "sick-disk": "store-faults",
+        "slow-disk": "commit-stall",
         "zombie-node": "view-divergence",
     }
 
@@ -562,7 +577,10 @@ class TrafficSim:
             ),
         ]
         if self.tiny:
-            keep = {"baseline", "zombie-node", "sick-disk"}
+            # slow-disk rides the tier-1 replica since r23: it is the
+            # scenario that proves the commit-stall page alert AND the
+            # alert-triggered profile capture pinning store/ frames
+            keep = {"baseline", "zombie-node", "slow-disk", "sick-disk"}
             matrix = [m for m in matrix if m[0] in keep]
         return matrix
 
@@ -596,10 +614,12 @@ def _assert_bars(rec: dict, tiny: bool) -> None:
         )
     # r20 alert bars: the scenario's typed alert raised while injected
     # (drill-marked — the chaos census was live) and resolved after
-    # restore().  Tier-1 replica asserts the sick-disk store-fault
-    # alert; the full matrix additionally holds zombie-node's
-    # view-divergence alert to the same bar.
-    if sid == "sick-disk" or (sid == "zombie-node" and not tiny):
+    # restore().  Tier-1 replica asserts the sick-disk store-fault and
+    # slow-disk commit-stall alerts; the full matrix additionally holds
+    # zombie-node's view-divergence alert to the same bar.
+    if sid in ("sick-disk", "slow-disk") or (
+        sid == "zombie-node" and not tiny
+    ):
         al = rec.get("alerts")
         assert al, f"{sid}: no alert observation in the record"
         assert al["raised"], (
@@ -613,6 +633,32 @@ def _assert_bars(rec: dict, tiny: bool) -> None:
         assert al["resolved"], (
             f"{sid}: alert {al['expected']!r} still firing after "
             f"restore + recovery: {al['after']}"
+        )
+    # r23 profile-attachment bars: a disk-pathology page alert must
+    # arrive with the continuous profiler's hot-window capture pinned
+    # to it, and that capture's dominant store-worker stack must name
+    # the store commit path — the incident record says WHERE the wall
+    # went, not just that a threshold tripped
+    if sid in ("sick-disk", "slow-disk"):
+        prof = (rec["alerts"]["during"] or {}).get("profile")
+        assert prof, (
+            f"{sid}: page alert fired without an attached profile "
+            "capture"
+        )
+        assert prof["reason"] == f"alert_{rec['alerts']['expected']}"
+        assert prof["samples"] > 0, prof
+        store_stacks = {
+            k: v for k, v in prof["folded"].items()
+            if k.startswith("store;")
+        }
+        assert store_stacks, (
+            f"{sid}: attached profile holds no store-worker stacks: "
+            f"{sorted(prof['folded'])[:8]}"
+        )
+        top = max(store_stacks, key=store_stacks.get)
+        assert "store/crdt.py" in top, (
+            f"{sid}: top store-worker stack does not name the commit "
+            f"path: {top}"
         )
     if sid == "churn-storm":
         cc = rec.get("catchup")
@@ -646,6 +692,7 @@ async def run_matrix(
     seed: int = 31,
     only: Optional[Tuple[str, ...]] = None,
 ) -> dict:
+    from corrosion_tpu.runtime import profiler as _prof
     from corrosion_tpu.runtime import tsdb as _tsdb
 
     saved = (syncer.RECV_TIMEOUT, syncer.OPEN_TIMEOUT)
@@ -657,6 +704,14 @@ async def run_matrix(
         sample_interval_secs=0.08 if tiny else 0.25,
         slots=600,
         max_series=4096,
+    )
+    # same discipline for the r23 continuous profiler (the knobs tune()
+    # writes into each node's cfg.profile — configured up front so the
+    # first node's ensure() adopts THIS instance, not a leftover): the
+    # page-alert captures the slow/sick-disk bars assert ride on it
+    _prof.configure(
+        window_secs=1.0 if tiny else 5.0,
+        max_overhead_pct=4.0 if tiny else 1.0,
     )
     if tiny:
         # tiny-shape deadlines: the zombie window is ~1 s, so the sync
@@ -689,6 +744,7 @@ async def run_matrix(
         await sim.stop_cluster()
         syncer.RECV_TIMEOUT, syncer.OPEN_TIMEOUT = saved
         _tsdb.configure()  # uninstall: later tests ensure() their own
+        _prof.configure()  # ditto — the sampler thread must not leak
     out = {
         "metric": "traffic_sim",
         "mode": "tier1" if tiny else "full",

@@ -195,6 +195,56 @@ def test_alerts_over_cli(live_agent):
     assert "cluster alerts" in r.stdout
 
 
+def test_profile_over_cli(live_agent):
+    """r23: `corrosion profile` round-trips the live agent's continuous
+    profiler (GET /v1/profile) — summary table, raw JSON, a valid
+    speedscope file on disk, folded text, and the cluster rollup."""
+    cfg = live_agent["cfg"]
+    tmp = live_agent["tmp"]
+    import json as _json
+
+    # the always-on sampler needs a beat to accumulate samples
+    deadline = time.monotonic() + 20
+    body = {}
+    while time.monotonic() < deadline:
+        r = run_cli(["-c", cfg, "profile", "--json"])
+        assert r.returncode == 0, r.stderr
+        body = _json.loads(r.stdout)
+        assert body.get("enabled"), body
+        if body.get("samples", 0) > 0:
+            break
+        time.sleep(0.5)
+    assert body.get("samples", 0) > 0, body
+    assert body["top_self"], body
+
+    r = run_cli(["-c", cfg, "profile"])
+    assert r.returncode == 0, r.stderr
+    assert "samples over" in r.stdout and "frame" in r.stdout
+
+    # speedscope export round-trip: the file on disk is the document
+    out = tmp / "prof.speedscope.json"
+    r = run_cli(["-c", cfg, "profile", "--speedscope", str(out)])
+    assert r.returncode == 0, r.stderr
+    doc = _json.loads(out.read_text())
+    assert doc["$schema"].endswith("file-format-schema.json")
+    assert doc["profiles"][0]["type"] == "sampled"
+    assert len(doc["shared"]["frames"]) > 0
+
+    r = run_cli(["-c", cfg, "profile", "--folded"])
+    assert r.returncode == 0, r.stderr
+    # every folded line is "stack count" with a subsystem;task prefix
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert lines
+    for ln in lines:
+        stack, n = ln.rsplit(" ", 1)
+        assert int(n) > 0
+        assert stack.count(";") >= 1, ln
+
+    r = run_cli(["-c", cfg, "profile", "--cluster"])
+    assert r.returncode == 0, r.stderr
+    assert "cluster hotspots" in r.stdout
+
+
 def test_snapshot_dump_then_install_roundtrip(tmp_path):
     """r17 catch-up plane parity with the backup/restore block:
     `snapshot dump` builds the compressed container, `snapshot install`

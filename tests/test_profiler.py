@@ -1,0 +1,360 @@
+"""Continuous profiling plane (r23): sampler, fold store, statement
+shapes, adaptive shed, export formats.
+
+The sampler is driven SYNCHRONOUSLY here — `Profiler.sample_once()` is
+the documented test mode (the daemon thread is just a loop around it),
+so every assertion below is deterministic: no sleeping for a sampler
+tick, no racing the adaptive governor.  The deliberately hot function
+keeps a call-free loop body so every sample charges the SAME leaf
+frame — flamegraph dominance becomes an exact count, not a likelihood.
+"""
+
+import threading
+import time
+
+from corrosion_tpu.runtime import profiler as prof_mod
+from corrosion_tpu.runtime.metrics import Registry
+from corrosion_tpu.runtime.profiler import ADAPT_EVERY, Profiler
+from corrosion_tpu.runtime.profstore import (
+    OVERFLOW_KEY,
+    ProfStore,
+    self_times,
+    to_folded_text,
+)
+from corrosion_tpu.runtime.trace import timed_query
+
+
+def _deliberately_hot_spin(ready, flag):
+    # call-free loop body: every stack sample of this thread lands with
+    # THIS frame as the leaf (a stop Event's is_set() call would split
+    # the self time with threading.py)
+    ready.set()
+    x = 0
+    while not flag:
+        x = (x + 1) % 1000003
+    return x
+
+
+def _drive(p, n):
+    for _ in range(n):
+        p.sample_once()
+
+
+# -- hot-frame dominance ----------------------------------------------------
+
+
+def test_hot_function_dominates_folded_output():
+    p = Profiler(hz=1000.0, window_secs=600.0, registry=Registry())
+    ready, flag = threading.Event(), []
+    t = threading.Thread(
+        target=_deliberately_hot_spin,
+        args=(ready, flag),
+        name="asyncio_hotspin",  # _NAME_TAGS: asyncio_ -> worker
+        daemon=True,
+    )
+    t.start()
+    assert ready.wait(5.0)
+    n = 150
+    try:
+        _drive(p, n)
+    finally:
+        flag.append(1)
+        t.join(timeout=5.0)
+
+    folded = p.folded()
+    hot = {k: v for k, v in folded.items() if "_deliberately_hot_spin" in k}
+    # the spin thread was inside the hot frame for (almost) every tick;
+    # the sample landing exactly on ready.set() gets the 10% slack
+    assert sum(hot.values()) >= 0.9 * n, folded
+    # classified by thread-name prefix, no running asyncio task
+    assert all(k.startswith("worker;-;") for k in hot), hot
+    # and the hot frame is the LEAF of its stacks — top SELF time, not
+    # just presence (a call inside the loop body would split the count
+    # with the callee).  Dominance is asserted WITHIN the spin thread's
+    # own stacks: a wall-clock sampler also charges every other thread
+    # alive in the pytest process (pool threads parked by earlier test
+    # files merge into identical folded keys whose count scales with
+    # pool size), so a process-wide self-time ranking is inherently
+    # order-dependent.
+    rows = self_times(hot)
+    assert rows and "_deliberately_hot_spin" in rows[0][0], rows[:5]
+    assert rows[0][1] >= 0.9 * n
+
+    text = to_folded_text(folded)
+    for line in text.strip().splitlines():
+        stack, _, cnt = line.rpartition(" ")
+        assert stack.count(";") >= 1 and int(cnt) > 0
+
+
+# -- adaptive shed ----------------------------------------------------------
+
+
+def test_adaptive_shed_engages_and_restores():
+    reg = Registry()
+    p = Profiler(
+        hz=50.0, shed_hz=5.0, max_overhead_pct=1e-9, registry=reg
+    )
+    # a tight synchronous block busts ANY positive budget
+    _drive(p, ADAPT_EVERY)
+    assert p.shed is True
+    assert p.sheds_total == 1
+    assert p._interval == 1.0 / p.shed_hz
+    assert reg.counter("corro.profile.shed.total").value == 1
+    assert p.overhead_pct > 0.0
+
+    # with the budget effectively unbounded the projected full-rate
+    # duty clears the half-budget hysteresis bar -> restore
+    p.max_overhead_pct = 1e9
+    _drive(p, ADAPT_EVERY)
+    assert p.shed is False
+    assert p._interval == 1.0 / p.hz
+    # shed counter is monotone: restore does not decrement
+    assert reg.counter("corro.profile.shed.total").value == 1
+    assert p.census()["sheds_total"] == 1
+
+
+# -- ring bounds ------------------------------------------------------------
+
+
+def test_fold_map_overflow_is_typed_not_silent():
+    st = ProfStore(window_secs=600.0, max_stacks=4)
+    for i in range(10):
+        st.add_sample("loop;-;app.py:f%d" % i)
+    folded = st.merged()
+    assert len(folded) == 5  # 4 distinct + the overflow bucket
+    assert folded[OVERFLOW_KEY] == 6
+    assert sum(folded.values()) == 10  # accounted, never dropped
+
+
+def test_window_ring_is_bounded_and_lookback_filters():
+    clock = [1000.0]
+    st = ProfStore(window_secs=5.0, slots=3, wall=lambda: clock[0])
+    for i in range(10):
+        st.add_sample("w;-;app.py:f%d" % i)
+        clock[0] += 6.0
+        st.seal_coldpath()
+    c = st.census()
+    assert c["windows_sealed"] == 3  # deque bound
+    assert st.sealed_total == 10
+    assert set(st.merged()) == {
+        "w;-;app.py:f7", "w;-;app.py:f8", "w;-;app.py:f9"
+    }
+    # lookback 7s from t=1060 keeps windows sealed at 1054 and 1060
+    assert set(st.merged(7.0)) == {"w;-;app.py:f8", "w;-;app.py:f9"}
+
+
+# -- speedscope export ------------------------------------------------------
+
+# the essential subset of speedscope's file-format-schema.json: enough
+# to reject a malformed document (missing frame table, non-sampled
+# profile, weights/samples shape drift) without vendoring the full
+# schema
+_SPEEDSCOPE_SCHEMA = {
+    "type": "object",
+    "required": ["$schema", "shared", "profiles"],
+    "properties": {
+        "$schema": {"type": "string"},
+        "shared": {
+            "type": "object",
+            "required": ["frames"],
+            "properties": {
+                "frames": {
+                    "type": "array",
+                    "items": {
+                        "type": "object",
+                        "required": ["name"],
+                        "properties": {"name": {"type": "string"}},
+                    },
+                }
+            },
+        },
+        "profiles": {
+            "type": "array",
+            "minItems": 1,
+            "items": {
+                "type": "object",
+                "required": [
+                    "type", "name", "unit", "startValue", "endValue",
+                    "samples", "weights",
+                ],
+                "properties": {
+                    "type": {"enum": ["sampled"]},
+                    "unit": {"type": "string"},
+                    "startValue": {"type": "number"},
+                    "endValue": {"type": "number"},
+                    "samples": {
+                        "type": "array",
+                        "items": {
+                            "type": "array",
+                            "items": {"type": "integer", "minimum": 0},
+                        },
+                    },
+                    "weights": {
+                        "type": "array",
+                        "items": {"type": "number", "minimum": 0},
+                    },
+                },
+            },
+        },
+    },
+}
+
+
+def test_speedscope_export_validates_against_schema():
+    import jsonschema
+
+    p = Profiler(window_secs=600.0, registry=Registry())
+    for _ in range(5):
+        p.ring.add_sample("loop;tick;app.py:main;app.py:step")
+    for _ in range(3):
+        p.ring.add_sample("store;-;crdt.py:commit")
+    doc = p.export(fmt="speedscope")
+    jsonschema.validate(doc, _SPEEDSCOPE_SCHEMA)
+
+    prof = doc["profiles"][0]
+    nframes = len(doc["shared"]["frames"])
+    # loop/tick/main/step + store/-/commit: no frame shared between them
+    assert nframes == 7
+    assert len(prof["samples"]) == len(prof["weights"]) == 2
+    assert all(i < nframes for s in prof["samples"] for i in s)
+    assert prof["endValue"] == sum(prof["weights"]) == 8
+
+
+# -- statement shapes match the trace-callback counts -----------------------
+
+
+def test_stmt_histograms_match_trace_callback_counts():
+    reg = Registry()
+    prof_mod.configure(auto_start=False, registry=reg, window_secs=600.0)
+    try:
+        for _ in range(7):
+            with timed_query("SELECT 1", shape="test:select"):
+                pass
+        for _ in range(3):
+            with timed_query("INSERT INTO t", shape="test:insert"):
+                pass
+        with timed_query("no shape given"):
+            pass  # shapeless blocks stay out of the profile
+
+        h = reg.histogram("corro.store.stmt.seconds", shape="test:select")
+        assert h.count == 7
+        h2 = reg.histogram("corro.store.stmt.seconds", shape="test:insert")
+        assert h2.count == 3
+
+        rows = {r["shape"]: r for r in prof_mod.get().ring.stmt_rows()}
+        assert rows["test:select"]["count"] == 7
+        assert rows["test:insert"]["count"] == 3
+        assert set(rows) == {"test:select", "test:insert"}
+
+        cap = prof_mod.get().capture("alert_test")
+        assert cap["reason"] == "alert_test"
+        assert {r["shape"] for r in cap["stmt"]} == {
+            "test:select", "test:insert"
+        }
+    finally:
+        prof_mod.configure()  # uninstall; later tests see a clean plane
+    assert prof_mod.installed() is False
+    # uninstalled, the trace hook is a no-op (one module-global read)
+    with timed_query("SELECT 1", shape="test:select"):
+        pass
+    assert reg.histogram(
+        "corro.store.stmt.seconds", shape="test:select"
+    ).count == 7
+
+
+# -- record_write_buckets ---------------------------------------------------
+
+
+def test_write_buckets_partition_the_wall():
+    reg = Registry()
+    prof_mod.configure(auto_start=False, registry=reg)
+    try:
+        t = 100.0
+        prof_mod.record_write_buckets(
+            enq=t,
+            gate_start=t + 0.001,
+            gate_acq=t + 0.003,
+            dispatch=t + 0.004,
+            thread_start=t + 0.006,
+            thread_done=t + 0.016,
+            resolved=t + 0.017,
+            finalize_secs=0.004,
+        )
+        total = 0.0
+        from corrosion_tpu.runtime.profiler import WRITE_BUCKETS
+
+        for bucket in WRITE_BUCKETS:
+            h = reg.histogram("corro.write.profile.seconds", bucket=bucket)
+            assert h.count == 1, bucket
+            total += h.total
+        wall = reg.histogram("corro.write.profile.seconds", bucket="wall")
+        assert wall.count == 1
+        # the five buckets PARTITION the wall (to fp rounding)
+        assert abs(total - wall.total) < 1e-9
+
+        # a reordered stamp chain is refused, not banked as garbage
+        prof_mod.record_write_buckets(
+            enq=t, gate_start=t - 1.0, gate_acq=t, dispatch=t,
+            thread_start=t, thread_done=t, resolved=t, finalize_secs=0.0,
+        )
+        assert wall.count == 1
+    finally:
+        prof_mod.configure()
+
+
+# -- capture + hotspots -----------------------------------------------------
+
+
+def test_capture_and_hotspots_are_bounded():
+    reg = Registry()
+    p = Profiler(window_secs=600.0, registry=reg)
+    for i in range(30):
+        p.ring.add_sample("worker;-;a.py:f%d" % i)
+    for _ in range(50):
+        p.ring.add_sample("store;-;store/crdt.py:commit")
+    cap = p.capture("alert_commit-stall", top=10)
+    assert cap["samples"] == 80
+    assert len(cap["folded"]) <= 40  # 4 * top
+    assert len(cap["top_self"]) == 10
+    assert cap["top_self"][0]["frame"] == "store/crdt.py:commit"
+    assert reg.counter("corro.profile.captures.total").value == 1
+
+    spots = p.hotspots(top=3)
+    assert len(spots) == 3
+    assert spots[0] == {"frame": "store/crdt.py:commit", "samples": 50}
+
+
+def test_loop_task_names_ride_the_fold(event_loop=None):
+    import asyncio
+
+    async def scenario():
+        p = Profiler(window_secs=600.0, registry=Registry())
+        p.register_loop_coldpath()
+
+        async def busy():
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 0.05:
+                pass  # hold the loop so samples land inside this task
+
+        task = asyncio.get_running_loop().create_task(
+            busy(), name="hot-task"
+        )
+        # sample from a worker thread while the named task runs
+        stop = threading.Event()
+
+        def sampler():
+            while not stop.is_set():
+                p.sample_once()
+
+        th = threading.Thread(target=sampler, daemon=True)
+        th.start()
+        try:
+            await task
+        finally:
+            stop.set()
+            th.join(timeout=5.0)
+        return p.folded()
+
+    folded = asyncio.run(scenario())
+    named = {k: v for k, v in folded.items() if k.startswith("loop;hot-task;")}
+    assert named, folded

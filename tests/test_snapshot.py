@@ -154,13 +154,20 @@ def test_digest_heads_total_rides_and_tolerates_eof():
     assert decode_digest(enc).heads_total == 12345
     # a pre-r17 encoder never writes the trailing fields: strip exactly
     # the trailing uvarint(12345) PLUS the r20 empty-alert-block count
-    # (uvarint(0), one byte) that now follows it, and the decoder must
-    # default both (heads_total=0, alerts=[])
+    # and the r23 empty-hotspot-block count (uvarint(0), one byte each)
+    # that now follow it, and the decoder must default all three
+    # (heads_total=0, alerts=[], hotspots=[])
     w = Writer()
     w.uvarint(12345)
-    old_bytes = enc[: -(len(w.bytes()) + 1)]
+    old_bytes = enc[: -(len(w.bytes()) + 2)]
     old = decode_digest(old_bytes)
     assert old.heads_total == 0 and old.alerts == []
+    assert old.hotspots == []
+    # an r20-era encoder wrote heads_total + alerts but no hotspot
+    # block: strip only the final count byte and hotspots must default
+    # while the older trailing fields still decode
+    mid = decode_digest(enc[:-1])
+    assert mid.heads_total == 12345 and mid.hotspots == []
 
 
 # -- build + install --------------------------------------------------------

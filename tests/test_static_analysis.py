@@ -45,6 +45,9 @@ from corrosion_tpu.analysis.purity import KernelPurityChecker  # noqa: E402
 from corrosion_tpu.analysis.actuators import (  # noqa: E402
     ActuatorDisciplineChecker,
 )
+from corrosion_tpu.analysis.profiler_safety import (  # noqa: E402
+    ProfilerSafetyChecker,
+)
 from corrosion_tpu.analysis.timeouts import (  # noqa: E402
     TimeoutDisciplineChecker,
 )
@@ -946,11 +949,155 @@ def test_actuator_discipline_real_tree_is_clean():
     assert fs == [], "\n".join(f.render() for f in fs)
 
 
-# -- 10. the metrics fold + baseline machinery ------------------------------
+# -- 10. profiler-safety ----------------------------------------------------
+
+_HOT_SAMPLER_SLOPPY = """
+    import asyncio
+    import json
+
+    log = None
+    METRICS = None
+
+
+    class Ring:
+        def add_sample(self, key):
+            with self._map_lock:
+                self.folded[key] = self.folded.get(key, 0) + 1
+
+
+    class Sampler:
+        def sample_once(self):
+            loop = asyncio.get_event_loop()
+            self._gate.acquire()
+            key = f"{loop}"
+            frames = [f for f in (1, 2)]
+            top = sorted(frames)
+            payload = json.dumps(key)
+            log.debug("sampled %s", payload)
+            METRICS.counter("x").inc()
+            db = self.agent
+            add = self.ring.add_sample
+            add(key)
+            self._flush_coldpath()
+
+        def _flush_coldpath(self):
+            # exempt by suffix: bounded by cadence, not sample rate
+            with self._big_lock:
+                return sorted(json.dumps("x"))
+"""
+
+_HOT_SAMPLER_CLEAN = """
+    import sys
+    import time
+
+
+    class Ring:
+        def add_sample(self, key):
+            with self._fold_lock:
+                fmap = self._open.folded
+                n = fmap.get(key)
+                fmap[key] = 1 if n is None else n + 1
+
+
+    class Sampler:
+        def sample_once(self):
+            t0 = time.monotonic()
+            add = self.ring.add_sample
+            for tid, frame in sys._current_frames().items():
+                sub = self._tids.get(tid)
+                if sub is None:
+                    sub = self._classify_coldpath(tid)
+                add(sub + ";" + str(frame.f_lineno))
+            self._adapt_coldpath(t0)
+
+        def _classify_coldpath(self, tid):
+            # a cold function MAY take its own lock and touch metrics
+            with self._reg_lock:
+                self._tids[tid] = "other"
+            return "other"
+
+        def _adapt_coldpath(self, t0):
+            self.registry.gauge("corro.profile.overhead.pct").set(0.0)
+"""
+
+_PS_SCOPE = ("pkg/sampler.py",)
+
+
+def test_profiler_safety_fires_on_seeded_violations(tmp_path):
+    _write(tmp_path, "pkg/sampler.py", _HOT_SAMPLER_SLOPPY)
+    ctx = AnalysisContext(str(tmp_path))
+    fs = ProfilerSafetyChecker(scope=_PS_SCOPE).run(ctx)
+    # sample_once: asyncio call, .acquire on _gate, f-string,
+    # comprehension, sorted, json, logging, registry call, .agent
+    # traversal; add_sample (reached THROUGH the `add = …` alias):
+    # non-sanctioned with-lock.  _flush_coldpath's sins are exempt.
+    assert len(fs) == 10, "\n".join(f.render() for f in fs)
+    msgs = "\n".join(f.message for f in fs)
+    assert "asyncio API" in msgs
+    assert "acquires `_gate`" in msgs
+    assert "acquires `_map_lock`" in msgs  # proves the alias edge
+    assert "f-string" in msgs
+    assert "comprehension" in msgs
+    assert "sorted()" in msgs
+    assert "json call" in msgs
+    assert "logging" in msgs
+    assert "registry call" in msgs
+    assert "traverses `.agent`" in msgs
+    assert "_flush_coldpath" not in msgs
+
+
+def test_profiler_safety_minimal_fix_passes(tmp_path):
+    _write(tmp_path, "pkg/sampler.py", _HOT_SAMPLER_CLEAN)
+    ctx = AnalysisContext(str(tmp_path))
+    fs = ProfilerSafetyChecker(scope=_PS_SCOPE).run(ctx)
+    assert fs == [], "\n".join(f.render() for f in fs)
+
+
+def test_profiler_safety_scope_is_explicit_files(tmp_path):
+    # the rule scans the two named profiler files, nothing else — a
+    # sloppy sampler elsewhere in the tree is some other rule's problem
+    _write(tmp_path, "pkg/other.py", _HOT_SAMPLER_SLOPPY)
+    ctx = AnalysisContext(str(tmp_path))
+    assert ProfilerSafetyChecker(scope=_PS_SCOPE).run(ctx) == []
+
+
+def test_profiler_safety_noqa_suppresses(tmp_path):
+    body = _HOT_SAMPLER_SLOPPY.replace(
+        'METRICS.counter("x").inc()',
+        'METRICS.counter("x").inc()  # corro: noqa[profiler-safety]',
+    )
+    _write(tmp_path, "pkg/sampler.py", body)
+    ctx = AnalysisContext(str(tmp_path))
+    result = run_analysis(
+        ctx, [ProfilerSafetyChecker(scope=_PS_SCOPE)], baseline={}
+    )
+    assert len(result.suppressed) == 1
+    assert len(result.new) == 9
+
+
+def test_profiler_safety_real_tree_is_clean():
+    """The shipped sampler holds its own contract: everything reachable
+    from `sample_once` is lock-free (but `_fold_lock`), asyncio-free
+    and allocation-free, with all cold work behind `_coldpath` names —
+    this pin keeps the hot path honest as the profiler grows."""
+    fs = ProfilerSafetyChecker().run(AnalysisContext(REPO))
+    assert fs == [], "\n".join(f.render() for f in fs)
+
+
+def test_profiler_safety_reaches_the_fold_map(tmp_path):
+    # the reachable set must actually cross the alias into profstore's
+    # add_sample — an empty reachable set would vacuously "pass"
+    _write(tmp_path, "pkg/sampler.py", _HOT_SAMPLER_SLOPPY)
+    ctx = AnalysisContext(str(tmp_path))
+    fs = ProfilerSafetyChecker(scope=_PS_SCOPE).run(ctx)
+    assert any(f.symbol == "Ring.add_sample" for f in fs)
+
+
+# -- 11. the metrics fold + baseline machinery ------------------------------
 
 
 def test_metrics_fold_reports_same_inventory():
-    """The lint_metrics fold is lossless: same 242 literal series (218
+    """The lint_metrics fold is lossless: same 250 literal series (218
     at r19 + the 15 r20 alerting-plane series — corro.tsdb.*,
     corro.alerts.*, corro.metrics.{series,cardinality.dropped.total},
     corro.store.write.errors.total — + the 3 r21 write-path series:
@@ -960,7 +1107,11 @@ def test_metrics_fold_reports_same_inventory():
     skips.total, reverts.total, armed},
     corro.sync.targeted.rounds.total and
     corro.digest.degraded.total — the oversize-digest degrade the A/B
-    harness forced), same 2 wildcard
+    harness forced, + the 8 r23 profiling-plane series:
+    corro.profile.{samples.total, shed.total, captures.total,
+    overhead.pct}, corro.store.stmt.seconds,
+    corro.write.profile.seconds and the two commit-flush series
+    corro.store.commit.{flush.seconds, stall.total}), same 2 wildcard
     sites, both
     directions clean, via BOTH the framework checker and the
     back-compat shim."""
@@ -969,7 +1120,7 @@ def test_metrics_fold_reports_same_inventory():
     assert MetricsDocChecker().run(AnalysisContext(REPO)) == []
     assert lint_metrics.lint() == []
     literals, wildcards = lint_metrics.scan_call_sites()
-    assert len(literals) == 242
+    assert len(literals) == 250
     assert len(wildcards) == 2
     names = lint_metrics.parse_components_table()
     assert len(names) == len(set(names))

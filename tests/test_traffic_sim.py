@@ -9,9 +9,10 @@ Two layers:
    faults were injected, recovery + the closing zero-divergence
    verdict per scenario.
 2. IN-SUITE TINY REPLICA — `run_matrix(tiny=True)` runs the 3-node
-   {baseline, zombie-node, sick-disk} subset live (~5 s nominal,
-   budget ≤10 s): the same bars asserted against a real devcluster
-   under real faults every tier-1 run.
+   {baseline, zombie-node, slow-disk, sick-disk} subset live: the same
+   bars asserted against a real devcluster under real faults every
+   tier-1 run — since r23 including the commit-stall page alert with
+   its attached profile capture.
 
 Margin discipline (r15 memory): the banked guards pin deterministic
 facts only — counts, floors, verdicts — never wall-clock ratios; the
@@ -146,12 +147,14 @@ def test_churn_storm_banks_catchup_census(by_id):
 
 
 def test_alert_proof_banked_for_fault_scenarios(by_id):
-    """r20: the drill-vs-outage proof — sick-disk's store-faults and
-    zombie-node's view-divergence alerts each reached FIRING while the
-    fault was injected (carrying the scenario as the drill mark, since
-    the chaos census was live) and RESOLVED after restore()."""
+    """r20: the drill-vs-outage proof — sick-disk's store-faults,
+    slow-disk's commit-stall (r23) and zombie-node's view-divergence
+    alerts each reached FIRING while the fault was injected (carrying
+    the scenario as the drill mark, since the chaos census was live)
+    and RESOLVED after restore()."""
     for sid, rule in (
         ("sick-disk", "store-faults"),
+        ("slow-disk", "commit-stall"),
         ("zombie-node", "view-divergence"),
     ):
         al = by_id[sid].get("alerts")
@@ -161,6 +164,24 @@ def test_alert_proof_banked_for_fault_scenarios(by_id):
         assert al["drill"] == sid, f"{sid}: drill mark {al['drill']!r}"
         assert al["resolved"], f"{sid}: {rule} stuck firing: {al['after']}"
         assert al["during"]["severity"] == "page"
+
+
+def test_disk_incident_profiles_banked(by_id):
+    """r23: the full-matrix bank carries the alert-triggered profile
+    capture on each disk-pathology page alert, and the capture's
+    dominant store-worker stack names the store commit path."""
+    for sid in ("slow-disk", "sick-disk"):
+        prof = (by_id[sid]["alerts"]["during"] or {}).get("profile")
+        assert prof, f"{sid}: no profile attached to the firing alert"
+        assert prof["reason"] == f"alert_{by_id[sid]['alerts']['expected']}"
+        assert prof["samples"] > 0
+        store_stacks = {
+            k: v for k, v in prof["folded"].items()
+            if k.startswith("store;")
+        }
+        assert store_stacks, f"{sid}: no store-worker stacks in capture"
+        top = max(store_stacks, key=store_stacks.get)
+        assert "store/crdt.py" in top, f"{sid}: {top}"
 
 
 def test_injected_store_faults_surface_typed(by_id):
@@ -176,7 +197,8 @@ def test_injected_store_faults_surface_typed(by_id):
 
 def test_tier1_replica_serves_under_faults():
     """Live tiny-shape chaos: 3 nodes × {baseline, zombie-node,
-    sick-disk} through the REAL HTTP/subscription surfaces.  Every bar
+    slow-disk, sick-disk} through the REAL HTTP/subscription surfaces.
+    Every bar
     (`_assert_bars`) runs inside `run_matrix`; this test re-states the
     headline ones and bounds the wall with a wide backstop (nominal
     ~5 s — the ≤10 s replica budget — backstop for host drift plus the
@@ -191,8 +213,11 @@ def test_tier1_replica_serves_under_faults():
     # r22: the replica appends one remediation-ARMED zombie scenario
     # on a fresh tiny cluster — the supervisor boots, ticks, serves,
     # and every serving bar holds with the actuators live
+    # r23: slow-disk joins the tiny subset — the commit-stall page
+    # alert and its attached profile capture are tier-1 live bars
     assert ids == [
-        "baseline", "zombie-node", "sick-disk", "zombie-node-remediated",
+        "baseline", "zombie-node", "slow-disk", "sick-disk",
+        "zombie-node-remediated",
     ]
     for rec in record["scenarios"]:
         for stage, st in rec["stages"].items():
@@ -211,6 +236,25 @@ def test_tier1_replica_serves_under_faults():
     assert al["expected"] == "store-faults"
     assert al["raised"] and al["resolved"]
     assert al["drill"] == "sick-disk"
+    # r23, the replica's profiling headline (the same bar _assert_bars
+    # holds live): the slow-disk commit-stall page alert fired with the
+    # continuous profiler's capture attached, and the capture's
+    # dominant store-worker stack names the store commit path — the
+    # incident says WHERE the stalled wall went
+    slow = next(
+        s for s in record["scenarios"] if s["scenario"] == "slow-disk"
+    )
+    sal = slow["alerts"]
+    assert sal["expected"] == "commit-stall"
+    assert sal["raised"] and sal["resolved"]
+    assert sal["drill"] == "slow-disk"
+    prof = sal["during"]["profile"]
+    assert prof and prof["reason"] == "alert_commit-stall"
+    store_stacks = {
+        k: v for k, v in prof["folded"].items() if k.startswith("store;")
+    }
+    assert store_stacks
+    assert "store/crdt.py" in max(store_stacks, key=store_stacks.get)
     # r22: the standard replica runs OBSERVE-ONLY (the kill-switch
     # default) — the sick-disk store-faults firing must leave a typed
     # would_act audit trail, and no event may claim `acted`
@@ -234,8 +278,9 @@ def test_tier1_replica_serves_under_faults():
     # budget: +~12 s over the old 28 s backstop for the armed addendum
     # (second cluster boot + the zombie alert poll spending its tiny
     # fire cap — the view-divergence gauge doesn't trip in a ~1 s
-    # zombie window, a pre-existing tiny-shape limit)
-    assert elapsed < 40.0, f"tiny replica took {elapsed:.1f}s (budget 15s)"
+    # zombie window, a pre-existing tiny-shape limit), +~8 s for the
+    # r23 slow-disk scenario (window + alert fire/resolve polls)
+    assert elapsed < 48.0, f"tiny replica took {elapsed:.1f}s (budget 48s)"
 
 
 # -- r22: the remediation A/B bank ------------------------------------------

@@ -1,0 +1,94 @@
+"""WRITE_PROFILE.json guards (r23): the banked write-path attribution
+must stay coherent and the always-on sampler affordable.
+
+Same discipline as test_ingest_bench.py: assert on the BANKED document
+(structure + invariants), don't re-run the bench in tier-1.  The bank
+is re-cut by `python scripts/bench_ingest.py --profile`.
+"""
+
+import json
+import os
+
+import pytest
+
+from corrosion_tpu.runtime.profiler import WRITE_BUCKETS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BANK = os.path.join(REPO, "WRITE_PROFILE.json")
+
+# the acceptance bar: always-on sampling may cost the w16 write plane
+# at most this fraction of its wall
+MAX_OVERHEAD_PCT = 2.0
+
+
+@pytest.fixture(scope="module")
+def doc():
+    assert os.path.exists(BANK), (
+        "WRITE_PROFILE.json missing — run "
+        "`python scripts/bench_ingest.py --profile`"
+    )
+    with open(BANK) as f:
+        return json.load(f)
+
+
+def test_five_buckets_partition_the_commit_wall(doc):
+    buckets = doc["buckets_secs"]
+    assert set(buckets) == set(WRITE_BUCKETS)
+    assert all(v >= 0.0 for v in buckets.values()), buckets
+    wall = doc["wall_secs"]
+    assert wall > 0.0
+    # the buckets are constructed to PARTITION submit→resolve; banked
+    # coverage under 90% means a stamp went missing
+    assert sum(buckets.values()) >= 0.9 * wall
+    assert doc["coverage_pct"] >= 90.0
+    assert doc["bucket_commits"] > 0
+
+
+def test_sampler_overhead_within_budget(doc):
+    ov = doc["overhead"]
+    # duty accounting — exact busy/wall under the live w16 load
+    assert 0.0 <= ov["overhead_pct"] <= MAX_OVERHEAD_PCT, ov
+    assert ov["duty_phase_max_pct"] >= ov["overhead_pct"] - 1e-9
+    # the corroborating throughput A/B is banked with its noise floor,
+    # not trusted as a point estimate: it must exist and be well-formed
+    ab = ov["ab"]
+    assert ab["reps"] >= 4
+    assert ab["rows_per_s_off"] > 0 and ab["rows_per_s_on"] > 0
+    lo, hi = ab["pair_delta_spread_pct"]
+    assert lo <= ab["median_paired_delta_pct"] <= hi
+
+
+def test_adaptive_shed_was_live(doc):
+    # the governor must have been exercised during the banked run —
+    # an overhead number measured with the shed ladder inert says
+    # nothing about production behavior
+    ov = doc["overhead"]
+    assert ov["sheds_total"] >= 1 or (
+        doc["detail"]["sampler"]["sheds_total"] >= 1
+    )
+    assert ov["hz_effective"] > 0
+
+
+def test_detail_attribution_is_coherent(doc):
+    det = doc["detail"]
+    # sqlite COMMIT flush wall rides inside the commit pipeline
+    assert det["commit_fsync_count"] > 0
+    assert 0.0 < det["commit_fsync_secs"] < doc["wall_secs"]
+    # the w1 rung's statement shapes were profiled
+    assert any(k.startswith("insert:") for k in det["stmt_secs"])
+    assert det["stmt_rows"] and det["stmt_rows"][0]["count"] > 0
+    census = det["sampler"]
+    assert census["enabled"] is True
+    assert census["busy_secs_total"] > 0.0
+    assert det["w1_rows_per_s"] > 0
+
+
+def test_code_sha_stamps_the_profiled_files(doc):
+    shas = doc["code_sha"]
+    for path in (
+        "corrosion_tpu/runtime/profiler.py",
+        "corrosion_tpu/agent/run.py",
+        "corrosion_tpu/store/crdt.py",
+        "scripts/bench_ingest.py",
+    ):
+        assert shas.get(path) and shas[path] != "missing", path
