@@ -20,6 +20,7 @@ import contextlib
 import logging
 import os
 import random
+import threading
 from collections import deque
 from dataclasses import dataclass
 from pathlib import Path
@@ -668,6 +669,11 @@ async def shutdown(agent: Agent) -> None:
     await agent.tracker.wait_all(timeout=60.0)
     await agent.transport.close()
     await agent.listener.close()
+    if agent.commit_group is not None:
+        # r24: join the dedicated committer thread BEFORE the store
+        # closes under it (an in-flight commit finishes; new submits
+        # get a typed refusal instead of racing the close)
+        agent.commit_group.close()
     agent.store.close()
 
 
@@ -726,6 +732,120 @@ def _group_fanout_enabled(perf) -> bool:
     return getattr(perf, "group_fanout", True)
 
 
+def _committer_thread_enabled(perf) -> bool:
+    """r24 dedicated-committer gate: `[perf] committer_thread` config,
+    with the CORRO_COMMITTER env var overriding for bench A/B axes
+    (mirrors CORRO_GROUP_FANOUT — `to_thread`/`0` restores the
+    per-batch `asyncio.to_thread` hop as the r24 pre mode)."""
+    env = os.environ.get("CORRO_COMMITTER")
+    if env is not None:
+        return env.strip().lower() not in (
+            "0", "false", "no", "off", "to_thread"
+        )
+    return getattr(perf, "committer_thread", True)
+
+
+class _CommitterThread:
+    """One long-lived commit thread per store (r24, write-path round 4).
+
+    The r14–r23 path paid one `asyncio.to_thread` per batch: an
+    executor submit, a work-queue wakeup, a wrapper future and a
+    context copy — measured as the `to_thread_hop`+`asyncio_dispatch`
+    share (~37%) of the solo-writer wall in WRITE_PROFILE.json.  Here
+    the leader hands the batch over lock-free — a plain `deque.append`
+    (GIL-atomic) plus one `threading.Event` set — and parks on an
+    asyncio future; the committer drains whole entries in one dequeue
+    pass and resolves the parked future with a single
+    `loop.call_soon_threadsafe` wakeup.
+
+    Backpressure is unchanged by design: the leader still holds the
+    priority write gate for the whole commit, so a wedged committer
+    surfaces exactly like a wedged `to_thread` commit did — writers
+    queue behind the gate and the existing admission machinery turns
+    overload into typed refusals, never a new unbounded hang.  The
+    thread is named `corro-committer` so the continuous profiler's
+    `_NAME_TAGS` table classifies its samples under the `committer`
+    subsystem."""
+
+    def __init__(self, run: Callable):
+        self._run = run  # _commit_batch: called on the thread, may raise
+        self._q: deque = deque()
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+    def submit(self, loop, batch) -> asyncio.Future:
+        """Enqueue one batch (event-loop thread only); returns the
+        future the leader parks on.  Lazily starts the thread so agents
+        on the `to_thread` path never own an idle thread."""
+        import time as _time
+
+        fut = loop.create_future()
+        t = self._thread
+        if t is None or not t.is_alive():
+            if self._stop:  # closed at shutdown: refuse, don't strand
+                fut.set_exception(
+                    RuntimeError("committer thread is shut down")
+                )
+                return fut
+            self._thread = threading.Thread(
+                target=self._main, name="corro-committer", daemon=True
+            )
+            self._thread.start()
+        self._q.append((loop, fut, batch, _time.monotonic()))
+        METRICS.gauge("corro.write.committer.queue.depth").set(
+            len(self._q)
+        )
+        self._wake.set()
+        return fut
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._stop = True
+        self._wake.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+    def _main(self) -> None:
+        import time as _time
+
+        q = self._q
+        while True:
+            self._wake.wait()
+            self._wake.clear()
+            while q:
+                loop, fut, batch, enq = q.popleft()
+                METRICS.histogram(
+                    "corro.write.committer.handoff.seconds"
+                ).observe(_time.monotonic() - enq)
+                try:
+                    self._run(batch)
+                except BaseException as e:
+                    err: Optional[BaseException] = e
+                else:
+                    err = None
+                self._resolve(loop, fut, err)
+            if self._stop:
+                return
+
+    @staticmethod
+    def _resolve(loop, fut, err: Optional[BaseException]) -> None:
+        def _settle() -> None:
+            if fut.done():
+                return  # leader's loop died mid-commit; commit stands
+            if err is None:
+                fut.set_result(None)
+            else:
+                fut.set_exception(err)
+
+        try:
+            loop.call_soon_threadsafe(_settle)
+        except RuntimeError:
+            # the loop closed under us (hard shutdown): the sqlite
+            # commit itself stands — there is nobody left to tell
+            pass
+
+
 @dataclass
 class _GroupItem:
     """One writer's slot in a commit group."""
@@ -748,7 +868,7 @@ class _GroupItem:
     fanned: bool = False
     # r23 write-profile stamps (monotonic): the leader/commit thread
     # fill these so submit() can attribute the full submit→resolve wall
-    # across {asyncio dispatch, write gate, to_thread hop, finalize,
+    # across {asyncio dispatch, write gate, handoff, finalize,
     # sqlite flush} (corro.write.profile.seconds → WRITE_PROFILE.json)
     gate_start: float = 0.0
     gate_acq: float = 0.0
@@ -789,6 +909,13 @@ class GroupCommitter:
         # slow broadcast plane backpressures commits instead of piling
         # unfinished fanouts
         self._fanout_job: Optional[asyncio.Future] = None
+        # r24: the dedicated committer thread (lazily started on the
+        # first thread-mode batch; close() joins it at shutdown)
+        self._committer = _CommitterThread(self._commit_batch)
+
+    def close(self) -> None:
+        """Stop the committer thread (agent shutdown)."""
+        self._committer.close()
 
     async def submit(
         self,
@@ -893,9 +1020,17 @@ class GroupCommitter:
                         it.gate_start = t_gate
                         it.gate_acq = t_acq
                         it.dispatch = t_dispatch
-                    commit_job = asyncio.ensure_future(
-                        asyncio.to_thread(self._commit_batch, batch)
-                    )
+                    if _committer_thread_enabled(perf):
+                        # r24: lock-free handoff to the long-lived
+                        # committer thread — no executor submit, no
+                        # wrapper task, one loop wakeup on completion
+                        commit_job = self._committer.submit(
+                            asyncio.get_running_loop(), batch
+                        )
+                    else:
+                        commit_job = asyncio.ensure_future(
+                            asyncio.to_thread(self._commit_batch, batch)
+                        )
                     # shielded: a cancelled leader must not abandon a
                     # commit thread mid-flight (the store lock, not this
                     # gate, is the true sqlite guard)
@@ -1031,7 +1166,7 @@ class GroupCommitter:
         max_bytes = agent.config.perf.group_commit_max_bytes
         booked = agent.bookie.ensure(agent.actor_id)
         committed: List[_GroupItem] = []
-        t_thread = _time.monotonic()  # r23: the to_thread hop landed
+        t_thread = _time.monotonic()  # r23: the commit-thread handoff landed
         # a SOLO batch skips the per-writer savepoint (r15): with one
         # writer there are no batchmates to isolate, and its failure
         # aborts the whole group tx below — the uncontended fast path

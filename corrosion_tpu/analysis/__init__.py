@@ -24,6 +24,8 @@ Rules (see COMPONENTS.md "Static analysis" for the full table):
     metrics-doc     emitted series <-> COMPONENTS.md observability table
                     (both directions; the former scripts/lint_metrics.py)
     capture-parity  trigger DDL <-> direct-capture lockstep (r15)
+    finalize-parity native crdt_finalize_batch ABI <-> Python glue
+                    lockstep + counted columnar fallback (r24)
     timeout-discipline  network awaits in agent//api/ carry wait_for
                     deadlines (r18: the zombie-node hang class)
     actuator-discipline  remediation actuators declare cooldown /

@@ -224,6 +224,7 @@ def all_checkers() -> List[Checker]:
     from corrosion_tpu.analysis.blocking import AsyncBlockingChecker
     from corrosion_tpu.analysis.capture_parity import CaptureParityChecker
     from corrosion_tpu.analysis.codecext import CodecExtChecker
+    from corrosion_tpu.analysis.finalize_parity import FinalizeParityChecker
     from corrosion_tpu.analysis.lockcheck import LockDisciplineChecker
     from corrosion_tpu.analysis.metricsdoc import MetricsDocChecker
     from corrosion_tpu.analysis.parity import LaneParityChecker
@@ -238,6 +239,7 @@ def all_checkers() -> List[Checker]:
         LockDisciplineChecker(),
         CodecExtChecker(),
         CaptureParityChecker(),
+        FinalizeParityChecker(),
         MetricsDocChecker(),
         TimeoutDisciplineChecker(),
         ActuatorDisciplineChecker(),

@@ -170,6 +170,64 @@ def merge_batch_lib():
         return lib
 
 
+_finalize_lock = threading.Lock()
+_finalize_lib = None  # ctypes.CDLL, or False = unavailable (don't retry)
+
+
+def finalize_batch_lib():
+    """ctypes handle to the native local-commit finalize engine
+    (`native/crdt_batch.cpp::crdt_finalize_batch`, r24 — the
+    CORRO_FINALIZE=native phase B), or None when the native path is
+    unavailable.  Shares the crdt_batch.so build with the merge engine;
+    built once per process, content-hash gated.  The store glue falls
+    back to the columnar Python engine (counted by
+    `corro.write.finalize.native.unavailable`) when this returns None."""
+    global _finalize_lib
+    with _finalize_lock:
+        if _finalize_lib is not None:
+            return _finalize_lib or None
+        import ctypes
+
+        path = _build_so(_BATCH_SRC, _BATCH_SO)
+        if path is None:
+            _finalize_lib = False
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            fn = lib.crdt_finalize_batch
+        except (OSError, AttributeError) as e:
+            log.warning("could not load native finalize library: %s", e)
+            _finalize_lib = False
+            return None
+        c = ctypes
+        fn.restype = c.c_int
+        fn.argtypes = [
+            # group geometry
+            c.c_int32, c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+            c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+            c.POINTER(c.c_int32),
+            # row snapshot
+            c.c_int32, c.POINTER(c.c_int64), c.POINTER(c.c_uint8),
+            # cv snapshot
+            c.c_int32, c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+            c.POINTER(c.c_int64),
+            # spec outputs
+            c.POINTER(c.c_int32),
+            c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+            c.POINTER(c.c_int32),
+            c.POINTER(c.c_int64), c.POINTER(c.c_int64),
+            # rows_up / clock_clear / clock_put plans
+            c.POINTER(c.c_int32), c.POINTER(c.c_int64),
+            c.POINTER(c.c_int32),
+            c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+            c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+            c.POINTER(c.c_int64), c.POINTER(c.c_int32),
+            c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+        ]
+        _finalize_lib = lib
+        return lib
+
+
 def load_into(conn) -> bool:
     """Load the extension into a sqlite3 connection; False → caller must
     register the Python fallbacks."""

@@ -124,6 +124,16 @@ class PerfConfig:
     # installed and capture raw/unrecognized SQL; false (or env
     # CORRO_CAPTURE=trigger) restores the pure trigger path.
     direct_capture: bool = True
+    # dedicated committer thread (r24): one long-lived thread per store
+    # runs every group commit, fed by a lock-free handoff deque + a
+    # single event-loop wakeup — the leader parks on a future instead of
+    # paying an executor submit/teardown (`asyncio.to_thread`) per
+    # batch.  Backpressure is unchanged: the leader still holds the
+    # priority write gate across the commit, so a stuck committer
+    # surfaces as the existing typed gate refusals, never a new hang.
+    # false (or env CORRO_COMMITTER=to_thread) restores the r15–r23
+    # per-batch to_thread hop (the ingest bench's r24 pre mode).
+    committer_thread: bool = True
     # broadcast
     broadcast_interval_ms: int = 500
     broadcast_cutoff_bytes: int = 64 * 1024

@@ -65,6 +65,7 @@ MAX_DEPTH = 48
 # extend it when a new named thread family appears — COMPONENTS.md
 # "Continuous profiling" documents the procedure)
 _NAME_TAGS = (
+    ("corro-committer", "committer"),
     ("corro-subs-diff", "fanout"),
     ("asyncio_", "worker"),
     ("ThreadPoolExecutor", "worker"),
@@ -442,11 +443,14 @@ def record_stmt(shape: str, secs: float) -> None:
 # PARTITION the submit→resolve wall: `sqlite_flush` is the worker-
 # thread wall minus finalize (statement exec + COMMIT fsync +
 # bookkeeping — the in-sqlite residual), `asyncio_dispatch` the
-# loop-side scheduling on both ends.
+# loop-side scheduling on both ends.  r24 renamed `to_thread_hop` →
+# `handoff`: the gate_acq→thread_start span is now the committer
+# thread's deque pickup latency (on CORRO_COMMITTER=to_thread it is
+# the old executor hop again), same partition arithmetic either way.
 WRITE_BUCKETS = (
     "asyncio_dispatch",
     "write_gate",
-    "to_thread_hop",
+    "handoff",
     "finalize",
     "sqlite_flush",
 )
@@ -483,7 +487,7 @@ def record_write_buckets(
     hist("corro.write.profile.seconds", bucket="write_gate").observe(
         gate_acq - gate_start
     )
-    hist("corro.write.profile.seconds", bucket="to_thread_hop").observe(
+    hist("corro.write.profile.seconds", bucket="handoff").observe(
         thread_start - dispatch + (dispatch - gate_acq)
     )
     hist("corro.write.profile.seconds", bucket="finalize").observe(

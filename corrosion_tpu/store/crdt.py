@@ -208,14 +208,26 @@ def _finalize_engine() -> str:
     as the ingest bench's r21 pre mode.  "percell": the per-cell
     reference loop (one SELECT+upsert round-trip per pending cell),
     the semantic reference for the randomized equivalence pin
-    (tests/test_finalize_batch.py)."""
+    (tests/test_finalize_batch.py).  "native" (r24): the phase-B
+    decision loop transcribed to C++ (`native/crdt_batch.cpp::
+    crdt_finalize_batch`, bit-identical to all three Python engines
+    under the randomized pins); hosts where the .so cannot build fall
+    back to "columnar", counted by
+    `corro.write.finalize.native.unavailable`."""
     eng = os.environ.get("CORRO_FINALIZE", "columnar")
-    if eng not in ("columnar", "vector", "percell"):
+    if eng not in ("columnar", "vector", "percell", "native"):
         raise ValueError(
             f"unknown CORRO_FINALIZE {eng!r} "
-            "(expected 'columnar', 'vector' or 'percell')"
+            "(expected 'columnar', 'vector', 'percell' or 'native')"
         )
     return eng
+
+
+# finalize-parity markers (analysis/finalize_parity.py): the native
+# finalize ABI — these must match `FINALIZE_ABI_VERSION` /
+# `FIN_CID_SENTINEL` in native/crdt_batch.cpp, pinned at lint time.
+_NATIVE_FINALIZE_ABI = 1
+_NATIVE_SENTINEL_CID = -1  # interned id `_phase_b_native` sends for SENTINEL
 
 
 def _capture_engine() -> str:
@@ -1098,7 +1110,13 @@ class CrdtStore:
         start_dv = self.db_version_for(site)
         next_dv = start_dv + 1
 
-        if _finalize_engine() == "columnar":
+        eng = _finalize_engine()
+        if eng == "native":
+            next_dv = self._phase_b_native(
+                deduped, items, cur_cl, cv_state, rows_up, clock_clear,
+                clock_put, out, next_dv,
+            )
+        elif eng == "columnar":
             next_dv = self._phase_b_columnar(
                 deduped, items, cur_cl, cv_state, rows_up, clock_clear,
                 clock_put, out, next_dv,
@@ -1325,6 +1343,217 @@ class CrdtStore:
             from corrosion_tpu.runtime.metrics import METRICS
 
             METRICS.counter("corro.write.finalize.columnar.total").inc(
+                len(all_specs)
+            )
+        new_change = Change.__new__
+        for a, b, ts in item_slices:
+            changes: List[Change] = []
+            for spec, cell in zip(all_specs[a:b], blobs[a:b]):
+                tbl, pk, cid, val, cv, dbv, seq, cl = spec
+                ch = new_change(Change)
+                ch.__dict__.update(
+                    table=tbl, pk=pk, cid=cid, val=val, col_version=cv,
+                    db_version=dbv, seq=seq, site_id=site_bytes, cl=cl,
+                    ts=ts, wire_cell=cell,
+                )
+                changes.append(ch)
+            out.append(changes)
+        return next_dv
+
+    def _phase_b_native(
+        self, deduped, items, cur_cl, cv_state, rows_up, clock_clear,
+        clock_put, out, next_dv,
+    ) -> int:
+        """Native finalize phase B (r24, CORRO_FINALIZE=native): the
+        decision loop runs in C++ (`native/crdt_batch.cpp::
+        crdt_finalize_batch`), Python keeps the value plane.
+
+        The glue interns the group's (table, pk) rows and cids to dense
+        integer ids, ships the deduped order keys / deleted-row sets /
+        phase-A snapshot as flat arrays, and gets back per-item change
+        SPECS (seq implicit by position, db_version derived here — the
+        same consecutive-assignment rule every engine uses) plus the
+        final rows/clock plans with Python-dict insertion-order
+        semantics.  Values never cross the boundary: a column spec
+        carries its global order index and the value is fetched from
+        the item's own deduped cells, then the WHOLE group encodes via
+        the same one-pass `write_change_cells` call the columnar engine
+        uses — byte-identity pinned in tests/test_finalize_batch.py.
+
+        A host where the .so cannot build (or the call reports a
+        malformed batch, which a correct glue never produces) falls
+        back to the columnar engine, silently but COUNTED:
+        `corro.write.finalize.native.unavailable`."""
+        from corrosion_tpu import native as _native_mod
+        from corrosion_tpu.runtime.metrics import METRICS
+
+        lib = _native_mod.finalize_batch_lib()
+        if lib is None:
+            METRICS.counter(
+                "corro.write.finalize.native.unavailable"
+            ).inc()
+            return self._phase_b_columnar(
+                deduped, items, cur_cl, cv_state, rows_up, clock_clear,
+                clock_put, out, next_dv,
+            )
+        import ctypes as C
+
+        # -- intern rows/cids + flatten the group geometry -----------------
+        row_ids: Dict[Tuple[str, bytes], int] = {}
+        rows: List[Tuple[str, bytes]] = []
+        cid_ids: Dict[str, int] = {}
+        cids: List[str] = []
+        del_off = [0]
+        del_rows: List[int] = []
+        ord_off = [0]
+        ord_rows: List[int] = []
+        ord_cids: List[int] = []
+        ord_keys: List[tuple] = []  # global order index -> (tbl, pk, cid)
+        for cells, order, deleted_rows in deduped:
+            for k in deleted_rows:
+                i = row_ids.get(k)
+                if i is None:
+                    i = row_ids[k] = len(rows)
+                    rows.append(k)
+                del_rows.append(i)
+            del_off.append(len(del_rows))
+            for key in order:
+                tbl, pk, cid = key
+                k = (tbl, pk)
+                i = row_ids.get(k)
+                if i is None:
+                    i = row_ids[k] = len(rows)
+                    rows.append(k)
+                ord_rows.append(i)
+                if cid == SENTINEL:
+                    ord_cids.append(_NATIVE_SENTINEL_CID)
+                else:
+                    ci = cid_ids.get(cid)
+                    if ci is None:
+                        ci = cid_ids[cid] = len(cids)
+                        cids.append(cid)
+                    ord_cids.append(ci)
+                ord_keys.append(key)
+            ord_off.append(len(ord_rows))
+
+        cap = len(del_rows) + len(ord_rows)
+        if cap == 0:
+            for _ in deduped:
+                out.append([])
+            return next_dv
+
+        n_rows = len(rows)
+        row_cl = [0] * n_rows
+        row_ex = [0] * n_rows
+        for k, i in row_ids.items():
+            cl = cur_cl.get(k)
+            if cl is not None:
+                row_cl[i] = cl
+                row_ex[i] = 1
+        cv_r: List[int] = []
+        cv_c: List[int] = []
+        cv_v: List[int] = []
+        for k2, entry in cv_state.items():
+            i = row_ids.get(k2)
+            if i is None:
+                continue
+            for cid, v in entry.items():
+                ci = cid_ids.get(cid)
+                if ci is None:
+                    continue  # probe row whose cid this group never writes
+                cv_r.append(i)
+                cv_c.append(ci)
+                cv_v.append(v)
+
+        I32, I64, U8 = C.c_int32, C.c_int64, C.c_uint8
+
+        def arr(ctype, lst):
+            return (ctype * max(1, len(lst)))(*lst)
+
+        spec_count = (I32 * len(deduped))()
+        spec_row = (I32 * cap)()
+        spec_cid = (I32 * cap)()
+        spec_ord = (I32 * cap)()
+        spec_cv = (I64 * cap)()
+        spec_cl = (I64 * cap)()
+        up_row = (I32 * cap)()
+        up_cl = (I64 * cap)()
+        n_up = I32()
+        clear_row = (I32 * cap)()
+        n_clear = I32()
+        put_row = (I32 * cap)()
+        put_cid = (I32 * cap)()
+        put_cv = (I64 * cap)()
+        put_item = (I32 * cap)()
+        put_seq = (I32 * cap)()
+        n_put = I32()
+        rc = lib.crdt_finalize_batch(
+            len(deduped), arr(I32, del_off), arr(I32, del_rows),
+            arr(I32, ord_off), arr(I32, ord_rows), arr(I32, ord_cids),
+            n_rows, arr(I64, row_cl), arr(U8, row_ex),
+            len(cv_r), arr(I32, cv_r), arr(I32, cv_c), arr(I64, cv_v),
+            spec_count, spec_row, spec_cid, spec_ord, spec_cv, spec_cl,
+            up_row, up_cl, C.byref(n_up), clear_row, C.byref(n_clear),
+            put_row, put_cid, put_cv, put_item, put_seq, C.byref(n_put),
+        )
+        if rc != 0:
+            METRICS.counter(
+                "corro.write.finalize.native.unavailable"
+            ).inc()
+            return self._phase_b_columnar(
+                deduped, items, cur_cl, cv_state, rows_up, clock_clear,
+                clock_put, out, next_dv,
+            )
+
+        # -- materialize specs / plans back into the phase-C shapes --------
+        site_bytes = self.site_id.bytes16
+        all_specs: List[tuple] = []
+        item_slices: List[tuple] = []  # (start, end, ts)
+        item_meta: List[tuple] = []  # (db_version, ts_ntp) per item
+        pos = 0
+        for idx, ((cells, _order, _deleted), (_pending, ts)) in enumerate(
+            zip(deduped, items)
+        ):
+            cnt = spec_count[idx]
+            db_version = next_dv
+            if cnt:
+                next_dv += 1
+            item_meta.append((db_version, ts.ntp64))
+            for seq in range(cnt):
+                j = pos + seq
+                tbl, pk = rows[spec_row[j]]
+                ci = spec_cid[j]
+                if ci == _NATIVE_SENTINEL_CID:
+                    cid, val = SENTINEL, None
+                else:
+                    cid = cids[ci]
+                    val = cells[ord_keys[spec_ord[j]]]
+                all_specs.append((
+                    tbl, pk, cid, val, spec_cv[j], db_version, seq,
+                    spec_cl[j],
+                ))
+            item_slices.append((pos, pos + cnt, ts))
+            pos += cnt
+        for j in range(n_up.value):
+            tbl, pk = rows[up_row[j]]
+            rows_up.setdefault(tbl, {})[pk] = up_cl[j]
+        for j in range(n_clear.value):
+            tbl, pk = rows[clear_row[j]]
+            clock_clear.setdefault(tbl, {})[pk] = None
+        for j in range(n_put.value):
+            tbl, pk = rows[put_row[j]]
+            ci = put_cid[j]
+            cid = SENTINEL if ci == _NATIVE_SENTINEL_CID else cids[ci]
+            dbv, ts_ntp = item_meta[put_item[j]]
+            clock_put.setdefault(tbl, {}).setdefault(pk, {})[cid] = (
+                put_cv[j], dbv, put_seq[j], site_bytes, ts_ntp,
+            )
+
+        # ONE vectorized pack pass — the same batch encoder (and the
+        # same Change materialization) as the columnar engine
+        blobs = write_change_cells(all_specs, site_bytes)
+        if all_specs:
+            METRICS.counter("corro.write.finalize.native.total").inc(
                 len(all_specs)
             )
         new_change = Change.__new__

@@ -237,3 +237,227 @@ extern "C" int crdt_merge_batch(
   *out_n_clocks = nk;
   return 0;
 }
+
+// ---------------------------------------------------------------------------
+// Native local-commit finalize (r24, write-path round 4): the phase-B
+// decision loop of corrosion_tpu/store/crdt.py::finalize_group in C++.
+//
+// Python keeps phase A (the bulk clock/rows probes) and phase C (the
+// grouped executemany flush); this function is handed the deduped
+// (row, cid) order keys + deleted-row sets for EVERY item in the commit
+// group as interned integer arrays, plus the probed cl/col_version
+// snapshot, and returns (a) per-item change SPECS — row/cid/value
+// index/col_version/causal length, seq implicit by position — and (b)
+// the final rows-upsert / clock-clear / clock-put plans with Python
+// dict insertion-order semantics (an overwritten key keeps its slot, a
+// cleared-then-re-put key APPENDS — `del puts[cid]` then re-insert).
+// Values never cross the boundary: a column spec carries the global
+// order index, and the glue fetches the Python value + encodes via
+// write_change_cells exactly as the columnar engine does.
+//
+// The walk is the sequential immediate-effect decision loop — the
+// columnar engine's own in-order fallback, which coincides with its
+// kind-split batches whenever every SENTINEL precedes its own row's
+// column cells (the capture-plane convention) and with the percell
+// reference always.  Bit-identity across all four engines is pinned by
+// tests/test_finalize_batch.py.
+
+// ---- finalize-parity markers (analysis/finalize_parity.py) ----------------
+// These must stay in lockstep with the Python glue
+// (store/crdt.py::_phase_b_native): the finalize-parity static rule
+// pins the ABI version, the sentinel column id and the parity
+// arithmetic below against the columnar engine at lint time.
+#define FINALIZE_ABI_VERSION 1
+
+namespace {
+
+constexpr int32_t FIN_CID_SENTINEL = -1;  // the SENTINEL clock column id
+
+struct PutEnt {
+  int32_t row, cid, item, seq;
+  int64_t cv;
+  bool alive;
+};
+
+struct CvEnt {
+  int64_t cv;
+  uint32_t gen;
+};
+
+}  // namespace
+
+extern "C" int crdt_finalize_batch(
+    // group geometry: item i's deleted rows span del_off[i]..del_off[i+1],
+    // its deduped order keys span ord_off[i]..ord_off[i+1] (cid -1 =
+    // sentinel); both off arrays have n_items+1 entries
+    int32_t n_items, const int32_t* del_off, const int32_t* del_row,
+    const int32_t* ord_off, const int32_t* ord_row, const int32_t* ord_cid,
+    // phase-A snapshot: per interned row the current causal length and
+    // whether the row exists at all (cur_cl's absent-key distinction)
+    int32_t n_rows, const int64_t* row_cl, const uint8_t* row_exists,
+    // cv_state triples: (row, cid, col_version) from the clock probe
+    int32_t n_cv, const int32_t* cv_row, const int32_t* cv_cid,
+    const int64_t* cv_val,
+    // outputs — caller allocates capacity n_del_total + n_ord_total for
+    // every flat array (every delete/order key emits at most one spec,
+    // and each plan grows at most once per spec)
+    int32_t* out_spec_count,  // [n_items]
+    int32_t* out_spec_row, int32_t* out_spec_cid,
+    int32_t* out_spec_ord,  // global order index of the value, -1 = none
+    int64_t* out_spec_cv, int64_t* out_spec_cl,
+    int32_t* out_up_row, int64_t* out_up_cl, int32_t* out_n_up,
+    int32_t* out_clear_row, int32_t* out_n_clear,
+    int32_t* out_put_row, int32_t* out_put_cid, int64_t* out_put_cv,
+    int32_t* out_put_item, int32_t* out_put_seq, int32_t* out_n_put) {
+  if (n_items < 0 || n_rows < 0 || n_cv < 0) return 2;
+
+  std::vector<int64_t> cl_live(row_cl, row_cl + n_rows);
+  std::vector<uint8_t> exists(row_exists, row_exists + n_rows);
+  std::vector<uint32_t> cv_gen(n_rows, 0);
+
+  std::unordered_map<uint64_t, CvEnt> cvs;
+  cvs.reserve((size_t)n_cv * 2);
+  for (int32_t i = 0; i < n_cv; ++i) {
+    if (cv_row[i] < 0 || cv_row[i] >= n_rows || cv_cid[i] < 0) return 2;
+    cvs[keyof(cv_row[i], cv_cid[i])] = CvEnt{cv_val[i], 0};
+  }
+
+  // rows_up: dict-ordered upsert plan (overwrite in place, append new)
+  std::vector<int32_t> up_pos(n_rows, -1);
+  int32_t n_up = 0;
+  auto rows_up_set = [&](int32_t row, int64_t cl) {
+    if (up_pos[row] < 0) {
+      up_pos[row] = n_up;
+      out_up_row[n_up] = row;
+      out_up_cl[n_up] = cl;
+      ++n_up;
+    } else {
+      out_up_cl[up_pos[row]] = cl;
+    }
+  };
+
+  // clock_clear: dict-ordered insert-once set
+  std::vector<uint8_t> clear_seen(n_rows, 0);
+  int32_t n_clear = 0;
+
+  // clock_put with Python dict semantics: an existing key updates in
+  // place; clear_clocks `del`s the row's non-sentinel keys so a later
+  // re-put of the same (row, cid) APPENDS at the tail
+  std::vector<PutEnt> puts;
+  puts.reserve(64);
+  std::unordered_map<uint64_t, int32_t> put_pos;
+  std::vector<std::vector<int32_t>> row_puts(n_rows);
+  auto put = [&](int32_t row, int32_t cid, int64_t cv, int32_t item,
+                 int32_t seq) {
+    uint64_t k = keyof(row, cid);
+    auto it = put_pos.find(k);
+    if (it != put_pos.end()) {
+      PutEnt& e = puts[it->second];
+      e.cv = cv;
+      e.item = item;
+      e.seq = seq;
+    } else {
+      put_pos[k] = (int32_t)puts.size();
+      if (cid != FIN_CID_SENTINEL)
+        row_puts[row].push_back((int32_t)puts.size());
+      puts.push_back(PutEnt{row, cid, item, seq, cv, true});
+    }
+  };
+  auto clear_clocks = [&](int32_t row) {
+    if (!clear_seen[row]) {
+      clear_seen[row] = 1;
+      out_clear_row[n_clear++] = row;
+    }
+    cv_gen[row]++;  // cv_state.pop(row): snapshot + earlier puts die
+    for (int32_t pos : row_puts[row]) {
+      PutEnt& e = puts[pos];
+      if (e.alive) {
+        e.alive = false;
+        put_pos.erase(keyof(e.row, e.cid));
+      }
+    }
+    row_puts[row].clear();
+  };
+  auto cv_get = [&](int32_t row, int32_t cid) -> int64_t {
+    auto it = cvs.find(keyof(row, cid));
+    if (it == cvs.end() || it->second.gen != cv_gen[row]) return 0;
+    return it->second.cv;
+  };
+
+  int32_t spec_n = 0;  // flat write cursor across items
+  for (int32_t it_i = 0; it_i < n_items; ++it_i) {
+    if (del_off[it_i] > del_off[it_i + 1] ||
+        ord_off[it_i] > ord_off[it_i + 1])
+      return 2;
+    int32_t item_start = spec_n;
+    auto emit = [&](int32_t row, int32_t cid, int32_t ord, int64_t cv,
+                    int64_t cl) -> int32_t {
+      int32_t seq = spec_n - item_start;
+      out_spec_row[spec_n] = row;
+      out_spec_cid[spec_n] = cid;
+      out_spec_ord[spec_n] = ord;
+      out_spec_cv[spec_n] = cv;
+      out_spec_cl[spec_n] = cl;
+      ++spec_n;
+      return seq;
+    };
+    // delete kind first: bumped-EVEN causal lengths (the tombstone
+    // parity), row clocks wiped, one sentinel spec per deleted row
+    for (int32_t j = del_off[it_i]; j < del_off[it_i + 1]; ++j) {
+      int32_t row = del_row[j];
+      if (row < 0 || row >= n_rows) return 2;
+      int64_t cl = (exists[row] ? cl_live[row] : 1) + 1;
+      cl += (cl & 1);
+      cl_live[row] = cl;
+      exists[row] = 1;
+      rows_up_set(row, cl);
+      clear_clocks(row);
+      int32_t seq = emit(row, FIN_CID_SENTINEL, -1, cl, cl);
+      put(row, FIN_CID_SENTINEL, cl, it_i, seq);
+    }
+    // in-order decision walk over the deduped keys (sequential
+    // immediate-effect semantics — see the header comment)
+    for (int32_t j = ord_off[it_i]; j < ord_off[it_i + 1]; ++j) {
+      int32_t row = ord_row[j], cid = ord_cid[j];
+      if (row < 0 || row >= n_rows || cid < FIN_CID_SENTINEL) return 2;
+      if (cid == FIN_CID_SENTINEL) {
+        // sentinel kind: creation (row unseen) or resurrection (even
+        // cl -> next odd); an alive row's sentinel is a no-op
+        bool ex = exists[row] != 0;
+        int64_t prev = ex ? cl_live[row] : 0;
+        int64_t cl = (prev % 2 == 0) ? prev + 1 : prev;
+        if (!ex || prev % 2 == 0) {
+          cl_live[row] = cl;
+          exists[row] = 1;
+          rows_up_set(row, cl);
+          if (prev % 2 == 0 && prev > 0) clear_clocks(row);
+          int32_t seq = emit(row, FIN_CID_SENTINEL, -1, cl, cl);
+          put(row, FIN_CID_SENTINEL, cl, it_i, seq);
+        }
+      } else {
+        // column kind: live causal length + bumped col_version
+        int64_t cl = exists[row] ? cl_live[row] : 1;
+        int64_t cv = cv_get(row, cid) + 1;
+        cvs[keyof(row, cid)] = CvEnt{cv, cv_gen[row]};
+        int32_t seq = emit(row, cid, j, cv, cl);
+        put(row, cid, cv, it_i, seq);
+      }
+    }
+    out_spec_count[it_i] = spec_n - item_start;
+  }
+
+  *out_n_up = n_up;
+  *out_n_clear = n_clear;
+  int32_t n_put = 0;
+  for (const PutEnt& e : puts) {
+    if (!e.alive) continue;
+    out_put_row[n_put] = e.row;
+    out_put_cid[n_put] = e.cid;
+    out_put_cv[n_put] = e.cv;
+    out_put_item[n_put] = e.item;
+    out_put_seq[n_put] = e.seq;
+    ++n_put;
+  }
+  *out_n_put = n_put;
+  return 0;
+}

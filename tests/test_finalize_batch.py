@@ -1,9 +1,10 @@
 """Pins for the vectorized local-commit finalize (r14 batch, r21
-columnar).
+columnar, r24 native).
 
-1. Randomized equivalence: BOTH non-reference engines — the r14/r15
-   per-cell emit loop (`CORRO_FINALIZE=vector`) and the r21 columnar
-   phase B (`CORRO_FINALIZE=columnar`, the default) — must emit
+1. Randomized equivalence: ALL non-reference engines — the r14/r15
+   per-cell emit loop (`CORRO_FINALIZE=vector`), the r21 columnar
+   phase B (`CORRO_FINALIZE=columnar`, the default), and the r24 C++
+   decision loop (`CORRO_FINALIZE=native`) — must emit
    byte/clock-identical changes AND leave byte-identical data/rows/clock
    tables vs the per-cell reference `_finalize_pending_percell` for ANY
    statement mix — delete/reinsert chains inside one tx, dedupe
@@ -146,7 +147,7 @@ def run_engine(monkeypatch, engine: str, txs) -> tuple:
     return all_changes, dump
 
 
-@pytest.mark.parametrize("engine", ["vector", "columnar"])
+@pytest.mark.parametrize("engine", ["vector", "columnar", "native"])
 @pytest.mark.parametrize("seed", [1, 7, 23, 99])
 def test_finalize_engines_equivalent_to_percell(monkeypatch, seed, engine):
     rng = random.Random(seed)
@@ -185,9 +186,32 @@ def test_columnar_wire_cells_identical_to_percell(monkeypatch):
         return cells
 
     assert wire("columnar") == wire("percell")
+    assert wire("native") == wire("percell")
 
 
-@pytest.mark.parametrize("engine", ["vector", "columnar"])
+def test_native_finalize_falls_back_to_columnar_when_unavailable(monkeypatch):
+    """No-compiler hosts (r24): `CORRO_FINALIZE=native` with no loadable
+    crdt_batch.so must silently produce the columnar engine's results —
+    byte-identical changes and state — while counting each fallback on
+    `corro.write.finalize.native.unavailable` so fleet dashboards can
+    see hosts running degraded."""
+    import corrosion_tpu.native as native_mod
+    from corrosion_tpu.runtime.metrics import METRICS
+
+    txs = random_txs(random.Random(5), 12)
+    ch_ref, dump_ref = run_engine(monkeypatch, "columnar", txs)
+
+    monkeypatch.setattr(native_mod, "finalize_batch_lib", lambda: None)
+    before = METRICS.counter("corro.write.finalize.native.unavailable").value
+    ch_nat, dump_nat = run_engine(monkeypatch, "native", txs)
+    after = METRICS.counter("corro.write.finalize.native.unavailable").value
+
+    assert ch_nat == ch_ref
+    assert dump_nat == dump_ref
+    assert after > before
+
+
+@pytest.mark.parametrize("engine", ["vector", "columnar", "native"])
 def test_delete_reinsert_same_tx_equivalence(monkeypatch, engine):
     """The trickiest dedupe path, pinned explicitly: delete + re-insert
     (and insert + delete + re-insert) of the same pk inside ONE tx."""

@@ -36,6 +36,9 @@ from corrosion_tpu.analysis.capture_parity import (  # noqa: E402
     CaptureParityChecker,
 )
 from corrosion_tpu.analysis.codecext import CodecExtChecker  # noqa: E402
+from corrosion_tpu.analysis.finalize_parity import (  # noqa: E402
+    FinalizeParityChecker,
+)
 from corrosion_tpu.analysis.lockcheck import (  # noqa: E402
     LockDisciplineChecker,
 )
@@ -751,6 +754,146 @@ def test_capture_parity_fires_on_missing_columnar_builder(tmp_path):
     assert any(f.snippet == "missing-columnar-builder" for f in fs), fs
 
 
+# -- 7b. finalize-parity (r24 native engine <-> Python glue) ----------------
+
+_NATIVE_CRDT_OK = """
+    SENTINEL = "-1"
+    _NATIVE_FINALIZE_ABI = 2
+    _NATIVE_SENTINEL_CID = -1
+
+    def _finalize_engine():
+        return "native"
+
+    class Store:
+        def _phase_b_columnar(self, specs):
+            return [s for s in specs if s[2] != SENTINEL]
+
+        def _phase_b_native(self, specs):
+            lib = finalize_batch_lib()
+            if lib is None:
+                METRICS.counter(
+                    "corro.write.finalize.native.unavailable"
+                ).inc()
+                return self._phase_b_columnar(specs)
+            cells = [s for s in specs if s[2] != SENTINEL]
+            return write_change_cells(cells, b"site")
+"""
+
+_NATIVE_CPP_OK = """
+    #define FINALIZE_ABI_VERSION 2
+    constexpr int32_t FIN_CID_SENTINEL = -1;
+    extern "C" int crdt_finalize_batch(int32_t n_items) {
+      int64_t cl = 3;
+      cl += (cl & 1);
+      if (cl % 2 == 0) return 0;
+      return 0;
+    }
+"""
+
+
+def _finalize_parity_fixture(
+    tmp_path, crdt_body=_NATIVE_CRDT_OK, cpp_body=_NATIVE_CPP_OK
+):
+    _write(tmp_path, "store/crdt.py", crdt_body)
+    _write(tmp_path, "native/crdt_batch.cpp", cpp_body)
+    return FinalizeParityChecker(
+        crdt="store/crdt.py", cpp="native/crdt_batch.cpp"
+    )
+
+
+def test_finalize_parity_clean_when_lockstep(tmp_path):
+    checker = _finalize_parity_fixture(tmp_path)
+    assert checker.run(AnalysisContext(str(tmp_path))) == []
+
+
+def test_finalize_parity_silent_when_no_native_engine(tmp_path):
+    body = _NATIVE_CRDT_OK.replace('return "native"', 'return "columnar"')
+    checker = _finalize_parity_fixture(tmp_path, crdt_body=body)
+    assert checker.run(AnalysisContext(str(tmp_path))) == []
+
+
+def test_finalize_parity_fires_on_abi_version_drift(tmp_path):
+    body = _NATIVE_CPP_OK.replace(
+        "#define FINALIZE_ABI_VERSION 2", "#define FINALIZE_ABI_VERSION 3"
+    )
+    checker = _finalize_parity_fixture(tmp_path, cpp_body=body)
+    fs = checker.run(AnalysisContext(str(tmp_path)))
+    assert any(f.snippet == "abi-version-drift" for f in fs), fs
+    assert all(f.path == "native/crdt_batch.cpp" for f in fs), fs
+
+
+def test_finalize_parity_fires_on_sentinel_id_drift(tmp_path):
+    body = _NATIVE_CPP_OK.replace(
+        "FIN_CID_SENTINEL = -1", "FIN_CID_SENTINEL = -2"
+    )
+    checker = _finalize_parity_fixture(tmp_path, cpp_body=body)
+    fs = checker.run(AnalysisContext(str(tmp_path)))
+    assert any(f.snippet == "sentinel-id-drift" for f in fs), fs
+
+
+def test_finalize_parity_fires_on_missing_native_builder(tmp_path):
+    body = _NATIVE_CRDT_OK.replace(
+        "def _phase_b_native", "def _phase_b_other"
+    )
+    checker = _finalize_parity_fixture(tmp_path, crdt_body=body)
+    fs = checker.run(AnalysisContext(str(tmp_path)))
+    assert any(f.snippet == "missing-native-builder" for f in fs), fs
+
+
+def test_finalize_parity_fires_on_missing_export(tmp_path):
+    body = _NATIVE_CPP_OK.replace(
+        'extern "C" int crdt_finalize_batch', "static int finalize_impl"
+    )
+    checker = _finalize_parity_fixture(tmp_path, cpp_body=body)
+    fs = checker.run(AnalysisContext(str(tmp_path)))
+    assert any(f.snippet == "missing-native-export" for f in fs), fs
+
+
+def test_finalize_parity_fires_on_uncounted_fallback(tmp_path):
+    body = _NATIVE_CRDT_OK.replace(
+        """            if lib is None:
+                METRICS.counter(
+                    "corro.write.finalize.native.unavailable"
+                ).inc()
+                return self._phase_b_columnar(specs)
+""",
+        """            if lib is None:
+                return self._phase_b_columnar(specs)
+""",
+    )
+    checker = _finalize_parity_fixture(tmp_path, crdt_body=body)
+    fs = checker.run(AnalysisContext(str(tmp_path)))
+    assert any(f.snippet == "native-fallback-uncounted" for f in fs), fs
+
+
+def test_finalize_parity_fires_on_dropped_fallback(tmp_path):
+    body = _NATIVE_CRDT_OK.replace(
+        "return self._phase_b_columnar(specs)", "raise RuntimeError(lib)"
+    )
+    checker = _finalize_parity_fixture(tmp_path, crdt_body=body)
+    fs = checker.run(AnalysisContext(str(tmp_path)))
+    assert any(f.snippet == "native-fallback-drift" for f in fs), fs
+
+
+def test_finalize_parity_noqa_suppresses(tmp_path):
+    body = _NATIVE_CRDT_OK.replace(
+        "def _phase_b_native(self, specs):",
+        "def _phase_b_native(self, specs):"
+        "  # corro: noqa[finalize-parity]",
+    ).replace(
+        "return write_change_cells(cells, b\"site\")", "return cells"
+    )
+    checker = _finalize_parity_fixture(tmp_path, crdt_body=body)
+    ctx = AnalysisContext(str(tmp_path))
+    result = run_analysis(ctx, [checker], baseline={})
+    assert result.new == []
+    assert result.suppressed, "the encoder-drift finding must be noqa'd"
+
+
+def test_finalize_parity_real_tree_is_clean():
+    assert FinalizeParityChecker().run(AnalysisContext(REPO)) == []
+
+
 # -- 8. timeout-discipline --------------------------------------------------
 
 _UNBOUNDED_NET_AWAITS = """
@@ -1097,7 +1240,7 @@ def test_profiler_safety_reaches_the_fold_map(tmp_path):
 
 
 def test_metrics_fold_reports_same_inventory():
-    """The lint_metrics fold is lossless: same 250 literal series (218
+    """The lint_metrics fold is lossless: same 254 literal series (218
     at r19 + the 15 r20 alerting-plane series — corro.tsdb.*,
     corro.alerts.*, corro.metrics.{series,cardinality.dropped.total},
     corro.store.write.errors.total — + the 3 r21 write-path series:
@@ -1111,7 +1254,10 @@ def test_metrics_fold_reports_same_inventory():
     corro.profile.{samples.total, shed.total, captures.total,
     overhead.pct}, corro.store.stmt.seconds,
     corro.write.profile.seconds and the two commit-flush series
-    corro.store.commit.{flush.seconds, stall.total}), same 2 wildcard
+    corro.store.commit.{flush.seconds, stall.total}, + the 4 r24
+    committer/native-finalize series:
+    corro.write.committer.{queue.depth, handoff.seconds} and
+    corro.write.finalize.native.{total, unavailable}), same 2 wildcard
     sites, both
     directions clean, via BOTH the framework checker and the
     back-compat shim."""
@@ -1120,7 +1266,7 @@ def test_metrics_fold_reports_same_inventory():
     assert MetricsDocChecker().run(AnalysisContext(REPO)) == []
     assert lint_metrics.lint() == []
     literals, wildcards = lint_metrics.scan_call_sites()
-    assert len(literals) == 250
+    assert len(literals) == 254
     assert len(wildcards) == 2
     names = lint_metrics.parse_components_table()
     assert len(names) == len(set(names))

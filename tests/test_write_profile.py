@@ -61,8 +61,15 @@ def test_sampler_overhead_within_budget(doc):
 def test_adaptive_shed_was_live(doc):
     # the governor must have been exercised during the banked run —
     # an overhead number measured with the shed ladder inert says
-    # nothing about production behavior
+    # nothing about production behavior.  r24: the bench now PROVES
+    # the ladder with a deterministic forced-budget probe during
+    # warmup (the r23 bank only shed by luck on a warmup spike; the
+    # faster write path holds steady duty well under budget, so a
+    # run that hopes for an organic shed would bank sheds_total=0)
     ov = doc["overhead"]
+    probe = ov["governor_probe"]
+    assert probe["shed_fired"] is True, probe
+    assert probe["forced_budget_pct"] < MAX_OVERHEAD_PCT
     assert ov["sheds_total"] >= 1 or (
         doc["detail"]["sampler"]["sheds_total"] >= 1
     )
